@@ -1,0 +1,473 @@
+"""Process groups + collectives.
+
+Reference analog: ProcessGroup API
+(/root/reference/paddle/fluid/distributed/collective/process_group.h:47) over
+NCCL/Gloo/XCCL rings, rendezvoused by TCPStore, surfaced at
+python/paddle/distributed/collective.py + communication/.
+
+TPU-native design ("ProcessGroupXLA"): a Group names a set of ranks AND binds
+to a mesh axis. Collectives have two execution paths:
+
+- **in-graph** (the hot path): when invoked on traced values inside a
+  shard_map/pjit region, they lower to XLA collectives (psum / all_gather /
+  psum_scatter / all_to_all / ppermute) compiled over ICI — zero Python in
+  the loop, overlap scheduled by XLA (the reference gets this from NCCL
+  streams + hand overlap).
+- **eager**: single-process groups are identity-semantics (world of 1 per
+  controller); multi-host eager control-plane ops route through the JAX
+  coordination service (process_allgather / broadcast) — the TCPStore-style
+  path used for metadata exchange, not for tensor math.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import env as _env
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
+           "is_initialized", "all_reduce", "all_gather", "all_gather_object",
+           "reduce_scatter", "all_to_all", "all_to_all_single", "broadcast",
+           "broadcast_object_list", "reduce", "scatter", "scatter_object_list",
+           "gather", "send", "recv", "isend", "irecv", "barrier", "wait",
+           "get_world_size", "get_rank", "get_backend",
+           "stream", "P2POp", "batch_isend_irecv"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+class Task:
+    """Future-like handle (reference ProcessGroup::Task). XLA dispatch is
+    async by construction; wait() blocks on value readiness."""
+
+    def __init__(self, tensor=None):
+        self._tensor = tensor
+
+    def wait(self):
+        if self._tensor is not None and not isinstance(
+                self._tensor._value, jax.core.Tracer):
+            self._tensor._value.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+class Group:
+    """A communicator: a list of global ranks bound to a mesh axis name."""
+
+    def __init__(self, ranks: List[int], gid: int = 0,
+                 axis_name: Optional[str] = None, pg=None, name=None):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.id = gid
+        self.axis_name = axis_name or f"group_{gid}"
+        self.name = name or self.axis_name
+        self.process_group = pg
+
+    @property
+    def rank(self):
+        r = _env.global_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) \
+            if global_rank in self.ranks else -1
+
+    def is_member(self):
+        return _env.global_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, " \
+               f"ranks={self.ranks})"
+
+
+_groups = {}
+_group_counter = [0]
+_default_group: Optional[Group] = None
+
+
+def _world_ranks():
+    return list(range(max(_env.get_world_size(), 1)))
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(_world_ranks(), 0, axis_name="world")
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """reference: python/paddle/distributed/collective.py:142 new_group.
+    backend is accepted and ignored — XLA is the only backend on TPU."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    if ranks is None:
+        ranks = _world_ranks()
+    g = Group(sorted(ranks), gid, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def is_initialized():
+    return _env.is_initialized()
+
+
+def get_world_size(group=None):
+    return (group or _get_default_group()).nranks
+
+
+def get_rank(group=None):
+    if group is None:
+        return _env.global_rank()
+    return group.rank
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis(group) -> str:
+    return (group or _get_default_group()).axis_name
+
+
+def _in_shard_map(arr, group):
+    """True when we're tracing inside a shard_map region that has this
+    group's axis bound."""
+    if not _is_traced(arr):
+        return False
+    try:
+        jax.lax.axis_index(_axis(group))
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _apply_inplace(tensor, fn, op_name):
+    out = apply(fn, tensor, op_name=op_name)
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor._out_index = out._out_index
+    tensor.stop_gradient = out.stop_gradient
+    return tensor
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(x, ax)
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+            return _REDUCERS[op](x, ax)
+        # eager single-controller: this controller holds the only shard of
+        # the group -> identity
+        return x
+
+    _apply_inplace(tensor, fn, "all_reduce")
+    return Task(tensor)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            return jax.lax.all_gather(x, ax)
+        return jnp.expand_dims(x, 0)
+
+    out = apply(fn, tensor, op_name="all_gather")
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        for i in range(out.shape[0]):
+            tensor_list.append(out[i])
+        return Task(tensor)
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.clear()
+    n = get_world_size(group)
+    if n <= 1 or not _env.is_initialized():
+        object_list.append(obj)
+        return
+    from jax.experimental import multihost_utils
+
+    import pickle
+
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    # pad to fixed size for allgather
+    size = np.asarray([data.size], np.int32)
+    sizes = multihost_utils.process_allgather(size)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: data.size] = data
+    gathered = multihost_utils.process_allgather(padded)
+    for i in range(gathered.shape[0]):
+        object_list.append(pickle.loads(
+            gathered[i, : int(sizes[i])].tobytes()))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    ax = _axis(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            return jax.lax.psum_scatter(x, ax, scatter_dimension=0,
+                                        tiled=True)
+        return x
+
+    src = tensor_or_tensor_list
+    if isinstance(src, list):
+        from ..ops.manipulation import concat
+
+        src = concat(src, axis=0)
+    out = apply(fn, src, op_name="reduce_scatter")
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor.stop_gradient = out.stop_gradient
+    return Task(tensor)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ax = _axis(group)
+    n = get_world_size(group)
+    from ..ops.manipulation import stack
+
+    x = stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, list) \
+        else in_tensor_list
+
+    def fn(v):
+        if _in_shard_map(v, group):
+            return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                      tiled=False)
+        return v
+
+    out = apply(fn, x, op_name="all_to_all")
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.clear()
+        for i in range(out.shape[0]):
+            out_tensor_list.append(out[i])
+        return Task()
+    return out
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(v):
+        if _in_shard_map(v, group):
+            return jax.lax.all_to_all(
+                v.reshape((n, v.shape[0] // n) + v.shape[1:]), ax,
+                split_axis=0, concat_axis=0, tiled=True
+            ).reshape(v.shape)
+        return v
+
+    out = apply(fn, in_tensor, op_name="all_to_all_single")
+    out_tensor._value = out._value
+    out_tensor._grad_node = out._grad_node
+    out_tensor.stop_gradient = out.stop_gradient
+    return Task(out_tensor)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    g = group or _get_default_group()
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            # select src rank's value on every rank
+            idx = jax.lax.axis_index(ax)
+            gathered = jax.lax.all_gather(x, ax)
+            return gathered[src_in_group]
+        return x
+
+    _apply_inplace(tensor, fn, "broadcast")
+    return Task(tensor)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    n = get_world_size(group)
+    if n <= 1 or not _env.is_initialized():
+        return
+    from jax.experimental import multihost_utils
+
+    obj = object_list[0] if _env.global_rank() == src else None
+    out = multihost_utils.broadcast_one_to_all(
+        np.frombuffer(__import__("pickle").dumps(obj), np.uint8)
+        if obj is not None else np.zeros(0, np.uint8))
+    if _env.global_rank() != src and out.size:
+        object_list[0] = __import__("pickle").loads(out.tobytes())
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # XLA collectives produce the result on all ranks; dst semantic kept
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return Task(tensor)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            idx = jax.lax.axis_index(_axis(group))
+            return jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+        return x
+
+    from ..ops.manipulation import stack
+
+    if tensor_list:
+        stacked = stack(tensor_list, axis=0)
+        out = apply(fn, stacked, op_name="scatter")
+        tensor._value = out._value
+        tensor.stop_gradient = out.stop_gradient
+    return Task(tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    objs = list(in_object_list or [])
+    all_objs = []
+    all_gather_object(all_objs, objs, group)
+    flat = all_objs[src] if src < len(all_objs) else objs
+    r = get_rank(group)
+    out_object_list.clear()
+    out_object_list.append(flat[r] if r < len(flat) else None)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    tl = gather_list if gather_list is not None else []
+    all_gather(tl, tensor, group, sync_op)
+    return Task(tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send. In-graph: ppermute edge (see p2p helpers in
+    meta_parallel.pp_utils). Eager single-controller: buffered locally."""
+    _p2p_buffer.setdefault(dst, []).append(Tensor(tensor._value))
+    return Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    me = _env.global_rank()
+    buf = _p2p_buffer.get(me) or []
+    if buf:
+        tensor.set_value(buf.pop(0))
+    return Task(tensor)
+
+
+_p2p_buffer = {}
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    if _env.is_initialized() and _env.get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if not isinstance(tensor._value, jax.core.Tracer):
+        tensor._value.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream namespace — stream-addressed variants.
+    XLA owns stream scheduling on TPU, so these alias the main collectives."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

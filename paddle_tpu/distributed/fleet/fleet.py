@@ -1,0 +1,133 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init:167, distributed_model via model.py:32, distributed_optimizer:1326).
+
+fleet.init builds the hybrid topology over the device mesh; distributed_model
+picks the engine by parallel mode (TensorParallel / PipelineParallel /
+ShardingParallel / SegmentParallel / DataParallel wrapper), and
+distributed_optimizer wraps with HybridParallelOptimizer. Same dispatch
+shape as the reference, engines re-designed for XLA SPMD.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env as _env
+from .. import topology as _topology
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from .base import DistributedStrategy
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    _env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    import jax
+
+    n_dev = len(jax.devices())
+    degrees = {
+        "dp": hc.get("dp_degree", 1) or 1,
+        "pp": hc.get("pp_degree", 1) or 1,
+        "sharding": hc.get("sharding_degree", 1) or 1,
+        "sep": hc.get("sep_degree", 1) or 1,
+        "mp": hc.get("mp_degree", 1) or 1,
+    }
+    import numpy as np
+
+    specified = int(np.prod(list(degrees.values())))
+    if degrees["dp"] == -1 or (specified < n_dev and degrees["dp"] == 1
+                               and specified > 1):
+        degrees["dp"] = max(n_dev // (specified // max(degrees["dp"], 1)), 1)
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"],
+        [degrees["dp"], degrees["pp"], degrees["sharding"], degrees["sep"],
+         degrees["mp"]])
+    hcg = HybridCommunicateGroup(topo)
+    if topo.world_size() <= n_dev:
+        hcg.build_mesh()
+    _topology.set_hybrid_communicate_group(hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def _hcg() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init(is_collective=True)
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:32 — dispatch on parallel mode."""
+    from ..meta_parallel import (PipelineParallel, SegmentParallel,
+                                 ShardingParallel, TensorParallel)
+    from ..parallel import DataParallel
+
+    hcg = _hcg()
+    strategy = _fleet_state["strategy"]
+    mode = hcg.get_parallel_mode()
+    if mode == "single":
+        return model
+    if mode == "data_parallel":
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy=strategy)
+    if mode == "segment_parallel":
+        return SegmentParallel(model, hcg, strategy=strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy=strategy)
+    if mode == "pipeline":
+        from ..meta_parallel.pp_layers import PipelineLayer
+
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, strategy=strategy)
+        return PipelineParallel(model, hcg, strategy=strategy)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet.py:1326 -> HybridParallelOptimizer."""
+    from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+
+    hcg = _hcg()
+    return HybridParallelOptimizer(
+        optimizer, hcg, _fleet_state["strategy"] or strategy)
+
+
+def distributed_scaler(scaler):
+    return scaler
+
+
+# info APIs (reference fleet.py worker_num etc.)
+def worker_num():
+    return _env.get_world_size()
+
+
+def worker_index():
+    return _env.global_rank()
+
+
+def is_first_worker():
+    return _env.global_rank() == 0
+
+def worker_endpoints(to_string=False):
+    eps = _env.ParallelEnv().trainer_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from .. import collective
+
+    collective.barrier()

@@ -1,0 +1,13 @@
+from .engines import (MetaParallelBase, SegmentParallel, ShardingParallel,
+                      TensorParallel)
+from .hybrid_optimizer import HybridParallelOptimizer
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave, spmd_pipeline)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .sharding_optimizer import (DygraphShardingOptimizer,
+                                 DygraphShardingOptimizerV2,
+                                 GroupShardedOptimizerStage2,
+                                 GroupShardedStage2, GroupShardedStage3,
+                                 group_sharded_parallel)

@@ -1,0 +1,54 @@
+"""Declarative op registry.
+
+Reference analog: /root/reference/paddle/phi/ops/yaml/ops.yaml (445 ops) +
+KernelFactory (paddle/phi/core/kernel_factory.h:58). There, YAML is the single
+source of truth feeding four code generators. Here the registry is populated
+at import time by @defop decorations; each entry records the pure jax
+implementation (the "kernel"), differentiability (whether a VJP is recorded),
+and is queryable/dumpable — `dump_yaml()` emits the ops.yaml-equivalent
+inventory so coverage vs the reference can be audited mechanically.
+
+On TPU there is exactly one backend (XLA) and jax.vjp supplies every backward,
+so the (op, backend, dtype) -> kernel selection problem collapses to a name ->
+jax-function table; XLA's own dispatch handles dtype/layout specialization.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+__all__ = ["OpInfo", "register", "get", "all_ops", "dump_yaml"]
+
+
+@dataclass
+class OpInfo:
+    name: str
+    fn: Callable
+    differentiable: bool = True
+    tags: tuple = ()
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register(name: str, fn: Callable, differentiable: bool = True, tags=()):
+    _REGISTRY[name] = OpInfo(name, fn, differentiable, tuple(tags))
+    return _REGISTRY[name]
+
+
+def get(name: str) -> Optional[OpInfo]:
+    return _REGISTRY.get(name)
+
+
+def all_ops() -> Dict[str, OpInfo]:
+    return dict(_REGISTRY)
+
+
+def dump_yaml() -> str:
+    lines = []
+    for name in sorted(_REGISTRY):
+        info = _REGISTRY[name]
+        lines.append(f"- op : {name}")
+        lines.append(f"  backend : xla")
+        lines.append(f"  backward : {'vjp_auto' if info.differentiable else 'none'}")
+    return "\n".join(lines)

@@ -1,0 +1,24 @@
+"""Test env: 8 virtual CPU devices so mesh/sharding paths run hardware-free
+(SURVEY.md §4 — the fake-device strategy; reference uses fake_cpu_device.h +
+CustomCPU plugin)."""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("PT_USE_PALLAS", "0")
+
+# the runtime may pre-import jax with a TPU platform pinned via env; force
+# the CPU simulation backend regardless (must happen before first devices())
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(2024)
+    yield

@@ -1,0 +1,141 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_matmul():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    x = paddle.to_tensor(a, stop_gradient=False)
+    w = paddle.to_tensor(b, stop_gradient=False)
+    loss = paddle.matmul(x, w).sum()
+    loss.backward()
+    g = np.ones((3, 2), np.float32)
+    assert np.allclose(x.grad.numpy(), g @ b.T, atol=1e-5)
+    assert np.allclose(w.grad.numpy(), a.T @ g, atol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    assert np.allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_multi_path():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    z = y + x  # two paths into x
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [5.0])  # 2x + 1
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [8.0])
+    # without retain_graph the second call must raise
+    x2 = paddle.to_tensor([2.0], stop_gradient=False)
+    y2 = (x2 * x2).sum()
+    y2.backward()
+    with pytest.raises(RuntimeError):
+        y2.backward()
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    assert np.allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_through_intermediate():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    h = x * 2
+    h.stop_gradient = False
+    y = h * h
+    gh, gx = paddle.grad(y, [h, x])
+    assert np.allclose(gh.numpy(), [12.0])
+    assert np.allclose(gx.numpy(), [24.0])
+
+
+def test_hooks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert seen and np.allclose(seen[0], [3.0])
+    assert np.allclose(x.grad.numpy(), [6.0])  # hook doubled it
+
+
+def test_non_scalar_backward_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 1.0]))
+    assert np.allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_branching_ops_grad():
+    x = paddle.to_tensor(np.random.rand(4).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.concat([x * 2, x * 3], axis=0).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [5.0] * 4)
+
+
+def test_functional_jacobian():
+    def f(x):
+        return (x * x).sum()
+
+    x = paddle.to_tensor([1.0, 2.0])
+    jac = paddle.autograd.jacobian(f, x)
+    assert np.allclose(jac.numpy(), [2.0, 4.0])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    assert np.allclose(y.numpy(), [6.0])
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [2.0])

@@ -1,0 +1,131 @@
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset,
+                           DistributedBatchSampler, TensorDataset)
+
+
+class SquareDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        x = np.full((3,), float(i), np.float32)
+        return x, np.array([i % 2], np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_basic():
+    dl = DataLoader(SquareDataset(32), batch_size=8)
+    batches = list(dl)
+    assert len(batches) == 4
+    x, y = batches[0]
+    assert x.shape == [8, 3] and y.shape == [8, 1]
+
+
+def test_dataloader_shuffle_drop_last():
+    dl = DataLoader(SquareDataset(10), batch_size=4, shuffle=True,
+                    drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 2
+
+
+def test_dataloader_workers():
+    dl = DataLoader(SquareDataset(64), batch_size=8, num_workers=2)
+    xs = [b[0].numpy()[:, 0] for b in dl]
+    flat = sorted(np.concatenate(xs).tolist())
+    assert flat == [float(i) for i in range(64)]  # ordered delivery
+
+
+def test_tensor_dataset_and_samplers():
+    xs = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    ds = TensorDataset([xs])
+    assert float(ds[3][0].numpy()) == 3.0
+    bs = BatchSampler(ds, batch_size=3)
+    assert len(bs) == 4
+    dbs = DistributedBatchSampler(SquareDataset(16), batch_size=2,
+                                  num_replicas=4, rank=1)
+    idxs = [i for b in dbs for i in b]
+    assert all(i % 4 == 1 for i in idxs)
+
+
+def test_amp_autocast_bf16():
+    with amp.auto_cast(dtype="bfloat16"):
+        a = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        b = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+        c = paddle.matmul(a, b)
+        assert c.numpy().dtype.name == "bfloat16"
+        # blacklisted op stays fp32
+        s = paddle.nn.functional.softmax(a)
+        assert s.dtype == np.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == np.float32
+
+
+def test_grad_scaler():
+    net = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0)
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    loss = net(x).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled.numpy()) == pytest.approx(2 * float(loss.numpy()),
+                                                  rel=1e-5)
+    scaled.backward()
+    before = net.weight.numpy().copy()
+    scaler.step(opt)
+    assert not np.allclose(net.weight.numpy(), before)
+
+
+def test_grad_scaler_skips_inf():
+    net = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    net.weight.grad = paddle.to_tensor(
+        np.full((2, 2), np.inf, np.float32))
+    net.bias.grad = paddle.to_tensor(np.zeros(2, np.float32))
+    before = net.weight.numpy().copy()
+    scaler.step(opt)
+    assert np.allclose(net.weight.numpy(), before)  # skipped
+    assert scaler.get_scale_ratio() == pytest.approx(2.0)  # halved
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    opt = optimizer.Adam(parameters=net.parameters())
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    net(x).sum().backward()
+    opt.step()
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(paddle.load(path))
+    assert np.allclose(net2(x).numpy(), net(x).numpy(), rtol=1e-6)
+    opt2 = optimizer.Adam(parameters=net2.parameters())
+    opt2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+    assert opt2._step_count == 1
+
+
+def test_hapi_model_fit():
+    from paddle_tpu import Model
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.datasets import FakeImageDataset
+
+    model = Model(LeNet())
+    model.prepare(
+        optimizer.Adam(parameters=model.parameters(), learning_rate=1e-3),
+        nn.CrossEntropyLoss(),
+        Accuracy())
+    ds = FakeImageDataset(num_samples=64)
+    model.fit(ds, epochs=1, batch_size=16, verbose=0)
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert "loss" in logs and "acc" in logs

@@ -1,0 +1,232 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+def test_linear():
+    lin = nn.Linear(4, 3)
+    x = t(np.random.rand(2, 4).astype(np.float32))
+    y = lin(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    assert np.allclose(y.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shapes_and_values():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = t(np.random.rand(2, 3, 16, 16).astype(np.float32))
+    assert conv(x).shape == [2, 8, 16, 16]
+    conv2 = nn.Conv2D(3, 8, 3, stride=2)
+    assert conv2(x).shape == [2, 8, 7, 7]
+    # depthwise
+    dw = nn.Conv2D(8, 8, 3, padding=1, groups=8)
+    assert dw(conv(x)).shape == [2, 8, 16, 16]
+    # value check vs manual conv for 1x1
+    c11 = nn.Conv2D(3, 4, 1, bias_attr=False)
+    y = c11(x).numpy()
+    ref = np.einsum("nchw,oc->nohw", x.numpy(),
+                    c11.weight.numpy()[:, :, 0, 0])
+    assert np.allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_transpose():
+    ct = nn.Conv2DTranspose(4, 2, 2, stride=2)
+    x = t(np.random.rand(1, 4, 8, 8).astype(np.float32))
+    assert ct(x).shape == [1, 2, 16, 16]
+
+
+def test_pools():
+    x = t(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [2, 3, 1, 1]
+    ref = x.numpy().reshape(2, 3, 4, 2, 4, 2).max((3, 5))
+    assert np.allclose(nn.MaxPool2D(2, 2)(x).numpy(), ref)
+    aref = x.numpy().mean((2, 3), keepdims=True)
+    assert np.allclose(nn.AdaptiveAvgPool2D((1, 1))(x).numpy(), aref,
+                       rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = t(np.random.rand(8, 4, 5, 5).astype(np.float32) * 3 + 1)
+    bn.train()
+    y = bn(x).numpy()
+    assert abs(y.mean()) < 1e-2
+    assert abs(y.std() - 1) < 1e-1
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm_vs_numpy():
+    ln = nn.LayerNorm(6)
+    x = np.random.rand(4, 6).astype(np.float32)
+    y = ln(t(x)).numpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5)
+    assert np.allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = np.random.rand(3, 8).astype(np.float32)
+    y = rn(t(x)).numpy()
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    assert np.allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_groupnorm_instancenorm():
+    gn = nn.GroupNorm(2, 4)
+    x = t(np.random.rand(2, 4, 5, 5).astype(np.float32))
+    assert gn(x).shape == [2, 4, 5, 5]
+    inorm = nn.InstanceNorm2D(4)
+    assert inorm(x).shape == [2, 4, 5, 5]
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = t(np.array([[1, 2], [0, 3]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    assert np.allclose(out.numpy()[1, 0], 0.0)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = t(np.ones((100, 100), np.float32))
+    d.train()
+    y = d(x).numpy()
+    assert abs(y.mean() - 1.0) < 0.1  # upscale_in_train preserves mean
+    assert (y == 0).mean() > 0.3
+    d.eval()
+    assert np.allclose(d(x).numpy(), 1.0)
+
+
+def test_activations():
+    x = np.linspace(-3, 3, 20).astype(np.float32)
+    assert np.allclose(F.relu(t(x)).numpy(), np.maximum(x, 0))
+    assert np.allclose(F.sigmoid(t(x)).numpy(), 1 / (1 + np.exp(-x)),
+                       rtol=1e-5)
+    sm = F.softmax(t(x.reshape(4, 5))).numpy()
+    assert np.allclose(sm.sum(-1), 1.0, rtol=1e-5)
+    assert np.allclose(F.leaky_relu(t(x)).numpy(),
+                       np.where(x > 0, x, 0.01 * x), rtol=1e-5)
+    g = F.gelu(t(x)).numpy()
+    assert g[0] < 0.01 and abs(g[-1] - 3) < 0.01
+
+
+def test_sequential_layerlist_state_dict():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    assert np.allclose(m2.state_dict()["0.weight"].numpy(),
+                       sd["0.weight"].numpy())
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = t(np.random.rand(2, 6, 16).astype(np.float32))
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = t(np.random.rand(2, 5, 16).astype(np.float32))
+    assert enc(x).shape == [2, 5, 16]
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=1)
+    x = t(np.random.rand(2, 5, 8).astype(np.float32))
+    out, _ = lstm(x)
+    assert out.shape == [2, 5, 16]
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out, _ = gru(x)
+    assert out.shape == [2, 5, 32]
+
+
+def test_losses_vs_numpy():
+    logits = np.random.rand(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4], np.int64)
+    loss = F.cross_entropy(t(logits), t(labels)).numpy()
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    assert np.allclose(loss, ref, rtol=1e-5)
+
+    a = np.random.rand(6).astype(np.float32)
+    b = np.random.rand(6).astype(np.float32)
+    assert np.allclose(F.mse_loss(t(a), t(b)).numpy(), ((a - b) ** 2).mean(),
+                       rtol=1e-5)
+    assert np.allclose(F.l1_loss(t(a), t(b)).numpy(),
+                       np.abs(a - b).mean(), rtol=1e-5)
+    # ignore_index
+    labels2 = np.array([0, -100, 1, -100], np.int64)
+    l2 = F.cross_entropy(t(logits), t(labels2)).numpy()
+    ref2 = -np.log(p[[0, 2], [0, 1]]).mean()
+    assert np.allclose(l2, ref2, rtol=1e-5)
+
+
+def test_bce_with_logits():
+    x = np.random.randn(8).astype(np.float32)
+    y = (np.random.rand(8) > 0.5).astype(np.float32)
+    out = F.binary_cross_entropy_with_logits(t(x), t(y)).numpy()
+    p = 1 / (1 + np.exp(-x))
+    ref = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+    assert np.allclose(out, ref, rtol=1e-4)
+
+
+def test_scaled_dot_product_attention_matches_ref():
+    q = np.random.rand(2, 8, 4, 16).astype(np.float32)  # B S H D
+    k = np.random.rand(2, 8, 4, 16).astype(np.float32)
+    v = np.random.rand(2, 8, 4, 16).astype(np.float32)
+    out = F.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+    # numpy reference
+    qb = q.transpose(0, 2, 1, 3)
+    kb = k.transpose(0, 2, 1, 3)
+    vb = v.transpose(0, 2, 1, 3)
+    s = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(16)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = (p @ vb).transpose(0, 2, 1, 3)
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_causal_attention_grad():
+    q = paddle.to_tensor(np.random.rand(1, 8, 2, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    out.sum().backward()
+    assert q.grad is not None and q.grad.shape == [1, 8, 2, 16]
+
+
+def test_interpolate():
+    x = t(np.random.rand(1, 3, 4, 4).astype(np.float32))
+    assert F.interpolate(x, size=[8, 8], mode="nearest").shape == \
+        [1, 3, 8, 8]
+    assert F.interpolate(x, scale_factor=2, mode="bilinear").shape == \
+        [1, 3, 8, 8]
+
+
+def test_clip_grad_norm():
+    p = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    (p * 100).sum().backward()
+    nn.utils.clip_grad_norm_([p], max_norm=1.0)
+    assert np.linalg.norm(p.grad.numpy()) <= 1.01

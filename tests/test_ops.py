@@ -1,0 +1,202 @@
+"""NumPy-reference op tests (the OpTest pattern, reference
+test/legacy_test/op_test.py:418 — analytic outputs vs numpy + grad checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(7)
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+UNARY_CASES = [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("sin", np.sin), ("cos", np.cos),
+    ("abs", np.abs), ("floor", np.floor), ("ceil", np.ceil),
+    ("square", np.square), ("log1p", np.log1p),
+    ("rsqrt", lambda x: 1 / np.sqrt(x)),
+    ("reciprocal", lambda x: 1 / x), ("expm1", np.expm1),
+    ("sign", np.sign), ("erf", None),
+]
+
+
+@pytest.mark.parametrize("name,ref", UNARY_CASES)
+def test_unary(name, ref):
+    a = RNG.rand(3, 4).astype(np.float32) + 0.5
+    out = getattr(paddle, name)(t(a)).numpy()
+    if ref is not None:
+        assert np.allclose(out, ref(a), rtol=1e-5, atol=1e-6), name
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power), ("mod", np.mod), ("floor_divide", np.floor_divide),
+    ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,ref", BINARY_CASES)
+def test_binary(name, ref):
+    a = RNG.rand(3, 4).astype(np.float32) + 0.5
+    b = RNG.rand(3, 4).astype(np.float32) + 0.5
+    out = getattr(paddle, name)(t(a), t(b)).numpy()
+    assert np.allclose(out, ref(a, b), rtol=1e-5), name
+
+
+def test_reductions():
+    a = RNG.rand(3, 4, 5).astype(np.float32)
+    assert np.allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+    assert np.allclose(paddle.mean(t(a), axis=1).numpy(), a.mean(1),
+                       rtol=1e-5)
+    assert np.allclose(paddle.max(t(a), axis=0).numpy(), a.max(0))
+    assert np.allclose(paddle.min(t(a), axis=-1, keepdim=True).numpy(),
+                       a.min(-1, keepdims=True))
+    assert np.allclose(paddle.prod(t(a), axis=2).numpy(), a.prod(2),
+                       rtol=1e-4)
+    assert np.allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+    assert np.allclose(paddle.var(t(a)).numpy(), a.var(ddof=1), rtol=1e-4)
+    assert np.allclose(paddle.logsumexp(t(a), axis=1).numpy(),
+                       np.log(np.exp(a).sum(1)), rtol=1e-4)
+    assert np.allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1),
+                       rtol=1e-5)
+
+
+def test_matmul_variants():
+    a = RNG.rand(2, 3, 4).astype(np.float32)
+    b = RNG.rand(2, 4, 5).astype(np.float32)
+    assert np.allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+    assert np.allclose(
+        paddle.matmul(t(a), t(b.transpose(0, 2, 1)),
+                      transpose_y=True).numpy(), a @ b, rtol=1e-5)
+    assert np.allclose(paddle.bmm(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+    v = RNG.rand(3).astype(np.float32)
+    m = RNG.rand(3, 3).astype(np.float32)
+    assert np.allclose(paddle.mv(t(m), t(v)).numpy(), m @ v, rtol=1e-5)
+    assert np.allclose(
+        paddle.einsum("bij,bjk->bik", t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    a = RNG.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    assert np.allclose(paddle.inv(t(spd)).numpy(), np.linalg.inv(spd),
+                       rtol=1e-3, atol=1e-4)
+    l = paddle.cholesky(t(spd)).numpy()
+    assert np.allclose(l @ l.T, spd, rtol=1e-4, atol=1e-4)
+    assert np.allclose(paddle.det(t(spd)).numpy(), np.linalg.det(spd),
+                       rtol=1e-3)
+    w, v = paddle.eigh(t(spd))
+    assert np.allclose(np.sort(w.numpy()),
+                       np.sort(np.linalg.eigvalsh(spd)), rtol=1e-4)
+    u, s, vh = paddle.svd(t(a))
+    assert np.allclose(np.sort(s.numpy())[::-1],
+                       np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+    b = RNG.rand(4, 2).astype(np.float32)
+    assert np.allclose(paddle.solve(t(spd), t(b)).numpy(),
+                       np.linalg.solve(spd, b), rtol=1e-3, atol=1e-4)
+    assert np.allclose(paddle.norm(t(a)).numpy(),
+                       np.linalg.norm(a), rtol=1e-5)
+
+
+def test_manipulation():
+    a = RNG.rand(2, 3, 4).astype(np.float32)
+    assert paddle.reshape(t(a), [6, 4]).shape == [6, 4]
+    assert paddle.transpose(t(a), [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.concat([t(a), t(a)], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([t(a), t(a)], axis=0).shape == [2, 2, 3, 4]
+    parts = paddle.split(t(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t(a), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    assert paddle.squeeze(t(a[:1]), axis=0).shape == [3, 4]
+    assert paddle.flip(t(a), axis=0).numpy()[0].tolist() == a[1].tolist()
+    assert paddle.roll(t(a), 1, axis=0).numpy()[0].tolist() == a[1].tolist()
+    assert paddle.tile(t(a), [1, 2, 1]).shape == [2, 6, 4]
+    assert paddle.expand(t(np.ones((1, 3), np.float32)), [5, 3]).shape == \
+        [5, 3]
+    # [1,1,2,2] = (last dim 1,1), (second-last 2,2) — reference layout
+    assert paddle.pad(t(a), [1, 1, 2, 2]).shape == [2, 7, 6]
+
+
+def test_gather_scatter():
+    a = np.arange(20, dtype=np.float32).reshape(4, 5)
+    idx = np.array([0, 2], np.int64)
+    assert np.allclose(paddle.gather(t(a), t(idx)).numpy(), a[[0, 2]])
+    assert np.allclose(
+        paddle.index_select(t(a), t(idx), axis=1).numpy(), a[:, [0, 2]])
+    nd_idx = np.array([[0, 1], [3, 4]], np.int64)
+    assert np.allclose(paddle.gather_nd(t(a), t(nd_idx)).numpy(),
+                       [a[0, 1], a[3, 4]])
+    upd = np.ones((2, 5), np.float32)
+    out = paddle.scatter(t(a), t(idx), t(upd)).numpy()
+    assert np.allclose(out[[0, 2]], 1.0)
+    tk = np.array([[1, 0], [0, 1]], np.int64)
+    assert np.allclose(
+        paddle.take_along_axis(t(a[:2, :2]), t(tk), axis=1).numpy(),
+        np.take_along_axis(a[:2, :2], tk, 1))
+
+
+def test_search_sort():
+    a = RNG.rand(3, 5).astype(np.float32)
+    assert np.allclose(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+    assert np.allclose(paddle.argsort(t(a), axis=1).numpy(), a.argsort(1))
+    s = paddle.sort(t(a), axis=1).numpy()
+    assert np.allclose(s, np.sort(a, 1))
+    vals, idx = paddle.topk(t(a), 2, axis=1)
+    ref = np.sort(a, 1)[:, ::-1][:, :2]
+    assert np.allclose(vals.numpy(), ref, rtol=1e-6)
+    nz = paddle.nonzero(t((a > 0.5).astype(np.float32)))
+    assert nz.numpy().shape[1] == 2
+    u = paddle.unique(t(np.array([3, 1, 2, 1, 3])))
+    assert u.numpy().tolist() == [1, 2, 3]
+
+
+def test_logic_where():
+    a = RNG.rand(3, 4).astype(np.float32)
+    b = RNG.rand(3, 4).astype(np.float32)
+    assert np.array_equal(paddle.equal(t(a), t(a)).numpy(),
+                          np.ones_like(a, bool))
+    w = paddle.where(t(a) > t(b), t(a), t(b)).numpy()
+    assert np.allclose(w, np.maximum(a, b))
+    assert bool(paddle.allclose(t(a), t(a)).numpy())
+
+
+def test_random_deterministic():
+    paddle.seed(123)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(123)
+    b = paddle.randn([4, 4]).numpy()
+    assert np.allclose(a, b)
+    u = paddle.uniform([1000], min=0, max=1).numpy()
+    assert 0 <= u.min() and u.max() <= 1 and abs(u.mean() - 0.5) < 0.05
+    p = paddle.randperm(10).numpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_grad_check_selected_ops():
+    """analytic vs numeric gradient (reference check_grad pattern)."""
+    def numeric_grad(f, x, eps=1e-3):
+        g = np.zeros_like(x)
+        for i in np.ndindex(x.shape):
+            xp = x.copy(); xp[i] += eps
+            xm = x.copy(); xm[i] -= eps
+            g[i] = (f(xp) - f(xm)) / (2 * eps)
+        return g
+
+    a = RNG.rand(3, 3).astype(np.float32) + 0.5
+
+    cases = {
+        "tanh": lambda x: paddle.tanh(x).sum(),
+        "exp": lambda x: paddle.exp(x).sum(),
+        "softmax": lambda x: (paddle.nn.functional.softmax(x) ** 2).sum(),
+        "norm": lambda x: paddle.norm(x),
+    }
+    for name, fn in cases.items():
+        xt = t(a.copy(), sg=False)
+        fn(xt).backward()
+        ng = numeric_grad(lambda x: float(fn(t(x)).numpy()), a)
+        assert np.allclose(xt.grad.numpy(), ng, rtol=1e-2, atol=1e-2), name

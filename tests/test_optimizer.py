@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _param(val):
+    from paddle_tpu.core.tensor import Parameter
+
+    return Parameter(np.asarray(val, np.float32))
+
+
+def _set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+def test_sgd_step():
+    p = _param([1.0, 2.0])
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [1.0, 1.0])
+    opt.step()
+    assert np.allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+
+def test_momentum():
+    p = _param([1.0])
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=[p])
+    _set_grad(p, [1.0])
+    opt.step()
+    assert np.allclose(p.numpy(), [0.9])
+    _set_grad(p, [1.0])
+    opt.step()
+    # v = 0.9*1 + 1 = 1.9 -> p = 0.9 - 0.19
+    assert np.allclose(p.numpy(), [0.71], rtol=1e-5)
+
+
+def test_adam_matches_formula():
+    p = _param([1.0])
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [0.5])
+    opt.step()
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    mhat = m / 0.1
+    vhat = v / 0.001
+    expected = 1.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    assert np.allclose(p.numpy(), [expected], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _param([1.0])
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[p],
+                          weight_decay=0.1)
+    _set_grad(p, [0.0])
+    opt.step()
+    # zero grad -> update is pure decay: p - lr*wd*p
+    assert np.allclose(p.numpy(), [1.0 - 0.1 * 0.1 * 1.0], rtol=1e-5)
+
+
+def test_weight_decay_coupled_sgd():
+    p = _param([1.0])
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    _set_grad(p, [0.0])
+    opt.step()
+    assert np.allclose(p.numpy(), [0.99], rtol=1e-5)
+
+
+@pytest.mark.parametrize("cls", [optimizer.Adagrad, optimizer.RMSProp,
+                                 optimizer.Adadelta, optimizer.Adamax,
+                                 optimizer.Lamb, optimizer.NAdam,
+                                 optimizer.RAdam])
+def test_optimizers_decrease_loss(cls):
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    kwargs = {"parameters": net.parameters(), "learning_rate": 0.05}
+    opt = cls(**kwargs)
+    x = paddle.to_tensor(np.random.rand(16, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(16, 1).astype(np.float32))
+    first = None
+    for i in range(30):
+        loss = ((net(x) - y) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first
+
+
+def test_grad_clip_global_norm():
+    p1 = _param(np.ones(4))
+    p2 = _param(np.ones(4))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                        grad_clip=optimizer.ClipGradByGlobalNorm(1.0))
+    _set_grad(p1, np.full(4, 10.0))
+    _set_grad(p2, np.full(4, 10.0))
+    opt.step()
+    delta = np.abs(1.0 - p1.numpy())
+    total = np.sqrt((delta ** 2).sum() * 2)
+    assert total <= 1.01
+
+
+def test_lr_schedulers():
+    s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(s())
+        s.step()
+    assert np.allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    c = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    assert c() == pytest.approx(1.0)
+    for _ in range(10):
+        c.step()
+    assert c() == pytest.approx(0.0, abs=1e-6)
+
+    w = lr_mod.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    assert w() == pytest.approx(0.0)
+    for _ in range(5):
+        w.step()
+    assert w() == pytest.approx(0.1)
+
+    n = lr_mod.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+    peak_area = [n() for _ in range(3)]
+    assert all(v > 0 for v in peak_area)
+
+
+def test_optimizer_with_scheduler():
+    net = nn.Linear(2, 2)
+    sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+    opt = optimizer.SGD(learning_rate=sched, parameters=net.parameters())
+    assert opt.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert opt.get_lr() == pytest.approx(0.01)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _param([1.0, 2.0])
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [0.5, 0.5])
+    opt.step()
+    sd = opt.state_dict()
+    p2 = _param(p.numpy())
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[p2])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    _set_grad(p, [0.5, 0.5])
+    _set_grad(p2, [0.5, 0.5])
+    opt.step()
+    opt2.step()
+    assert np.allclose(p.numpy(), p2.numpy(), rtol=1e-6)

@@ -1,0 +1,115 @@
+"""Regression tests for review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+
+def test_setitem_backward_no_selfloop():
+    w = paddle.to_tensor([5.0], stop_gradient=False)
+    y = paddle.zeros([3])
+    y.stop_gradient = False
+    y = y * 2.0  # give y a producer
+    y[0] = w[0]
+    y.sum().backward()
+    assert w.grad is not None and np.allclose(w.grad.numpy(), [1.0])
+
+
+def test_setitem_grad_flows_to_value():
+    w = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    base = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    y = base * 3.0
+    y[1:3] = w
+    (y * paddle.to_tensor([1.0, 10.0, 100.0, 1000.0])).sum().backward()
+    assert np.allclose(w.grad.numpy(), [10.0, 100.0])
+    # overwritten slots get no grad; others scaled by 3
+    assert np.allclose(base.grad.numpy(), [3.0, 0.0, 0.0, 3000.0])
+
+
+def test_inplace_add_backward():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * 2.0
+    h.add_(paddle.to_tensor([1.0, 1.0]))
+    h.sum().backward()
+    assert np.allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_hook_fires_once_with_accumulated_grad():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    calls = []
+    x.register_hook(lambda g: calls.append(g.numpy().copy()))
+    y = x * 2.0
+    (x + y).sum().backward()
+    assert len(calls) == 1
+    assert np.allclose(calls[0], [3.0, 3.0])
+    assert np.allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_intermediate_hook_accumulated():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x * 2.0
+    calls = []
+    h.register_hook(lambda g: calls.append(g.numpy().copy()))
+    (h * 3.0 + h * 4.0).sum().backward()
+    assert len(calls) == 1
+    assert np.allclose(calls[0], [7.0])
+
+
+def test_grid_sample_nearest_shape():
+    x = paddle.to_tensor(np.random.rand(1, 2, 4, 4).astype(np.float32))
+    grid = paddle.to_tensor(
+        np.random.uniform(-1, 1, (1, 3, 5, 2)).astype(np.float32))
+    out = F.grid_sample(x, grid, mode="nearest")
+    assert out.shape == [1, 2, 3, 5]
+    out_b = F.grid_sample(x, grid, mode="bilinear")
+    assert out_b.shape == [1, 2, 3, 5]
+
+
+def test_pool_ceil_mode():
+    x = paddle.to_tensor(np.ones((1, 1, 5, 5), np.float32))
+    out = F.max_pool2d(x, kernel_size=2, stride=2, ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    out2 = F.max_pool2d(x, kernel_size=2, stride=2, ceil_mode=False)
+    assert out2.shape == [1, 1, 2, 2]
+    avg = F.avg_pool2d(x, kernel_size=2, stride=2, ceil_mode=True)
+    assert avg.shape == [1, 1, 3, 3]
+    # border windows average only valid elements
+    assert np.allclose(avg.numpy(), 1.0)
+    d = F.avg_pool2d(paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32)),
+                     kernel_size=2, stride=2, divisor_override=2)
+    assert np.allclose(d.numpy(), 2.0)
+
+
+def test_adamw_apply_decay_param_fun():
+    lin = nn.Linear(2, 2)
+    for name, p in lin.named_parameters():
+        p.name = name
+    opt = optimizer.AdamW(
+        learning_rate=0.1, parameters=lin.parameters(), weight_decay=0.5,
+        apply_decay_param_fun=lambda n: "bias" not in n)
+    lin.weight.grad = paddle.zeros([2, 2])
+    lin.bias.grad = paddle.zeros([2])
+    wb, bb = lin.weight.numpy().copy(), lin.bias.numpy().copy()
+    opt.step()
+    assert not np.allclose(lin.weight.numpy(), wb)  # decayed
+    assert np.allclose(lin.bias.numpy(), bb)  # excluded from decay
+
+
+def test_param_groups_lr_and_wd():
+    a = nn.Linear(2, 2, bias_attr=False)
+    b = nn.Linear(2, 2, bias_attr=False)
+    opt = optimizer.SGD(
+        learning_rate=0.1,
+        parameters=[{"params": a.parameters(), "learning_rate": 0.0},
+                    {"params": b.parameters(), "weight_decay": 0.0}],
+        weight_decay=1.0)
+    a.weight.grad = paddle.ones([2, 2])
+    b.weight.grad = paddle.ones([2, 2])
+    aw, bw = a.weight.numpy().copy(), b.weight.numpy().copy()
+    opt.step()
+    # group a: lr multiplier 0 -> frozen
+    assert np.allclose(a.weight.numpy(), aw)
+    # group b: wd overridden to 0 -> pure sgd step
+    assert np.allclose(b.weight.numpy(), bw - 0.1, rtol=1e-5)

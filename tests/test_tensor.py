@@ -1,0 +1,89 @@
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_creation_dtypes():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == np.float32
+    assert paddle.to_tensor([1, 2]).dtype == np.int32  # logical int64
+    assert paddle.to_tensor(True).dtype == np.bool_
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == np.int32
+    assert paddle.full([2, 2], 7).numpy().tolist() == [[7, 7], [7, 7]]
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3.0
+    assert np.allclose(paddle.linspace(0, 1, 5).numpy(),
+                       np.linspace(0, 1, 5))
+
+
+def test_numpy_roundtrip_item():
+    a = np.random.rand(3, 4).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert np.allclose(t.numpy(), a)
+    assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+    assert len(t) == 3
+    assert t.size == 12
+    assert t.ndim == 2
+
+
+def test_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    assert np.allclose((a + b).numpy(), [5, 7, 9])
+    assert np.allclose((a - b).numpy(), [-3, -3, -3])
+    assert np.allclose((a * b).numpy(), [4, 10, 18])
+    assert np.allclose((b / a).numpy(), [4, 2.5, 2])
+    assert np.allclose((a ** 2).numpy(), [1, 4, 9])
+    assert np.allclose((-a).numpy(), [-1, -2, -3])
+    assert np.allclose((1.0 - a).numpy(), [0, -1, -2])
+    assert (a < b).numpy().all()
+    assert np.allclose(abs(paddle.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_indexing():
+    t = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    assert t[0].numpy().tolist() == [0, 1, 2, 3]
+    assert t[1, 2].item() == 6
+    assert t[:, 1].numpy().tolist() == [1, 5, 9]
+    assert t[0:2, 0:2].shape == [2, 2]
+    idx = paddle.to_tensor([0, 2])
+    assert t[idx].shape == [2, 4]
+    t[0, 0] = 99.0
+    assert t[0, 0].item() == 99.0
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert t.astype("int64").dtype == np.int32  # logical int64
+    assert t.astype(paddle.bfloat16).numpy().dtype.name == "bfloat16"
+
+
+def test_inplace_and_setvalue():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    assert t.numpy().tolist() == [2.0, 3.0]
+    t.set_value(np.array([5.0, 6.0], np.float32))
+    assert t.numpy().tolist() == [5.0, 6.0]
+    t.zero_()
+    assert t.numpy().tolist() == [0.0, 0.0]
+
+
+def test_clone_detach():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient
+
+
+def test_methods_patched():
+    t = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32))
+    assert t.sum().ndim == 0
+    assert t.mean(axis=0).shape == [3]
+    assert t.reshape([3, 2]).shape == [3, 2]
+    assert t.transpose([1, 0]).shape == [3, 2]
+    assert t.T.shape == [3, 2]
+    assert t.unsqueeze(0).shape == [1, 2, 3]
+    assert t.flatten().shape == [6]
