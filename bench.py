@@ -1,0 +1,109 @@
+"""Flagship benchmark: Llama pretraining step throughput on one TPU chip.
+
+Runs the compiled stacked-Llama training step (the same code path
+dryrun_multichip exercises over the hybrid mesh) on a ~0.9B-param Llama
+config sized for a single v5e chip, and reports tokens/sec/chip and MFU.
+
+vs_baseline: achieved MFU / 0.45 (the BASELINE.md north-star MFU target for
+Llama-2-13B on v5p; same metric, single-chip proxy).
+
+Prints ONE JSON line.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """bf16 peak FLOP/s for the attached chip."""
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12  # conservative default
+
+
+def model_flops_per_token(cfg, n_params, seq):
+    # 6ND for the matmuls + attention flops 12*L*h*s (fwd+bwd, causal/2)
+    attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq / 2 * 2
+    return 6 * n_params + attn
+
+
+def main():
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    from paddle_tpu.models import llama
+    from jax.sharding import Mesh
+
+    if on_tpu:
+        cfg = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", recompute=True)
+        batch, seq, steps = 8, 2048, 10
+    else:  # CPU smoke fallback so the harness never hard-fails
+        cfg = llama.LLAMA_PRESETS["debug"]
+        batch, seq, steps = 2, 128, 3
+
+    from paddle_tpu.distributed.fleet.trainer import HybridTrainer
+
+    dev = np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1)
+    mesh = Mesh(dev, ("dp", "pp", "sharding", "sep", "mp"))
+    trainer = HybridTrainer(cfg, mesh, learning_rate=3e-4)
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(trainer.params))
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+
+    # compile + warmup (device_get: block_until_ready is unreliable through
+    # the tunneled TPU relay)
+    loss = trainer.step(ids, labels)
+    jax.device_get(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(ids, labels)
+    jax.device_get(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_token = model_flops_per_token(cfg, n_params, seq)
+    achieved = tokens_per_sec * flops_per_token
+    mfu = achieved / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "n_params": n_params,
+            "batch": batch,
+            "seq": seq,
+            "steps": steps,
+            "loss": float(jax.device_get(loss)),
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from . import llama
+from .llama import (LLAMA_PRESETS, LlamaConfig, LlamaForCausalLM, LlamaModel)
