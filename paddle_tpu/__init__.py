@@ -51,6 +51,16 @@ from . import vision
 from . import incubate
 from . import distributed
 from . import device
+from . import distribution
+from . import fft
+from . import signal
+from . import sparse
+from . import quantization
+from . import linalg
+from . import onnx
+from . import geometric
+from . import audio
+from . import text
 from .hapi.model import Model
 from . import hapi
 from . import profiler

@@ -105,6 +105,10 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
         a2, k2 = jax.tree.unflatten(treedef, flat2)
         with autograd.no_grad():
             out = fn(*a2, **k2)
+        from ..utils import flags as _flags
+
+        if _flags.flag("check_nan_inf"):
+            check_nan_inf(name, jax.tree.leaves(out))
         return _wrap_outputs(out, node=None)
 
     diff_pos = [
@@ -127,6 +131,10 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
         out, vjp_fn = jax.vjp(run, *primals)
 
     out_flat, out_treedef = jax.tree.flatten(out)
+    from ..utils import flags as _flags
+
+    if _flags.flag("check_nan_inf"):
+        check_nan_inf(name, out_flat)
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
     node = autograd.GradNode(
         name,
@@ -148,6 +156,30 @@ def _wrap_outputs(out, node):
     out_flat, out_treedef = jax.tree.flatten(out)
     wrapped = [Tensor(o, stop_gradient=True) for o in out_flat]
     return jax.tree.unflatten(out_treedef, wrapped)
+
+
+def check_nan_inf(name, arrays):
+    """FLAGS_check_nan_inf debug mode (reference: paddle/common/flags.cc:72,
+    nan_inf_utils hooks in eager + new_executor). Eager-only: sync-checks
+    every op output; level>=3 reports instead of raising."""
+    import numpy as np
+
+    from ..utils import flags as _flags
+
+    for a in arrays:
+        if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype,
+                                                         jnp.inexact):
+            continue
+        if isinstance(a, jax.core.Tracer):
+            continue
+        bad = int(jax.device_get(jnp.sum(~jnp.isfinite(a))))
+        if bad:
+            msg = (f"op [{name}] output contains {bad} NaN/Inf values "
+                   f"(shape {tuple(a.shape)}, dtype {a.dtype})")
+            if int(_flags.flag("check_nan_inf_level") or 0) >= 3:
+                print("WARNING:", msg)
+            else:
+                raise FloatingPointError(msg)
 
 
 def defop(name: str = None, differentiable: bool = True):

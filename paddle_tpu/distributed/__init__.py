@@ -27,6 +27,11 @@ from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
 from .parallel import DataParallel
 from . import utils
+from . import auto_tuner
+from . import elastic
+from . import launch
+from .store import TCPStore
+from . import rpc
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -253,6 +253,33 @@ def get_worker_info():
     return _worker_info
 
 
+import os
+
+
+def jax_tree_to_numpy(obj):
+    """Tensors -> numpy for cross-process transport."""
+    if isinstance(obj, Tensor):
+        return ("__t__", np.asarray(obj.numpy()))
+    if isinstance(obj, (list, tuple)):
+        t = [jax_tree_to_numpy(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    if isinstance(obj, dict):
+        return {k: jax_tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def numpy_tree_to_tensor(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__t__":
+        return Tensor(obj[1])
+    if isinstance(obj, list):
+        return [numpy_tree_to_tensor(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(numpy_tree_to_tensor(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: numpy_tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (Tensor,)):
@@ -287,6 +314,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -317,6 +346,12 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
+        if self.use_shared_memory:
+            from ..utils import native
+
+            if native.available():
+                yield from self._iter_shm_workers()
+                return
         yield from self._iter_workers()
 
     def _iter_iterable(self):
@@ -328,6 +363,66 @@ class DataLoader:
                 batch = []
         if batch and not getattr(self, "drop_last", False):
             yield self.collate_fn(batch)
+
+    def _iter_shm_workers(self):
+        """Multiprocess workers hand batches through native shared-memory
+        rings (reference: io/dataloader/worker.py + shared-mem transport;
+        native side csrc/pt_runtime.cpp). Batch i is produced by worker
+        i % W and rings are drained round-robin, preserving order."""
+        import multiprocessing as mp
+        import pickle
+
+        from ..utils.native import ShmRing
+
+        all_batches = list(self.batch_sampler)
+        w = min(self.num_workers, max(len(all_batches), 1))
+        ring_bytes = 64 << 20
+        base = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+        rings = [ShmRing(f"{base}_{i}", ring_bytes, create=True)
+                 for i in range(w)]
+
+        dataset = self.dataset
+        collate = self.collate_fn
+        init_fn = self.worker_init_fn
+
+        def worker(widx, ring_name):
+            ring = ShmRing(ring_name, ring_bytes, create=False)
+            try:
+                global _worker_info
+                import paddle_tpu.io as _io
+
+                _io._worker_info = _WorkerInfo(widx, w, dataset)
+                if init_fn is not None:
+                    init_fn(widx)
+                for bi in range(widx, len(all_batches), w):
+                    batch = collate([dataset[j] for j in all_batches[bi]])
+                    payload = pickle.dumps(
+                        jax_tree_to_numpy(batch), protocol=4)
+                    ring.write(payload)
+            finally:
+                ring.mark_closed()
+                ring.close(unlink=False)
+
+        ctx = mp.get_context("fork")
+        procs = [ctx.Process(target=worker, args=(i, f"{base}_{i}"),
+                             daemon=True) for i in range(w)]
+        for p in procs:
+            p.start()
+        try:
+            import pickle
+
+            for bi in range(len(all_batches)):
+                data = rings[bi % w].read(
+                    timeout_ms=int((self.timeout or 300) * 1000))
+                if data is None:
+                    return
+                yield numpy_tree_to_tensor(pickle.loads(data))
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for r in rings:
+                r.close(unlink=True)
 
     def _iter_workers(self):
         import concurrent.futures

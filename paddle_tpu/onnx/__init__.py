@@ -1,0 +1,31 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py). On TPU the deploy
+interchange is StableHLO (jax.export), which this wraps; classic ONNX
+protobuf export is not provided in-tree."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export the layer as a StableHLO module (path + '.stablehlo.mlir')."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..jit import functional as FB
+
+    if input_spec is None:
+        raise ValueError("input_spec required for export")
+    params = FB.current_params(layer)
+    buffers = FB.current_buffers(layer)
+
+    def pure(params, buffers, *ins):
+        out, _ = FB.call_functional(layer, params, buffers, ins, train=False)
+        return out
+
+    args = [jnp.zeros(tuple(s.shape),
+                      s.dtype if not isinstance(s.dtype, str) else s.dtype)
+            for s in input_spec]
+    lowered = jax.jit(pure).lower(params, buffers, *args)
+    text = lowered.as_text()
+    out_path = path + ".stablehlo.mlir"
+    with open(out_path, "w") as f:
+        f.write(text)
+    return out_path

@@ -1,0 +1,144 @@
+"""paddle_tpu.sparse (reference: python/paddle/sparse/ — SparseCooTensor /
+SparseCsrTensor with 51 sparse op kernels).
+
+TPU-native: wraps jax.experimental.sparse BCOO (XLA-native sparse) behind
+the reference's coo/csr API. Dense fallbacks keep semantics where BCOO
+lacks an op."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_same_shape", "add", "subtract", "multiply", "matmul",
+           "masked_matmul", "relu", "to_dense", "to_sparse_coo", "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+        self.stop_gradient = True
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.dtype)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype.name})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    idx = indices.numpy() if isinstance(indices, Tensor) else \
+        np.asarray(indices)
+    vals = values.numpy() if isinstance(values, Tensor) else \
+        np.asarray(values, np.float32)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx.T)),
+                        shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    vals = np.asarray(values.numpy() if isinstance(values, Tensor)
+                      else values, np.float32)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols])
+    return sparse_coo_tensor(idx, vals, shape)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return SparseCooTensor(jsparse.BCOO.fromdense(arr))
+
+
+def to_dense(x):
+    return x.to_dense() if isinstance(x, SparseCooTensor) else x
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _sp(x):
+    return x._bcoo if isinstance(x, SparseCooTensor) else x._value
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(jsparse.BCOO.fromdense(
+            x._bcoo.todense() + y._bcoo.todense()))
+    return Tensor(to_dense(x)._value + to_dense(y)._value)
+
+
+def subtract(x, y, name=None):
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        to_dense(x)._value - to_dense(y)._value))
+
+
+def multiply(x, y, name=None):
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        to_dense(x)._value * to_dense(y)._value))
+
+
+def matmul(x, y, name=None):
+    if isinstance(x, SparseCooTensor):
+        out = x._bcoo @ (y._value if isinstance(y, Tensor) else _sp(y))
+        return Tensor(out if not isinstance(out, jsparse.BCOO)
+                      else out.todense())
+    return Tensor(x._value @ _sp(y))
+
+
+def masked_matmul(x, y, mask, name=None):
+    dense = (x._value if isinstance(x, Tensor) else x._bcoo.todense()) @ \
+        (y._value if isinstance(y, Tensor) else y._bcoo.todense())
+    m = mask._bcoo.todense() if isinstance(mask, SparseCooTensor) else \
+        mask._value
+    return SparseCooTensor(jsparse.BCOO.fromdense(
+        jnp.where(m != 0, dense, 0)))
+
+
+def relu(x, name=None):
+    return SparseCooTensor(jsparse.BCOO(
+        (jax.nn.relu(x._bcoo.data), x._bcoo.indices), shape=x._bcoo.shape))
+
+
+class nn:
+    """paddle.sparse.nn — minimal sparse layer namespace."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -1,0 +1,190 @@
+"""Aux subsystems: native shm ring, nan/inf debug, distributions, fft,
+sparse, quantization, auto-tuner, profiler, onnx export."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_native_ring_roundtrip():
+    from paddle_tpu.utils import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    r = native.ShmRing("/pt_test_ring_ut", 1 << 20, create=True)
+    c = native.ShmRing("/pt_test_ring_ut", 1 << 20, create=False)
+    for i in range(10):
+        r.write(bytes([i]) * (i * 1000 + 1))
+    for i in range(10):
+        assert c.read() == bytes([i]) * (i * 1000 + 1)
+    r.mark_closed()
+    assert c.read() is None
+    c.close(unlink=False)
+    r.close(unlink=True)
+
+
+def test_shm_dataloader():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.utils import native
+    from paddle_tpu.vision.datasets import FakeImageDataset
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    ds = FakeImageDataset(num_samples=48)
+    dl = DataLoader(ds, batch_size=8, num_workers=2, use_shared_memory=True)
+    batches = list(dl)
+    assert len(batches) == 6
+    # order preserved
+    assert np.allclose(batches[0][0].numpy()[0], ds._images[0])
+    assert np.allclose(batches[3][0].numpy()[0], ds._images[24])
+
+
+def test_nan_inf_check():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            paddle.log(x * 0 - 1)  # log of negative -> nan
+        paddle.exp(x)  # clean op passes
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_distributions():
+    from paddle_tpu import distribution as D
+
+    paddle.seed(0)
+    n = D.Normal(0.0, 1.0)
+    s = n.sample([2000])
+    assert abs(float(s.mean().numpy())) < 0.1
+    lp = n.log_prob(paddle.to_tensor([0.0]))
+    assert np.allclose(lp.numpy(), -0.5 * np.log(2 * np.pi), rtol=1e-5)
+    assert float(n.entropy().numpy()) == pytest.approx(
+        0.5 * np.log(2 * np.pi * np.e), rel=1e-5)
+
+    c = D.Categorical(probs=paddle.to_tensor([0.2, 0.8]))
+    samples = c.sample([500]).numpy()
+    assert 0.7 < samples.mean() < 0.9
+    assert np.allclose(c.log_prob(paddle.to_tensor([1])).numpy(),
+                       np.log(0.8), rtol=1e-5)
+
+    b = D.Bernoulli(probs=0.3)
+    assert np.allclose(b.log_prob(paddle.to_tensor([1.0])).numpy(),
+                       np.log(0.3), rtol=1e-4)
+
+    kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))
+    assert float(kl.numpy()) == pytest.approx(0.0, abs=1e-6)
+
+    g = D.Gamma(2.0, 3.0)
+    s = g.sample([1000])
+    assert abs(float(s.mean().numpy()) - 2 / 3) < 0.1
+
+    lap = D.Laplace(0.0, 1.0)
+    assert np.allclose(lap.log_prob(paddle.to_tensor([0.0])).numpy(),
+                       -np.log(2.0), rtol=1e-5)
+
+
+def test_fft_roundtrip():
+    x = paddle.randn([4, 16])
+    y = paddle.fft.ifft(paddle.fft.fft(x))
+    assert np.allclose(y.numpy().real, x.numpy(), atol=1e-5)
+    r = paddle.fft.irfft(paddle.fft.rfft(x), n=16)
+    assert np.allclose(r.numpy(), x.numpy(), atol=1e-5)
+
+
+def test_signal_stft_istft():
+    from paddle_tpu import signal
+
+    x = paddle.randn([2, 512])
+    spec = signal.stft(x, n_fft=64, hop_length=16)
+    assert spec.shape[1] == 33  # onesided freqs
+    rec = signal.istft(spec, n_fft=64, hop_length=16, length=512)
+    assert np.allclose(rec.numpy(), x.numpy(), atol=1e-4)
+
+
+def test_sparse():
+    dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+    sp = paddle.sparse.to_sparse_coo(paddle.to_tensor(dense))
+    assert sp.nnz() == 3
+    assert np.allclose(sp.to_dense().numpy(), dense)
+    idx = np.array([[0, 1], [0, 2]], np.int64)
+    sp2 = paddle.sparse.sparse_coo_tensor(idx, [5.0, 6.0], shape=[2, 3])
+    assert sp2.to_dense().numpy()[1, 2] == 6.0
+    mm = paddle.sparse.matmul(sp, paddle.to_tensor(
+        np.ones((3, 2), np.float32)))
+    assert np.allclose(mm.numpy(), dense @ np.ones((3, 2), np.float32))
+
+
+def test_quantization_ptq_qat():
+    from paddle_tpu.quantization import (AbsmaxObserver, FakeQuanterWithAbsMax,
+                                         QAT, QuantConfig)
+
+    obs = AbsmaxObserver()
+    obs.observe(paddle.to_tensor([-4.0, 2.0]))
+    assert obs.scales() == pytest.approx(4.0 / 127)
+
+    fq = FakeQuanterWithAbsMax()
+    fq.train()
+    x = paddle.to_tensor(np.linspace(-1, 1, 32).astype(np.float32),
+                         stop_gradient=False)
+    y = fq(x)
+    assert np.abs(y.numpy() - x.numpy()).max() < 0.02  # quantization error
+    y.sum().backward()
+    assert np.allclose(x.grad.numpy(), 1.0)  # STE
+
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    qat = QAT(QuantConfig())
+    qmodel = qat.quantize(model)
+    out = qmodel(paddle.randn([2, 8]))
+    assert out.shape == [2, 4]
+
+
+def test_auto_tuner():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, TunerCfg
+
+    t = AutoTuner(num_devices=8, global_batch=16, n_params=10 ** 9,
+                  hidden=4096, layers=32, seq=2048)
+    cands = t.candidates()
+    assert cands and all(c.world() == 8 for c in cands)
+    best = t.tune()
+    assert best.world() == 8
+    # measured-trial path picks the measured winner among trialed configs
+    ranked = t.rank()
+    target = ranked[min(3, len(ranked) - 1)]
+    best2 = t.tune(lambda c: 0.0 if c == target else 1.0)
+    assert best2 == target
+
+
+def test_profiler():
+    from paddle_tpu import profiler
+
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    with profiler.RecordEvent("my_span"):
+        paddle.matmul(paddle.randn([32, 32]), paddle.randn([32, 32]))
+    prof.step(num_samples=32)
+    prof.stop()
+    table = prof.summary()
+    assert "my_span" in table
+    assert "avg step" in prof.step_info()
+
+
+def test_onnx_stablehlo_export(tmp_path):
+    model = nn.Linear(4, 2)
+    from paddle_tpu.jit.api import InputSpec
+
+    path = paddle.onnx.export(
+        model, str(tmp_path / "m"),
+        input_spec=[InputSpec([1, 4], "float32")])
+    text = open(path).read()
+    assert "stablehlo" in text or "mhlo" in text or "func" in text
+
+
+def test_registry_dump():
+    from paddle_tpu.ops import registry
+
+    ops = registry.all_ops()
+    assert len(ops) > 250
+    yaml = registry.dump_yaml()
+    assert "- op : matmul" in yaml

@@ -1,0 +1,66 @@
+"""geometric / audio / text / rpc domain APIs."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_geometric_segment_ops():
+    data = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    s = paddle.geometric.segment_sum(data, seg)
+    assert np.allclose(s.numpy(), [[2, 4], [10, 12]])
+    m = paddle.geometric.segment_mean(data, seg)
+    assert np.allclose(m.numpy(), [[1, 2], [5, 6]])
+    mx = paddle.geometric.segment_max(data, seg)
+    assert np.allclose(mx.numpy(), [[2, 3], [6, 7]])
+
+
+def test_geometric_message_passing():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2]))
+    dst = paddle.to_tensor(np.array([1, 2, 0]))
+    out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+    assert np.allclose(out.numpy(), np.eye(3)[[2, 0, 1]])
+
+
+def test_audio_features():
+    from paddle_tpu.audio import features, functional
+
+    x = paddle.randn([2, 2048])
+    spec = features.Spectrogram(n_fft=256)(x)
+    assert spec.shape[1] == 129
+    mel = features.MelSpectrogram(n_fft=256, n_mels=32)(x)
+    assert mel.shape[1] == 32
+    mfcc = features.MFCC(n_fft=256, n_mels=32, n_mfcc=13)(x)
+    assert mfcc.shape[1] == 13
+    fb = functional.compute_fbank_matrix(16000, 256, 32)
+    assert fb.shape == [32, 129]
+
+
+def test_text_viterbi():
+    from paddle_tpu.text import ViterbiDecoder
+
+    # deterministic chain: transition heavily favors staying
+    emit = np.array([[[5.0, 0.0], [0.0, 5.0], [0.0, 5.0]]], np.float32)
+    trans = np.array([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    dec = ViterbiDecoder(paddle.to_tensor(trans))
+    scores, path = dec(paddle.to_tensor(emit))
+    assert path.numpy().tolist() == [[0, 1, 1]]
+
+
+def test_rpc_in_process():
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("worker0", rank=0, world_size=1,
+                 master_endpoint="127.0.0.1:0")
+    try:
+        assert rpc.rpc_sync("worker0", max, args=(3, 5)) == 5
+        fut = rpc.rpc_async("worker0", sum, args=([1, 2, 3],))
+        assert fut.result(timeout=10) == 6
+        info = rpc.get_worker_info()
+        assert info.name == "worker0" and info.rank == 0
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("worker0", divmod, args=(1, 0))
+    finally:
+        rpc.shutdown()
