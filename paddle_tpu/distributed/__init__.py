@@ -29,6 +29,8 @@ from .parallel import DataParallel
 from . import utils
 from . import auto_tuner
 from . import elastic
+from .watchdog import (comm_task_manager, disable_comm_watchdog,
+                       enable_comm_watchdog)
 from . import launch
 from .store import TCPStore
 from . import rpc

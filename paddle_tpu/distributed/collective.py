@@ -29,6 +29,7 @@ import numpy as np
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 from . import env as _env
+from .watchdog import comm_task_manager
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
            "is_initialized", "all_reduce", "all_gather", "all_gather_object",
@@ -58,13 +59,16 @@ class Task:
     """Future-like handle (reference ProcessGroup::Task). XLA dispatch is
     async by construction; wait() blocks on value readiness."""
 
-    def __init__(self, tensor=None):
+    def __init__(self, tensor=None, comm_task=None):
         self._tensor = tensor
+        self._comm_task = comm_task
 
     def wait(self):
         if self._tensor is not None and not isinstance(
                 self._tensor._value, jax.core.Tracer):
             self._tensor._value.block_until_ready()
+        if self._comm_task is not None:
+            self._comm_task.mark_done()
         return True
 
     def is_completed(self):
@@ -198,11 +202,28 @@ def _apply_inplace(tensor, fn, op_name):
     return tensor
 
 
+def _track(op_name, group, tensor=None):
+    """Register this collective with the desync watchdog (reference:
+    CommTaskManager::CommTaskEnqueue, comm_task_manager.h)."""
+    if not comm_task_manager.enabled:
+        return None
+    g = group or _get_default_group()
+    shape = dtype = None
+    if tensor is not None:
+        try:
+            shape, dtype = tuple(tensor.shape), tensor.dtype
+        except Exception:
+            pass
+    return comm_task_manager.start_task(
+        op_name, g.id, g.ranks, _env.global_rank(), shape=shape, dtype=dtype)
+
+
 # ---------------------------------------------------------------------------
 # collectives
 # ---------------------------------------------------------------------------
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ct = _track("all_reduce", group, tensor)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -218,10 +239,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return x
 
     _apply_inplace(tensor, fn, "all_reduce")
-    return Task(tensor)
+    if ct is not None:
+        ct.attach(tensor._value)
+    return Task(tensor, ct)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ct = _track("all_gather", group, tensor)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -231,11 +255,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         return jnp.expand_dims(x, 0)
 
     out = apply(fn, tensor, op_name="all_gather")
+    if ct is not None:
+        ct.attach(out._value)
     if isinstance(tensor_list, list):
         tensor_list.clear()
         for i in range(out.shape[0]):
             tensor_list.append(out[i])
-        return Task(tensor)
+        return Task(out, ct)
     return out
 
 
@@ -264,6 +290,7 @@ def all_gather_object(object_list, obj, group=None):
 
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
+    ct = _track("reduce_scatter", group, tensor)
     ax = _axis(group)
 
     def fn(x):
@@ -278,13 +305,16 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 
         src = concat(src, axis=0)
     out = apply(fn, src, op_name="reduce_scatter")
+    if ct is not None:
+        ct.attach(out._value)
     tensor._value = out._value
     tensor._grad_node = out._grad_node
     tensor.stop_gradient = out.stop_gradient
-    return Task(tensor)
+    return Task(tensor, ct)
 
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ct = _track("all_to_all", group)
     ax = _axis(group)
     n = get_world_size(group)
     from ..ops.manipulation import stack
@@ -299,16 +329,19 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         return v
 
     out = apply(fn, x, op_name="all_to_all")
+    if ct is not None:
+        ct.attach(out._value)
     if isinstance(out_tensor_list, list):
         out_tensor_list.clear()
         for i in range(out.shape[0]):
             out_tensor_list.append(out[i])
-        return Task()
+        return Task(comm_task=ct)
     return out
 
 
 def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
                       in_split_sizes=None, group=None, sync_op=True):
+    ct = _track("all_to_all_single", group, in_tensor)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -321,13 +354,16 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
         return v
 
     out = apply(fn, in_tensor, op_name="all_to_all_single")
+    if ct is not None:
+        ct.attach(out._value)
     out_tensor._value = out._value
     out_tensor._grad_node = out._grad_node
     out_tensor.stop_gradient = out.stop_gradient
-    return Task(out_tensor)
+    return Task(out_tensor, ct)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    ct = _track("broadcast", group, tensor)
     ax = _axis(group)
     g = group or _get_default_group()
     src_in_group = g.get_group_rank(src) if src in g.ranks else src
@@ -341,7 +377,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return x
 
     _apply_inplace(tensor, fn, "broadcast")
-    return Task(tensor)
+    if ct is not None:
+        ct.attach(tensor._value)
+    return Task(tensor, ct)
 
 
 def broadcast_object_list(object_list, src=0, group=None):

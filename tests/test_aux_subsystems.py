@@ -188,3 +188,40 @@ def test_registry_dump():
     assert len(ops) > 250
     yaml = registry.dump_yaml()
     assert "- op : matmul" in yaml
+
+
+def test_comm_watchdog():
+    """Collective desync watchdog (reference: CommTaskManager,
+    paddle/phi/core/distributed/comm_task_manager.h): in-flight collectives
+    are readiness-polled; only genuinely unready ones past the timeout are
+    dumped with per-group sequence counters."""
+    import json
+    import time
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.watchdog import comm_task_manager
+
+    dump = "/tmp/pt_watchdog_dump.jsonl"
+    open(dump, "w").close()
+    dist.enable_comm_watchdog(timeout_s=0.5, dump_path=dump)
+    try:
+        # completed eager collectives are NOT false-positive dumped, even
+        # when the Task is discarded without wait()
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        dist.all_reduce(x)
+        dist.broadcast(x, src=0)
+        assert comm_task_manager.seq_counters().get(0, 0) >= 2
+        time.sleep(1.6)
+        assert open(dump).read().strip() == ""
+        assert comm_task_manager.pending() == []
+        # a genuinely never-completing collective IS dumped exactly once
+        comm_task_manager.start_task("all_reduce", 0, [0], 0,
+                                     shape=(4,), dtype="float32")
+        time.sleep(1.6)
+        lines = [json.loads(l) for l in open(dump) if l.strip()]
+        assert len(lines) == 1 and lines[0]["event"] == "comm_task_timeout"
+        assert lines[0]["stalled"]["op"] == "all_reduce"
+        assert lines[0]["group_seq_counters"]["0"] >= 3
+    finally:
+        dist.disable_comm_watchdog()
+    assert comm_task_manager.dump_path == ""
