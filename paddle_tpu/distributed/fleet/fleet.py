@@ -89,10 +89,22 @@ def distributed_model(model):
     if mode == "sharding_parallel":
         return ShardingParallel(model, hcg, strategy=strategy)
     if mode == "pipeline":
+        from ..meta_parallel.pipeline_parallel import (
+            PipelineParallelWithInterleave, PipelineParallelZeroBubble)
         from ..meta_parallel.pp_layers import PipelineLayer
 
+        pp_cfg = dict(strategy.hybrid_configs.get("pp_configs", {}) or {}) \
+            if strategy is not None else {}
+        sched = str(pp_cfg.get("schedule_mode", "1F1B")).upper()
+        v = 1
         if isinstance(model, PipelineLayer):
-            return PipelineParallel(model, hcg, strategy=strategy)
+            v = model.get_num_virtual_stages()
+        if sched in ("ZBH1", "ZB-H1", "ZERO_BUBBLE"):
+            return PipelineParallelZeroBubble(model, hcg, strategy=strategy)
+        if v > 1 or sched == "VPP":
+            return PipelineParallelWithInterleave(
+                model, hcg, strategy=strategy,
+                num_virtual_pipeline_stages=max(v, 1))
         return PipelineParallel(model, hcg, strategy=strategy)
     return model
 

@@ -3,8 +3,11 @@ from .engines import (MetaParallelBase, SegmentParallel, ShardingParallel,
 from .hybrid_optimizer import HybridParallelOptimizer
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
+from . import pipeline_schedules
 from .pipeline_parallel import (PipelineParallel,
-                                PipelineParallelWithInterleave, spmd_pipeline)
+                                PipelineParallelWithInterleave,
+                                PipelineParallelZeroBubble, spmd_pipeline,
+                                spmd_pipeline_interleaved)
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .sharding_optimizer import (DygraphShardingOptimizer,
                                  DygraphShardingOptimizerV2,

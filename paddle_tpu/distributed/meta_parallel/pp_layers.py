@@ -43,12 +43,14 @@ class PipelineLayer(Layer):
         super().__init__()
         self._loss_fn = loss_fn
         self._topo = topology
-        from ...topology import get_hybrid_communicate_group
+        from ..topology import get_hybrid_communicate_group
 
         hcg = get_hybrid_communicate_group()
         if num_stages is None:
             num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
         self._num_stages = max(num_stages, 1)
+        self._num_virtual_pipeline_stages = max(
+            num_virtual_pipeline_stages or 1, 1)
         self._recompute_interval = recompute_interval
 
         descs = list(layers)
@@ -121,7 +123,7 @@ class PipelineLayer(Layer):
         return self._num_stages
 
     def get_num_virtual_stages(self):
-        return 1
+        return self._num_virtual_pipeline_stages
 
     def stage_fns(self, stage_id: int) -> List[Callable]:
         lo, hi = self._stage_bounds[stage_id], self._stage_bounds[stage_id + 1]
