@@ -34,6 +34,7 @@ from .watchdog import (comm_task_manager, disable_comm_watchdog,
 from . import launch
 from .store import TCPStore
 from . import rpc
+from . import ps
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

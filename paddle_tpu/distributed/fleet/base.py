@@ -67,23 +67,76 @@ class DistributedStrategy:
 
 
 class PaddleCloudRoleMaker:
-    """reference: fleet/base/role_maker.py — reads the launcher env."""
+    """reference: fleet/base/role_maker.py — reads the launcher env.
+
+    Collective mode: rank/world from the collective env. PS mode
+    (is_collective=False): reads the reference's PS env contract —
+    TRAINING_ROLE (TRAINER|PSERVER), PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID, POD_IP, PADDLE_PORT."""
 
     def __init__(self, is_collective=True, **kwargs):
+        import os
+
         self._is_collective = is_collective
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_eps = [e for e in eps.split(",") if e]
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                "1") or 1)
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        self._pod_ip = os.environ.get("POD_IP", "127.0.0.1")
+        self._port = os.environ.get("PADDLE_PORT", "")
 
     def _worker_num(self):
+        if not self._is_collective:
+            return self._trainers_num
         from .. import env
 
         return env.get_world_size()
 
     def _worker_index(self):
+        if not self._is_collective:
+            return self._trainer_id
         from .. import env
 
         return env.global_rank()
 
     def _is_worker(self):
-        return True
+        return self._is_collective or self._role == "TRAINER"
+
+    def _is_server(self):
+        return not self._is_collective and self._role == "PSERVER"
+
+    def _server_num(self):
+        return len(self._server_eps)
+
+    def _server_endpoints(self):
+        return list(self._server_eps)
+
+    def _server_endpoint(self):
+        """This PSERVER node's own endpoint (must be one of the
+        advertised endpoints or clients will never route to it)."""
+        me = f"{self._pod_ip}:{self._port}"
+        if not self._port or (self._server_eps
+                              and me not in self._server_eps):
+            raise RuntimeError(
+                f"PSERVER endpoint {me!r} not in "
+                f"PADDLE_PSERVERS_IP_PORT_LIST={self._server_eps}; set "
+                "POD_IP/PADDLE_PORT to one of the advertised endpoints")
+        return me
 
 
-UserDefinedRoleMaker = PaddleCloudRoleMaker
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Programmatic role maker (reference fleet/base/role_maker.py
+    UserDefinedRoleMaker): pass role/endpoints directly instead of env."""
+
+    def __init__(self, is_collective=False, current_id=0, role="TRAINER",
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._role = role.upper()
+        self._trainer_id = current_id
+        self._trainers_num = worker_num
+        self._server_eps = list(server_endpoints or [])
+        if self._role == "PSERVER" and self._server_eps:
+            ep = self._server_eps[current_id % len(self._server_eps)]
+            self._pod_ip, self._port = ep.rsplit(":", 1)

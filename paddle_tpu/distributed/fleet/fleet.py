@@ -24,6 +24,26 @@ _fleet_state = {
 
 
 def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    # PS mode (reference fleet.init(role) / fleet.init(is_collective=False)
+    # under the PS env contract): stand up TheOnePs instead of the
+    # collective topology
+    import os as _os
+
+    if (role_maker is None and not is_collective
+            and _os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST")):
+        from .base import PaddleCloudRoleMaker
+
+        role_maker = PaddleCloudRoleMaker(is_collective=False)
+    if role_maker is not None and not getattr(
+            role_maker, "_is_collective", True):
+        from ..ps.the_one_ps import TheOnePs, set_runtime
+
+        rt = TheOnePs(role_maker)
+        set_runtime(rt)
+        _fleet_state.update(initialized=True,
+                            strategy=strategy or DistributedStrategy(),
+                            hcg=None, role_maker=role_maker, ps_runtime=rt)
+        return None
     _env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
@@ -51,12 +71,56 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
     if topo.world_size() <= n_dev:
         hcg.build_mesh()
     _topology.set_hybrid_communicate_group(hcg)
-    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
+                        role_maker=None, ps_runtime=None)
     return None
 
 
 def is_initialized():
     return _fleet_state["initialized"]
+
+
+def _ps_runtime():
+    rt = _fleet_state.get("ps_runtime")
+    if rt is None:
+        raise RuntimeError("fleet is not in parameter-server mode; "
+                           "init with a PS role maker first")
+    return rt
+
+
+def is_server():
+    rm = _fleet_state.get("role_maker")
+    return bool(rm is not None and rm._is_server())
+
+
+def is_worker():
+    rm = _fleet_state.get("role_maker")
+    return rm is None or rm._is_worker()
+
+
+def server_num():
+    rm = _fleet_state.get("role_maker")
+    return rm._server_num() if rm is not None else 0
+
+
+def init_server(*args, **kwargs):
+    _ps_runtime().init_server(*args, **kwargs)
+
+
+def run_server():
+    _ps_runtime().run_server()
+
+
+def stop_server():
+    _ps_runtime().stop_server()
+
+
+def init_worker():
+    _ps_runtime().init_worker()
+
+
+def stop_worker(stop_servers=False):
+    _ps_runtime().stop_worker(stop_servers=stop_servers)
 
 
 def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
@@ -110,6 +174,10 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    if _fleet_state.get("ps_runtime") is not None:
+        from ..ps.the_one_ps import PSOptimizer
+
+        return PSOptimizer(optimizer, _fleet_state["ps_runtime"])
     """reference: fleet.py:1326 -> HybridParallelOptimizer."""
     from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
 
@@ -124,15 +192,17 @@ def distributed_scaler(scaler):
 
 # info APIs (reference fleet.py worker_num etc.)
 def worker_num():
-    return _env.get_world_size()
+    rm = _fleet_state.get("role_maker")
+    return rm._worker_num() if rm is not None else _env.get_world_size()
 
 
 def worker_index():
-    return _env.global_rank()
+    rm = _fleet_state.get("role_maker")
+    return rm._worker_index() if rm is not None else _env.global_rank()
 
 
 def is_first_worker():
-    return _env.global_rank() == 0
+    return is_worker() and worker_index() == 0
 
 def worker_endpoints(to_string=False):
     eps = _env.ParallelEnv().trainer_endpoints
@@ -140,6 +210,9 @@ def worker_endpoints(to_string=False):
 
 
 def barrier_worker():
+    if _fleet_state.get("ps_runtime") is not None:
+        _ps_runtime().barrier_worker()
+        return
     from .. import collective
 
     collective.barrier()

@@ -1,0 +1,162 @@
+"""Parameter-server stack (reference: paddle/fluid/distributed/ps/ +
+python/paddle/distributed/ps/the_one_ps.py): sharded sparse/dense tables,
+TCP pull/push services, async communicator, role maker, and end-to-end
+a_sync embedding training through fleet."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import ps as psmod
+
+
+def test_sparse_table_rules():
+    t = psmod.SparseTable(dim=4, rule="sgd", lr=0.1)
+    rows0 = t.pull([3, 7])
+    g = np.ones((2, 4), np.float32)
+    t.push([3, 7], g)
+    rows1 = t.pull([3, 7])
+    np.testing.assert_allclose(rows1, rows0 - 0.1, rtol=1e-6)
+    # duplicate keys pre-aggregate
+    t.push([9, 9], np.ones((2, 4), np.float32))
+    r9 = t.pull([9])
+    t2 = psmod.SparseTable(dim=4, rule="sgd", lr=0.1)
+    t2.push([9], 2 * np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(r9, t2.pull([9]), rtol=1e-6)
+    # adagrad accumulates g2
+    ta = psmod.SparseTable(dim=2, rule="adagrad", lr=1.0)
+    r0 = ta.pull([1])
+    ta.push([1], np.full((1, 2), 2.0, np.float32))
+    step1 = r0 - ta.pull([1])
+    ta.push([1], np.full((1, 2), 2.0, np.float32))
+    step2 = (r0 - step1) - ta.pull([1])
+    assert (np.abs(step2) < np.abs(step1)).all()   # lr shrinks with g2sum
+
+
+def test_ps_server_client_routing():
+    servers = [psmod.PsServer(port=0).start() for _ in range(2)]
+    try:
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        c = psmod.PsClient(eps)
+        c.create_sparse_table(0, dim=8, rule="sgd", lr=0.5)
+        keys = np.array([0, 1, 2, 3, 1000000007, 12], np.int64)
+        rows = c.pull_sparse(0, keys)
+        assert rows.shape == (6, 8)
+        # same key pulls the same lazily-initialized row from its shard
+        np.testing.assert_allclose(rows[1], c.pull_sparse(0, [1])[0])
+        c.push_sparse(0, keys, np.ones((6, 8), np.float32))
+        rows2 = c.pull_sparse(0, keys)
+        np.testing.assert_allclose(rows2, rows - 0.5, rtol=1e-6)
+        # rows landed across both shards
+        assert all(c._conns[s].call(
+            {"op": "table_size", "table_id": 0})["size"] > 0
+            for s in range(2))
+        # dense table
+        c.create_dense_table(1, shape=(3, 4), rule="sgd", lr=1.0)
+        c.set_dense(1, np.ones((3, 4), np.float32))
+        c.push_dense(1, np.full((3, 4), 0.25, np.float32))
+        np.testing.assert_allclose(c.pull_dense(1), 0.75)
+        # save/load roundtrip
+        pre = c.pull_sparse(0, keys)
+        c.save("/tmp/pt_ps_ckpt")
+        c.push_sparse(0, keys, np.ones((6, 8), np.float32))
+        c.load("/tmp/pt_ps_ckpt")
+        np.testing.assert_allclose(c.pull_sparse(0, keys), pre)
+        c.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_async_communicator_aggregates():
+    server = psmod.PsServer(port=0).start()
+    try:
+        c = psmod.PsClient([f"127.0.0.1:{server.port}"])
+        c.create_sparse_table(0, dim=4, rule="sgd", lr=1.0)
+        base = c.pull_sparse(0, [5])[0]
+        comm = psmod.AsyncCommunicator(c, send_interval_s=10.0)  # manual
+        comm.push_sparse(0, [5], np.ones((1, 4), np.float32))
+        comm.push_sparse(0, [5, 5], np.ones((2, 4), np.float32))
+        comm.flush()
+        np.testing.assert_allclose(c.pull_sparse(0, [5])[0], base - 3.0,
+                                   rtol=1e-6)
+        comm.stop()
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_role_maker_env():
+    from paddle_tpu.distributed.fleet import PaddleCloudRoleMaker
+
+    env = {"TRAINING_ROLE": "PSERVER",
+           "PADDLE_PSERVERS_IP_PORT_LIST": "10.0.0.1:8000,10.0.0.2:8000",
+           "PADDLE_TRAINERS_NUM": "4", "PADDLE_TRAINER_ID": "2",
+           "POD_IP": "10.0.0.2", "PADDLE_PORT": "8000"}
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rm = PaddleCloudRoleMaker(is_collective=False)
+        assert rm._is_server() and not rm._is_worker()
+        assert rm._server_num() == 2 and rm._worker_num() == 4
+        assert rm._server_endpoint() == "10.0.0.2:8000"
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
+def test_the_one_ps_end_to_end():
+    """Worker trains a DistributedEmbedding + dense head via fleet PS mode;
+    embedding rows live only on the servers and the loss decreases."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet import UserDefinedRoleMaker
+
+    # in-process "cluster": 2 server nodes as threads
+    servers = [psmod.PsServer(port=0).start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    try:
+        rm = UserDefinedRoleMaker(current_id=0, role="TRAINER",
+                                  worker_num=1, server_endpoints=eps)
+        fleet.init(rm)
+        assert fleet.is_worker() and not fleet.is_server()
+
+        paddle.seed(0)
+        emb = psmod.DistributedEmbedding(1 << 40, 16, rule="adagrad",
+                                         lr=0.3)
+        head = nn.Linear(16, 1)
+        fleet.init_worker()
+
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=head.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        from paddle_tpu.distributed.ps.the_one_ps import PSOptimizer
+
+        assert isinstance(opt, PSOptimizer)
+
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 1 << 30, size=(64,)).astype(np.int64)
+        y = rng.randn(64, 1).astype(np.float32)
+        loss_fn = nn.MSELoss()
+        losses = []
+        for _ in range(30):
+            xb = paddle.to_tensor(ids)
+            yb = paddle.to_tensor(y)
+            out = head(emb(xb))
+            loss = loss_fn(out, yb)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            psmod.get_runtime().communicator.flush()
+            losses.append(float(np.asarray(loss._value)))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+        # the table on the servers actually grew (rows live server-side)
+        rt = psmod.get_runtime()
+        assert rt.client.table_size(emb.table_id) == len(set(ids.tolist()))
+        fleet.stop_worker()
+    finally:
+        for s in servers:
+            s.stop()
