@@ -41,7 +41,8 @@ class HybridTrainer:
     def __init__(self, config, mesh: Mesh, learning_rate=3e-4,
                  weight_decay=0.1, beta1=0.9, beta2=0.95, eps=1e-8,
                  grad_clip_norm: Optional[float] = 1.0, seed: int = 0,
-                 remat: bool = True):
+                 remat: bool = True,
+                 pipeline_micro_batches: Optional[int] = None):
         self.config = config
         self.mesh = mesh
         self.lr = learning_rate
@@ -50,6 +51,20 @@ class HybridTrainer:
         self.eps = eps
         self.clip = grad_clip_norm
         self.remat = remat
+        # pp>1 + micro-batches => schedule-driven compiled pipeline
+        # (spmd_pipeline ring inside shard_map); otherwise the pp axis is a
+        # pure GSPMD layer-stack placement.
+        pp = mesh.shape.get("pp", 1)
+        self.n_micro = int(pipeline_micro_batches or 1)
+        self.pipelined = pp > 1 and self.n_micro > 1
+        if self.n_micro > 1 and pp <= 1:
+            raise ValueError(
+                f"pipeline_micro_batches={self.n_micro} requires a mesh "
+                f"with a 'pp' axis of size > 1 (got pp={pp})")
+        if self.pipelined and config.num_hidden_layers % pp != 0:
+            raise ValueError(
+                f"num_hidden_layers={config.num_hidden_layers} must divide "
+                f"evenly over pp={pp} for the compiled pipeline")
 
         specs = llama_mod.param_specs(config)
         self.param_shardings = jax.tree.map(
@@ -81,12 +96,19 @@ class HybridTrainer:
         wd = self.wd
         clip = self.clip
         remat = self.remat
-        batch_sharding = NamedSharding(self.mesh, data_spec())
+        mesh = self.mesh
+        pipelined = self.pipelined
+        spec = llama_mod.microbatch_spec() if pipelined else data_spec()
+        batch_sharding = NamedSharding(self.mesh, spec)
 
         def train_step(params, opt_state, input_ids, labels, lr, t):
-            loss, grads = jax.value_and_grad(
-                lambda p: llama_mod.loss_fn_stacked(
-                    p, (input_ids, labels), cfg, remat=remat))(params)
+            if pipelined:
+                loss_of = lambda p: llama_mod.loss_fn_pipelined(  # noqa: E731
+                    p, (input_ids, labels), cfg, mesh, remat=remat)
+            else:
+                loss_of = lambda p: llama_mod.loss_fn_stacked(  # noqa: E731
+                    p, (input_ids, labels), cfg, remat=remat)
+            loss, grads = jax.value_and_grad(loss_of)(params)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             if clip is not None:
                 gnorm = jnp.sqrt(sum(
@@ -127,9 +149,21 @@ class HybridTrainer:
         )
 
     def place_batch(self, input_ids, labels):
-        sharding = NamedSharding(self.mesh, data_spec())
-        return (jax.device_put(jnp.asarray(input_ids), sharding),
-                jax.device_put(jnp.asarray(labels), sharding))
+        ids, labs = jnp.asarray(input_ids), jnp.asarray(labels)
+        if self.pipelined:
+            b = ids.shape[0]
+            if b % self.n_micro != 0:
+                raise ValueError(
+                    f"batch {b} not divisible by "
+                    f"pipeline_micro_batches={self.n_micro}")
+            mb = b // self.n_micro
+            ids = ids.reshape((self.n_micro, mb) + ids.shape[1:])
+            labs = labs.reshape((self.n_micro, mb) + labs.shape[1:])
+            sharding = NamedSharding(self.mesh, llama_mod.microbatch_spec())
+        else:
+            sharding = NamedSharding(self.mesh, data_spec())
+        return (jax.device_put(ids, sharding),
+                jax.device_put(labs, sharding))
 
     def step(self, input_ids, labels):
         ids, labs = self.place_batch(input_ids, labels)
@@ -142,6 +176,13 @@ class HybridTrainer:
 
     def lower_text(self, batch_shape):
         """Compiled HLO text (for inspection/debugging of sharding)."""
+        if self.pipelined and len(batch_shape) == 2:
+            b, s = batch_shape
+            if b % self.n_micro != 0:
+                raise ValueError(
+                    f"batch {b} not divisible by "
+                    f"pipeline_micro_batches={self.n_micro}")
+            batch_shape = (self.n_micro, b // self.n_micro, s)
         ids = jnp.zeros(batch_shape, jnp.int32)
         return self._compiled.lower(
             self.params, self.opt_state, ids, ids,

@@ -38,8 +38,9 @@ from ..ops.pallas import flash_attention as fa
 from ..ops.pallas import rms_norm as rn
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel",
-           "forward_stacked", "loss_fn_stacked", "init_stacked_params",
-           "param_specs", "LLAMA_PRESETS"]
+           "forward_stacked", "loss_fn_stacked", "loss_fn_pipelined",
+           "init_stacked_params", "param_specs", "microbatch_spec",
+           "LLAMA_PRESETS"]
 
 
 @dataclass
@@ -434,11 +435,86 @@ def forward_stacked(params, input_ids, config: LlamaConfig,
     return logits
 
 
-def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True):
-    """Next-token LM loss; batch = (input_ids[B,S], labels[B,S])."""
-    input_ids, labels = batch
-    logits = forward_stacked(params, input_ids, config, remat=remat)
+def _head_loss(params, h, labels, config: LlamaConfig):
+    """Shared tail of both training paths: final norm -> LM head ->
+    mean next-token NLL. h: [..., S, H], labels: [..., S]."""
+    h = rn.rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    logits = h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(
         logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
     return -jnp.mean(picked)
+
+
+def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True):
+    """Next-token LM loss; batch = (input_ids[B,S], labels[B,S])."""
+    input_ids, labels = batch
+    x = jnp.take(params["embed"], input_ids, axis=0)
+    if config.dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
+
+    def body(carry, layer_params):
+        return _block(layer_params, carry, config), None
+
+    body_fn = jax.checkpoint(body) if remat else body
+    x, _ = jax.lax.scan(body_fn, x, params["blocks"])
+    return _head_loss(params, x, labels, config)
+
+
+def microbatch_spec():
+    """Sharding of a micro-batched tensor [n_micro, mb, S]: micro axis
+    replicated (it is the pipeline's time axis), batch over the data axes,
+    sequence over 'sep'."""
+    return P(None, ("dp", "sharding"), "sep")
+
+
+def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
+                      remat: bool = True):
+    """Schedule-driven compiled pipeline loss over the 'pp' mesh axis.
+
+    Reference analog: PipelineParallel.forward_backward_pipeline (1F1B,
+    fleet/meta_parallel/pipeline_parallel.py:459) + the static pipeline
+    scheduler passes. TPU-native shape: the trunk runs inside shard_map
+    manual over 'pp' ONLY (dp/sharding/sep/mp stay GSPMD-auto), as a
+    collective-permute micro-batch ring (spmd_pipeline): each of the
+    n_micro + P - 1 ticks computes this stage's layer slice on its current
+    micro-batch and ppermutes the activation one hop forward over ICI.
+    jax.grad transposes the scan+ppermute into the reverse pipeline, so
+    backward is an equally real schedule (GPipe ordering; bubble
+    2(P-1)/(2M+2(P-1))). Embedding and the LM head run under plain GSPMD
+    outside the ring (they are not layer-striped in the reference either).
+
+    batch = (input_ids[n_micro, mb, S], labels[n_micro, mb, S]).
+    Requires num_hidden_layers % pp == 0.
+    """
+    from ..distributed.meta_parallel.pipeline_parallel import spmd_pipeline
+
+    input_ids, labels = batch
+    n_micro = input_ids.shape[0]
+    x = jnp.take(params["embed"], input_ids, axis=0)  # [NM, mb, S, H]
+    if config.dtype == "bfloat16":
+        x = x.astype(jnp.bfloat16)
+
+    def stage_fn(stage_blocks, h):
+        def body(c, bp):
+            return _block(bp, c, config), None
+
+        body_fn = jax.checkpoint(body) if remat else body
+        y, _ = jax.lax.scan(body_fn, h, stage_blocks)
+        return y
+
+    def ring(stage_blocks, xm):
+        p = jax.lax.axis_size("pp")
+        stage = jax.lax.axis_index("pp")
+        ys = spmd_pipeline(stage_fn, stage_blocks, xm, n_micro,
+                           axis_name="pp")
+        # replicate the last stage's finished micro-batches across 'pp' so
+        # the head/loss run under plain GSPMD afterwards
+        return jax.lax.psum(
+            jnp.where(stage == p - 1, ys, jnp.zeros_like(ys)), "pp")
+
+    block_specs = jax.tree.map(lambda _: P("pp"), params["blocks"])
+    ys = jax.shard_map(
+        ring, mesh=mesh, in_specs=(block_specs, P()), out_specs=P(),
+        axis_names={"pp"}, check_vma=False)(params["blocks"], x)
+    return _head_loss(params, ys, labels, config)
