@@ -14,7 +14,6 @@ clients and the embedding layer agree on the layout.
 """
 from __future__ import annotations
 
-import pickle
 import queue
 import socket
 import threading
@@ -24,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..store import _recv_msg, _send_msg
+from .wire import decode_msg, encode_msg
 
 __all__ = ["PsClient", "AsyncCommunicator"]
 
@@ -37,9 +37,9 @@ class _Conn:
 
     def call(self, req: dict) -> dict:
         with self.lock:
-            _send_msg(self.sock, pickle.dumps(req))
-            (payload,) = _recv_msg(self.sock)
-        resp = pickle.loads(payload)
+            _send_msg(self.sock, *encode_msg(req))
+            parts = _recv_msg(self.sock)
+        resp = decode_msg(parts)
         if isinstance(resp, dict) and "err" in resp:
             raise RuntimeError(f"ps server error: {resp['err']}")
         return resp
@@ -144,13 +144,12 @@ class PsClient:
         # to the same server-side key (fresh counter per generation)
         seq = self._barrier_seq.get(name, 0) + 1
         self._barrier_seq[name] = seq
-        wire = f"{name}#{seq}"
-        self._conns[0].call({"op": "barrier", "name": wire, "world": world,
-                             "arrive": True})
+        self._conns[0].call({"op": "barrier", "name": name, "gen": seq,
+                             "world": world, "arrive": True})
         t0 = time.time()
         while True:
-            if self._conns[0].call({"op": "barrier", "name": wire,
-                                    "world": world})["done"]:
+            if self._conns[0].call({"op": "barrier", "name": name,
+                                    "gen": seq, "world": world})["done"]:
                 return
             if time.time() - t0 > timeout:
                 raise TimeoutError(f"ps barrier {name!r} timed out")

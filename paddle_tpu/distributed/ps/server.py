@@ -5,13 +5,17 @@ brpc_ps_server.h) — a PS node hosting table shards and serving
 pull/push/save/load RPCs from trainer clients.
 
 TPU-native: brpc is replaced by the framework's TCP message framing (the
-TCPStore/rpc layer); the protocol is pickle messages
-(op, table_id, payload). One server == one shard; clients route sparse keys
-by ``key % num_servers`` (the reference's hash routing in BrpcPsClient).
+TCPStore/rpc layer); the protocol is safe JSON+ndarray messages
+(op, table_id, payload — see wire.py), matching the reference's use of
+non-executable protobuf payloads. One server == one shard; clients route
+sparse keys by ``key % num_servers`` (the reference's hash routing in
+BrpcPsClient). The listener binds to the advertised pod IP
+(POD_IP / PADDLE_LOCAL_IP) rather than all interfaces unless the caller
+asks for 0.0.0.0 explicitly.
 """
 from __future__ import annotations
 
-import pickle
+import os
 import socket
 import threading
 from typing import Dict, Optional
@@ -20,14 +24,23 @@ import numpy as np
 
 from ..store import _recv_msg, _send_msg
 from .table import DenseTable, SparseTable
+from .wire import decode_msg, dump_obj, encode_msg, load_obj
 
-__all__ = ["PsServer"]
+__all__ = ["PsServer", "default_bind_host"]
+
+
+def default_bind_host() -> str:
+    """Bind address for PS/RPC listeners: the pod's advertised IP when the
+    launcher set one, else loopback — never 0.0.0.0 implicitly."""
+    return os.environ.get("POD_IP") or os.environ.get("PADDLE_LOCAL_IP") \
+        or "127.0.0.1"
 
 
 class PsServer:
     """Hosts this shard's tables and serves client RPCs on a TCP port."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, host: str = "", port: int = 0):
+        host = host or default_bind_host()
         self._sock = socket.socket()
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -37,7 +50,10 @@ class PsServer:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._barriers: Dict[str, int] = {}
+        # name -> [generation, arrival_count]; only the latest generation
+        # per name is kept (clients hit barriers in program order, so an
+        # arrival at gen k proves every gen < k completed) — bounded memory
+        self._barriers: Dict[str, list] = {}
 
     # -- lifecycle --------------------------------------------------------
     def start(self):
@@ -74,13 +90,14 @@ class PsServer:
     def _serve(self, conn):
         try:
             while True:
-                (payload,) = _recv_msg(conn)
-                req = pickle.loads(payload)
+                parts = _recv_msg(conn)
                 try:
+                    req = decode_msg(parts)
                     resp = self._handle(req)
                 except Exception as e:      # fault isolation per request
+                    req = {}
                     resp = {"err": f"{type(e).__name__}: {e}"}
-                _send_msg(conn, pickle.dumps(resp))
+                _send_msg(conn, *encode_msg(resp))
                 if req.get("op") == "stop":
                     break
         except (ConnectionError, EOFError, OSError):
@@ -116,24 +133,31 @@ class PsServer:
             return {"size": self._tables[req["table_id"]].size()}
         if op == "save":
             state = {tid: t.state() for tid, t in self._tables.items()}
-            with open(req["path"], "wb") as f:
-                pickle.dump(state, f)
+            dump_obj(state, req["path"])
             return {"ok": True}
         if op == "load":
-            with open(req["path"], "rb") as f:
-                state = pickle.load(f)
+            state = load_obj(req["path"])
             for tid, st in state.items():
                 if tid in self._tables:
                     self._tables[tid].load_state(st)
             return {"ok": True}
         if op == "barrier":
-            # counting barrier: nth arrival of `name` releases when count
-            # reaches world; clients poll
+            # counting barrier: nth arrival of (name, gen) releases when
+            # count reaches world; clients poll. A poll/arrival for an
+            # older generation than the stored one answers done=True (its
+            # caller could only have advanced past it), so only one entry
+            # per name ever lives on the server.
             name, world = req["name"], req["world"]
+            gen = int(req.get("gen", 0))
             with self._lock:
+                cur = self._barriers.get(name)
+                if cur is None or gen > cur[0]:
+                    cur = self._barriers[name] = [gen, 0]
+                if gen < cur[0]:
+                    return {"done": True}
                 if req.get("arrive"):
-                    self._barriers[name] = self._barriers.get(name, 0) + 1
-                done = self._barriers.get(name, 0) >= world
+                    cur[1] += 1
+                done = cur[1] >= world
             return {"done": done}
         if op == "stop":
             self._stop.set()

@@ -49,7 +49,17 @@ class TheOnePs:
     def init_server(self, *args, **kwargs):
         ep = self.role._server_endpoint()
         host, port = ep.rsplit(":", 1)
-        self.server = PsServer("0.0.0.0", int(port))
+        # bind the advertised endpoint host, not all interfaces; NATed /
+        # port-mapped deployments where that host is not a local interface
+        # fall back to 0.0.0.0 (trusted-network assumption, logged)
+        try:
+            self.server = PsServer(host, int(port))
+        except OSError:
+            import warnings
+            warnings.warn(
+                f"PS endpoint host {host!r} is not a local interface; "
+                "binding 0.0.0.0 — ensure the network is trusted")
+            self.server = PsServer("0.0.0.0", int(port))
         self.server.start()
 
     def run_server(self):
@@ -145,13 +155,18 @@ class DistributedEmbedding(Layer):
         if self._rt is None or self._rt.client is None:
             raise RuntimeError(
                 "DistributedEmbedding used before fleet.init_worker()")
+        from ...core.autograd import is_grad_enabled
+
         ids_np = np.asarray(ids._value).astype(np.int64)
         shape = ids_np.shape
         uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
         rows_np = self._rt.client.pull_sparse(self.table_id, uniq)
         rows = paddle.to_tensor(rows_np)
-        rows.stop_gradient = False
-        self._pulled.append((rows, uniq))
+        if is_grad_enabled():
+            # track only when a backward can produce row grads — eval /
+            # inference forwards would otherwise pin every pulled row
+            rows.stop_gradient = False
+            self._pulled.append((rows, uniq))
         inv_t = paddle.to_tensor(inv.astype(np.int64).reshape(-1))
         out = gather(rows, inv_t, axis=0)
         return reshape(out, list(shape) + [self.embedding_dim])

@@ -72,18 +72,23 @@ class CommTask:
         """Non-blocking completion check; updates and returns ``done``."""
         if self.done:
             return True
-        if self._arr is not None:
-            arr = self._arr()
-            if arr is None:
-                # output released by the program -> it was dispatched and
-                # consumed; nothing left to watch
-                self.done = True
-            else:
-                try:
-                    if arr.is_ready():
-                        self.done = True
-                except Exception:
-                    pass
+        if self._arr is None:
+            # attach() not (yet) called — stays pending; start_task marks
+            # it done when a later collective is issued on the same group
+            # (per-group dispatch order), so an attach() that failed or was
+            # skipped cannot dump forever on an active group
+            return False
+        arr = self._arr()
+        if arr is None:
+            # output released by the program -> it was dispatched and
+            # consumed; nothing left to watch
+            self.done = True
+        else:
+            try:
+                if arr.is_ready():
+                    self.done = True
+            except Exception:
+                pass
         return self.done
 
     def elapsed(self) -> float:
@@ -163,6 +168,13 @@ class CommTaskManager:
         task = CommTask(op_name, group_id, group_ranks, seq, rank,
                         shape=shape, dtype=dtype)
         with self._lock:
+            # dispatch on a group is ordered: starting a new task proves
+            # every earlier un-attached dispatch on the same group returned
+            # (its attach() failed or was skipped) — retire those instead
+            # of letting them dump a guaranteed-false timeout
+            for t in self._tasks:
+                if t.group_id == group_id and t._arr is None:
+                    t.mark_done()
             self._tasks.append(task)
         return task
 

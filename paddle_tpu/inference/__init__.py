@@ -191,7 +191,22 @@ def save_inference_model(path_prefix: str, layer, input_spec,
                      if jnp.issubdtype(o.dtype, jnp.floating) else o
                      for o in outs)
 
-    args = [jnp.zeros(tuple(s.shape), s.dtype) for s in input_spec]
+    # InputSpec dims of None export as symbolic dims (dynamic batch — the
+    # reference's save_inference_model default); static specs export as
+    # concrete zeros
+    if any(d is None for s in input_spec for d in tuple(s.shape)):
+        # None dims at the same axis position share one symbol (d0, d1, …)
+        # so inputs with a common dynamic batch dim stay shape-compatible
+        # under export — the reference's dynamic-batch convention
+        scope = jexport.SymbolicScope()
+        args = []
+        for s in input_spec:
+            spec = ",".join(f"d{j}" if d is None else str(d)
+                            for j, d in enumerate(tuple(s.shape)))
+            shp = jexport.symbolic_shape(spec, scope=scope)
+            args.append(jax.ShapeDtypeStruct(shp, s.dtype))
+    else:
+        args = [jnp.zeros(tuple(s.shape), s.dtype) for s in input_spec]
     exported = jexport.export(jax.jit(pure))(flat_p, flat_b, *args)
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exported.serialize())

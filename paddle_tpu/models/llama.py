@@ -415,21 +415,26 @@ def _block(params, x, config: LlamaConfig):
     return x
 
 
-def forward_stacked(params, input_ids, config: LlamaConfig,
-                    remat: bool = True):
-    """Whole-model forward: embedding -> lax.scan over stacked blocks ->
-    final norm -> logits. The TPU-native trunk (constant compile time in
-    depth; blocks rematerialized in backward when remat=True)."""
+def _trunk(params, input_ids, config: LlamaConfig, remat: bool = True):
+    """Embedding -> lax.scan over stacked blocks (constant compile time in
+    depth; blocks rematerialized in backward when remat=True). The single
+    source of the trunk pattern for the stacked forward/loss paths."""
     x = jnp.take(params["embed"], input_ids, axis=0)
     if config.dtype == "bfloat16":
         x = x.astype(jnp.bfloat16)
 
     def body(carry, layer_params):
-        out = _block(layer_params, carry, config)
-        return out, None
+        return _block(layer_params, carry, config), None
 
     body_fn = jax.checkpoint(body) if remat else body
     x, _ = jax.lax.scan(body_fn, x, params["blocks"])
+    return x
+
+
+def forward_stacked(params, input_ids, config: LlamaConfig,
+                    remat: bool = True):
+    """Whole-model forward: trunk -> final norm -> logits."""
+    x = _trunk(params, input_ids, config, remat)
     x = rn.rms_norm(x, params["final_norm"], config.rms_norm_eps)
     logits = x.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
     return logits
@@ -449,15 +454,7 @@ def _head_loss(params, h, labels, config: LlamaConfig):
 def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True):
     """Next-token LM loss; batch = (input_ids[B,S], labels[B,S])."""
     input_ids, labels = batch
-    x = jnp.take(params["embed"], input_ids, axis=0)
-    if config.dtype == "bfloat16":
-        x = x.astype(jnp.bfloat16)
-
-    def body(carry, layer_params):
-        return _block(layer_params, carry, config), None
-
-    body_fn = jax.checkpoint(body) if remat else body
-    x, _ = jax.lax.scan(body_fn, x, params["blocks"])
+    x = _trunk(params, input_ids, config, remat)
     return _head_loss(params, x, labels, config)
 
 

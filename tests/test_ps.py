@@ -160,3 +160,33 @@ def test_the_one_ps_end_to_end():
     finally:
         for s in servers:
             s.stop()
+
+
+def test_wire_codec_roundtrip():
+    """PS wire codec: JSON header + raw ndarray parts (no pickle on the
+    wire — reference uses protobuf, sendrecv.proto)."""
+    import numpy as np
+    from paddle_tpu.distributed.ps.wire import (decode_msg, dump_obj,
+                                                encode_msg, load_obj)
+
+    msg = {"op": "push_sparse", "table_id": 3,
+           "keys": np.arange(5, dtype=np.int64),
+           "grads": np.random.randn(5, 8).astype(np.float32),
+           "nested": {"rows": {7: np.ones(4, np.float32)},
+                      "flag": True, "none": None, "lst": [1, 2.5, "x"]}}
+    out = decode_msg(encode_msg(msg))
+    assert out["op"] == "push_sparse" and out["table_id"] == 3
+    np.testing.assert_array_equal(out["keys"], msg["keys"])
+    np.testing.assert_array_equal(out["grads"], msg["grads"])
+    assert out["nested"]["flag"] is True and out["nested"]["none"] is None
+    assert list(out["nested"]["rows"].keys()) == [7]
+
+    # file framing used by table save/load (replaces pickle.dump)
+    dump_obj(msg, "/tmp/pt_wire_obj.bin")
+    back = load_obj("/tmp/pt_wire_obj.bin")
+    np.testing.assert_array_equal(back["grads"], msg["grads"])
+
+    # non-wire-safe payloads refuse to encode
+    import pytest
+    with pytest.raises(TypeError):
+        encode_msg({"fn": lambda: 1})
