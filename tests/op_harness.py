@@ -1,0 +1,122 @@
+"""OpTest harness — the analog of reference test/legacy_test/op_test.py:418.
+
+Each OpCase names a registered op (paddle_tpu.ops.registry), supplies input
+factories, an optional NumPy reference for the forward, and tolerance knobs.
+`run_case` checks:
+  1. forward vs the NumPy reference (when given) in fp32;
+  2. numeric-vs-analytic reverse-mode gradients via jax.test_util.check_grads
+     (the analog of op_test.py:3026 check_grad) for differentiable ops;
+  3. a bf16 forward smoke run (finite outputs) for float ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.test_util import check_grads
+
+from paddle_tpu.ops import registry
+
+
+class OpCase:
+    def __init__(self, name, args, kwargs=None, ref=None, rtol=1e-5,
+                 atol=1e-5, grad_args=None, no_grad=False, grad_rtol=2e-2,
+                 grad_eps=1e-3, bf16=True, out_select=None):
+        """
+        name       registered op name (must exist in the registry)
+        args       tuple of concrete inputs (np/jnp arrays or scalars)
+        kwargs     static keyword attrs
+        ref        optional fn(*args, **kwargs) -> numpy expected output(s)
+        grad_args  indices of args to differentiate (default: all float
+                   array args)
+        no_grad    skip the grad check even if the op is differentiable
+                   (e.g. non-smooth at the sampled points)
+        out_select fn(out) -> array(s) used for grad check (for ops whose
+                   outputs mix float and int, e.g. max_pool_with_index)
+        """
+        self.name = name
+        self.args = tuple(args)
+        self.kwargs = dict(kwargs or {})
+        self.ref = ref
+        self.rtol, self.atol = rtol, atol
+        self.grad_args = grad_args
+        self.no_grad = no_grad
+        self.grad_rtol = grad_rtol
+        self.grad_eps = grad_eps
+        self.bf16 = bf16
+        self.out_select = out_select
+
+    def __repr__(self):
+        return f"OpCase({self.name})"
+
+
+def _is_float_array(a):
+    return hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+
+
+def _flatten_outs(out):
+    return [np.asarray(o) for o in jax.tree_util.tree_leaves(out)]
+
+
+def run_case(case: OpCase):
+    info = registry.get(case.name)
+    assert info is not None, f"op {case.name!r} not registered"
+    fn = info.fn
+
+    args = tuple(jnp.asarray(a) if isinstance(a, np.ndarray) else a
+                 for a in case.args)
+    out = fn(*args, **case.kwargs)
+
+    # 1. forward vs numpy reference
+    if case.ref is not None:
+        expect = case.ref(*[np.asarray(a) if hasattr(a, "shape") else a
+                            for a in case.args], **case.kwargs)
+        got = _flatten_outs(out)
+        want = _flatten_outs(expect)
+        assert len(got) == len(want), \
+            f"{case.name}: {len(got)} outputs vs ref {len(want)}"
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(
+                g.astype(np.float64) if g.dtype.kind == "f" else g,
+                w.astype(np.float64) if w.dtype.kind == "f" else w,
+                rtol=case.rtol, atol=case.atol,
+                err_msg=f"op {case.name} forward mismatch")
+
+    # 2. numeric-vs-analytic gradient (reverse mode)
+    if info.differentiable and not case.no_grad:
+        if case.grad_args is None:
+            gidx = [i for i, a in enumerate(args) if _is_float_array(a)]
+        else:
+            gidx = list(case.grad_args)
+        if gidx:
+            prims = [args[i] for i in gidx]
+
+            def g(*diff):
+                full = list(args)
+                for i, d in zip(gidx, diff):
+                    full[i] = d
+                o = fn(*full, **case.kwargs)
+                if case.out_select is not None:
+                    o = case.out_select(o)
+                leaves = [l for l in jax.tree_util.tree_leaves(o)
+                          if _is_float_array(l)]
+                return leaves
+
+            check_grads(g, prims, order=1, modes=["rev"],
+                        rtol=case.grad_rtol, atol=case.grad_rtol,
+                        eps=case.grad_eps)
+
+    # 3. bf16 smoke
+    if case.bf16 and any(_is_float_array(a) for a in args):
+        bargs = tuple(a.astype(jnp.bfloat16)
+                      if _is_float_array(a) and
+                      np.asarray(a).dtype == np.float32 else a
+                      for a in args)
+        try:
+            bout = fn(*bargs, **case.kwargs)
+        except (TypeError, ValueError):
+            return      # op constrains dtypes; fp32 path already checked
+        for o in _flatten_outs(bout):
+            if o.dtype.kind == "f":
+                assert np.isfinite(o.astype(np.float32)).all(), \
+                    f"op {case.name} bf16 produced non-finite values"

@@ -1,0 +1,257 @@
+"""OpTest cases for the nn yaml op surface (paddle_tpu/ops/nn_compat.py).
+
+Forward checks against NumPy references; gradients checked
+numeric-vs-analytic by the harness (reference op_test.py:3026 pattern).
+"""
+import math
+
+import numpy as np
+import pytest
+
+from op_harness import OpCase, run_case
+
+R = np.random.RandomState(11)
+
+
+def _x(*s):
+    return R.randn(*s).astype(np.float32)
+
+
+def _p(*s):
+    return (R.rand(*s).astype(np.float32) + 0.05)
+
+
+X = _x(2, 3, 8, 8)
+X2 = _x(4, 6)
+
+
+def np_softmax(a, axis=-1):
+    e = np.exp(a - a.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+ACT_CASES = [
+    OpCase("relu", (X2,), ref=lambda a: np.maximum(a, 0), no_grad=True),
+    OpCase("relu6", (X2,), ref=lambda a: np.clip(a, 0, 6), no_grad=True),
+    OpCase("silu", (X2,), ref=lambda a: a / (1 + np.exp(-a))),
+    OpCase("gelu", (X2,)),
+    OpCase("elu", (X2,)),
+    OpCase("celu", (X2,)),
+    OpCase("selu", (X2,)),
+    OpCase("leaky_relu", (X2,), no_grad=True),
+    OpCase("hardshrink", (X2,), no_grad=True),
+    OpCase("hardsigmoid", (X2,), no_grad=True),
+    OpCase("hardtanh", (X2,), no_grad=True),
+    OpCase("logsigmoid", (X2,),
+           ref=lambda a: -np.log1p(np.exp(-np.abs(a)))
+           + np.minimum(a, 0)),
+    OpCase("mish", (X2,),
+           ref=lambda a: a * np.tanh(np.log1p(np.exp(np.minimum(a, 20)))
+                                     + np.maximum(a - 20, 0) * 0),
+           rtol=1e-4, atol=1e-4),
+    OpCase("softplus", (X2,), ref=lambda a: np.log1p(np.exp(-np.abs(a)))
+           + np.maximum(a, 0), rtol=1e-4, atol=1e-5),
+    OpCase("softshrink", (X2,), no_grad=True),
+    OpCase("softsign", (X2,), ref=lambda a: a / (1 + np.abs(a))),
+    OpCase("tanh_shrink", (X2,), ref=lambda a: a - np.tanh(a)),
+    OpCase("thresholded_relu", (X2,), no_grad=True),
+    OpCase("prelu", (X, np.full((3,), 0.25, np.float32)), no_grad=True),
+    OpCase("maxout", (_x(2, 6, 4, 4),), kwargs={"groups": 2},
+           no_grad=True),
+    OpCase("log_softmax", (X2,),
+           ref=lambda a: np.log(np_softmax(a))),
+    OpCase("rrelu", (X2,), no_grad=True),
+    OpCase("gumbel_softmax", (X2,), no_grad=True),
+    OpCase("swiglu", (_x(4, 8),),
+           ref=lambda a: (a[:, :4] / (1 + np.exp(-a[:, :4]))) * a[:, 4:]),
+]
+
+
+def ref_conv2d(x, w, *a, **k):
+    B, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    out = np.zeros((B, O, H - kh + 1, W - kw + 1), np.float32)
+    for i in range(out.shape[2]):
+        for j in range(out.shape[3]):
+            patch = x[:, :, i:i + kh, j:j + kw].reshape(B, -1)
+            out[:, :, i, j] = patch @ w.reshape(O, -1).T
+    return out
+
+
+CONV_POOL_CASES = [
+    OpCase("conv2d", (_x(2, 3, 6, 6), _x(4, 3, 3, 3)), ref=ref_conv2d,
+           rtol=1e-4, atol=1e-4),
+    OpCase("conv3d", (_x(1, 2, 5, 5, 5), _x(3, 2, 2, 2, 2)), rtol=1e-4),
+    OpCase("conv2d_transpose", (_x(1, 4, 5, 5), _x(4, 3, 3, 3))),
+    OpCase("depthwise_conv2d", (_x(1, 3, 6, 6), _x(3, 1, 3, 3))),
+    OpCase("depthwise_conv2d_transpose", (_x(1, 3, 5, 5),
+                                          _x(3, 1, 3, 3))),
+    OpCase("pool2d", (X, 2), kwargs={"pooling_type": "max"},
+           no_grad=True,
+           ref=lambda a, k, **kw: a.reshape(2, 3, 4, 2, 4, 2)
+           .max(axis=(3, 5))),
+    OpCase("pool2d", (X, 2), kwargs={"pooling_type": "avg"},
+           ref=lambda a, k, **kw: a.reshape(2, 3, 4, 2, 4, 2)
+           .mean(axis=(3, 5))),
+    OpCase("pool3d", (_x(1, 2, 4, 4, 4), 2),
+           kwargs={"pooling_type": "avg"},
+           ref=lambda a, k, **kw: a.reshape(1, 2, 2, 2, 2, 2, 2, 2)
+           .mean(axis=(3, 5, 7))),
+    OpCase("max_pool2d_with_index", (X, 2), no_grad=True,
+           out_select=lambda o: o[0]),
+    OpCase("max_pool3d_with_index", (_x(1, 2, 4, 4, 4), 2),
+           no_grad=True),
+    OpCase("lp_pool2d", (X, 2.0, 2)),
+    OpCase("fractional_max_pool2d", (X, 3), no_grad=True),
+    OpCase("fractional_max_pool3d", (_x(1, 2, 6, 6, 6), 2),
+           no_grad=True),
+    OpCase("fold", (_x(1, 3 * 4, 4), [4, 4], 2), kwargs={"strides": 2},
+           rtol=1e-4),
+]
+
+
+def ref_layer_norm(x, *a, **k):
+    m = x.reshape(x.shape[0], -1).mean(1)
+    v = x.reshape(x.shape[0], -1).var(1)
+    shape = (-1,) + (1,) * (x.ndim - 1)
+    return (x - m.reshape(shape)) / np.sqrt(v.reshape(shape) + 1e-5)
+
+
+NORM_CASES = [
+    OpCase("layer_norm", (X,), ref=ref_layer_norm, rtol=1e-4, atol=1e-4),
+    OpCase("rms_norm", (X2,),
+           ref=lambda a, **k: a / np.sqrt((a * a).mean(-1, keepdims=True)
+                                          + 1e-6), rtol=1e-4, atol=1e-4),
+    OpCase("group_norm", (X,), kwargs={"num_groups": 3}, rtol=1e-4),
+    OpCase("instance_norm", (X,), rtol=1e-4),
+    OpCase("spectral_norm", (_x(4, 6), _p(4), _p(6)), grad_args=[0],
+           grad_rtol=5e-2),
+    OpCase("sync_batch_norm_", (X, np.zeros(3, np.float32),
+                                np.ones(3, np.float32), _p(3), _x(3)),
+           no_grad=True),
+    OpCase("fused_batch_norm_act", (X, _p(3), _x(3),
+                                    np.zeros(3, np.float32),
+                                    np.ones(3, np.float32)),
+           no_grad=True),
+    OpCase("fused_bn_add_activation", (X, _x(2, 3, 8, 8), _p(3), _x(3),
+                                       np.zeros(3, np.float32),
+                                       np.ones(3, np.float32)),
+           no_grad=True),
+]
+
+LBL4 = R.randint(0, 6, (4,)).astype(np.int64)
+
+
+def ref_cews(logits, label, **k):
+    sm = np_softmax(logits)
+    logp = np.log(sm)
+    return sm, -logp[np.arange(len(label)), label][:, None]
+
+
+LOSS_CASES = [
+    OpCase("bce_loss", (_p(4, 3) * 0.9, (R.rand(4, 3) > 0.5)
+                        .astype(np.float32)),
+           ref=lambda p, l, **k: -(l * np.log(p)
+                                   + (1 - l) * np.log(1 - p)),
+           rtol=1e-4, atol=1e-4, grad_args=[0]),
+    OpCase("kldiv_loss", (np.log(_p(4, 3)), _p(4, 3)), grad_args=[0]),
+    OpCase("nll_loss", (np.log(np_softmax(_x(4, 6))), LBL4),
+           grad_args=[0]),
+    OpCase("log_loss", (_p(4, 1) * 0.9, (R.rand(4, 1) > 0.5)
+                        .astype(np.float32)), grad_args=[0]),
+    OpCase("huber_loss", (_x(4, 3), _x(4, 3)),
+           ref=lambda a, b, **k: (
+               np.where(np.abs(a - b) <= 1.0, 0.5 * (a - b) ** 2,
+                        np.abs(a - b) - 0.5), a - b), no_grad=True),
+    OpCase("sigmoid_cross_entropy_with_logits",
+           (_x(4, 3), (R.rand(4, 3) > 0.5).astype(np.float32)),
+           grad_args=[0], rtol=1e-4,
+           ref=lambda x, l, **k: np.maximum(x, 0) - x * l
+           + np.log1p(np.exp(-np.abs(x)))),
+    OpCase("cross_entropy_with_softmax", (_x(4, 6), LBL4),
+           ref=ref_cews, rtol=1e-4, atol=1e-4, grad_args=[0]),
+    OpCase("identity_loss", (_x(4, 3),), ref=lambda a, **k: a.mean()),
+    OpCase("hsigmoid_loss", (_x(4, 8), LBL4,
+                             _x(12, 8)),
+           kwargs={"num_classes": 6}, grad_args=[0, 2]),
+    OpCase("margin_cross_entropy",
+           (np.clip(_x(4, 6), -0.9, 0.9), LBL4), grad_args=[],
+           no_grad=True),
+    OpCase("label_smooth", (np.eye(4, 6, dtype=np.float32),),
+           ref=lambda l, **k: l * 0.9 + 0.1 / 6),
+    OpCase("warpctc", (np.log(np_softmax(_x(6, 2, 5))),
+                       R.randint(1, 5, (2, 3)).astype(np.int32),
+                       np.array([6, 6], np.int32),
+                       np.array([3, 3], np.int32)), no_grad=True),
+]
+
+INTERP_MISC_CASES = [
+    OpCase("nearest_interp", (X,), kwargs={"size": (16, 16)},
+           ref=lambda a, **k: a.repeat(2, 2).repeat(2, 3),
+           no_grad=True),
+    OpCase("bilinear_interp", (X,), kwargs={"size": (16, 16)}),
+    OpCase("bicubic_interp", (X,), kwargs={"size": (16, 16)},
+           grad_rtol=5e-2),
+    OpCase("linear_interp", (_x(2, 3, 8),), kwargs={"size": (16,)}),
+    OpCase("trilinear_interp", (_x(1, 2, 4, 4, 4),),
+           kwargs={"size": (8, 8, 8)}),
+    OpCase("affine_grid", (_x(2, 2, 3),), kwargs={"out_shape":
+                                                  [2, 3, 4, 4]}),
+    OpCase("grid_sample", (X, np.clip(_x(2, 4, 4, 2), -1, 1)),
+           no_grad=True),   # bilinear corner weights are non-smooth
+    OpCase("pixel_shuffle", (_x(1, 4, 3, 3), 2),
+           ref=lambda a, r, **k: a.reshape(1, 1, 2, 2, 3, 3)
+           .transpose(0, 1, 4, 2, 5, 3).reshape(1, 1, 6, 6)),
+    OpCase("pixel_unshuffle", (_x(1, 1, 6, 6), 2)),
+    OpCase("channel_shuffle", (_x(1, 4, 3, 3), 2)),
+    OpCase("shuffle_channel", (_x(1, 4, 3, 3), 2)),
+    OpCase("temporal_shift", (_x(4, 4, 3, 3), 2), no_grad=True,
+           bf16=False),
+    OpCase("sequence_mask", (np.array([1, 3, 2], np.int64), 4),
+           ref=lambda l, m, **k: (np.arange(m)[None, :]
+                                  < l[:, None]).astype(np.int64)),
+    OpCase("pad3d", (_x(1, 2, 3, 3, 3), [1, 1, 1, 1, 0, 0])),
+    OpCase("bilinear", (_x(3, 4), _x(3, 5), _x(2, 4, 5),
+                        _x(2)),
+           ref=lambda x, y, w, b, **k:
+           np.einsum("bi,kij,bj->bk", x, w, y) + b),
+    OpCase("fused_softmax_mask", (_x(2, 2, 4, 4),
+                                  np.zeros((2, 1, 4, 4), np.float32)),
+           ref=lambda x, m, **k: np_softmax(x + m), grad_args=[0]),
+    OpCase("fused_softmax_mask_upper_triangle", (_x(2, 2, 4, 4),),
+           no_grad=True),
+    OpCase("dropout", (X2,), kwargs={"training": False},
+           ref=lambda a, **k: (a, np.ones_like(a, np.uint8)),
+           no_grad=True),
+    OpCase("unpool", (np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2),
+                      np.array([[[[0, 3], [8, 11]],
+                                 [[0, 2], [9, 15]]]], np.int32), 2),
+           no_grad=True),
+    OpCase("unpool3d", (_x(1, 1, 2, 2, 2),
+                        R.randint(0, 63, (1, 1, 2, 2, 2))
+                        .astype(np.int32), 2), no_grad=True),
+]
+
+ALL = ACT_CASES + CONV_POOL_CASES + NORM_CASES + LOSS_CASES \
+    + INTERP_MISC_CASES
+
+
+@pytest.mark.parametrize(
+    "case", ALL, ids=lambda c: f"{c.name}-{ALL.index(c)}")
+def test_nn_op(case):
+    run_case(case)
+
+
+def test_max_pool_index_roundtrip():
+    """unpool(max_pool_with_index(x)) puts each max back in place."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.registry import get
+
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    v, i = get("max_pool2d_with_index").fn(jnp.asarray(x), 2)
+    np.testing.assert_array_equal(np.asarray(v).reshape(2, 2),
+                                  [[5, 7], [13, 15]])
+    up = get("unpool").fn(v, i, 2)
+    expect = np.zeros((1, 1, 4, 4), np.float32)
+    expect[0, 0, [1, 1, 3, 3], [1, 3, 1, 3]] = [5, 7, 13, 15]
+    np.testing.assert_array_equal(np.asarray(up), expect)

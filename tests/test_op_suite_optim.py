@@ -1,0 +1,148 @@
+"""OpTest cases for the optimizer-update + AMP op surface
+(paddle_tpu/ops/optim_ops.py; reference ops.yaml sgd_/adam_/... entries)."""
+import numpy as np
+import pytest
+
+from op_harness import OpCase, run_case
+
+R = np.random.RandomState(3)
+
+
+def _w(*s):
+    return R.randn(*s).astype(np.float32)
+
+
+def _pos(*s):
+    return (R.rand(*s).astype(np.float32) + 0.1)
+
+
+LR = np.asarray(0.1, np.float32)
+P, G = _w(4, 3), _w(4, 3)
+
+
+def ref_sgd(param, lr, grad, *a, **k):
+    return param - lr * grad, None
+
+
+def ref_momentum(param, grad, vel, lr, *a, **k):
+    v = 0.9 * vel + grad
+    return param - lr * v, v, None
+
+
+def ref_adam(param, grad, lr, m1, m2, b1p, b2p, *a, **k):
+    nm1 = 0.9 * m1 + 0.1 * grad
+    nm2 = 0.999 * m2 + 0.001 * grad * grad
+    # input pows are beta^t for the current step (reference AdamKernel)
+    step = lr * np.sqrt(1 - b2p) / (1 - b1p)
+    return (param - step * nm1 / (np.sqrt(nm2) + 1e-8),
+            nm1, nm2, b1p * 0.9, b2p * 0.999, None)
+
+
+def ref_adagrad(param, grad, mom, lr, *a, **k):
+    nm = mom + grad * grad
+    return param - lr * grad / (np.sqrt(nm) + 1e-6), nm, None
+
+
+CASES = [
+    OpCase("sgd_", (P, LR, G), ref=ref_sgd),
+    OpCase("momentum_", (P, G, _w(4, 3), LR), ref=ref_momentum),
+    OpCase("adam_", (P, G, LR, np.zeros((4, 3), np.float32),
+                     np.zeros((4, 3), np.float32),
+                     np.asarray(0.9, np.float32),
+                     np.asarray(0.999, np.float32)), ref=ref_adam),
+    OpCase("adamw_", (P, G, LR, np.zeros((4, 3), np.float32),
+                      np.zeros((4, 3), np.float32),
+                      np.asarray(0.9, np.float32),
+                      np.asarray(0.999, np.float32))),
+    OpCase("adagrad_", (P, G, _pos(4, 3), LR), ref=ref_adagrad),
+    OpCase("decayed_adagrad", (P, G, _pos(4, 3), LR)),
+    OpCase("adadelta_", (P, G, _pos(4, 3), _pos(4, 3), LR)),
+    OpCase("adamax_", (P, G, LR, np.zeros((4, 3), np.float32),
+                       _pos(4, 3), np.asarray(0.9, np.float32))),
+    OpCase("asgd_", (P, G, LR, _w(4, 3), _w(4, 3),
+                     np.asarray(4.0, np.float32))),
+    OpCase("rmsprop_", (P, _pos(4, 3), G, _w(4, 3), LR, _w(4, 3))),
+    OpCase("rprop_", (P, G, _w(4, 3), np.full((4, 3), 0.01, np.float32))),
+    OpCase("lamb_", (P, G, LR, np.zeros((4, 3), np.float32),
+                     np.zeros((4, 3), np.float32),
+                     np.asarray(0.9, np.float32),
+                     np.asarray(0.999, np.float32))),
+    OpCase("nadam_", (P, G, LR, np.asarray(0.96, np.float32),
+                      np.asarray(0.999, np.float32),
+                      np.asarray(0.9, np.float32),
+                      np.zeros((4, 3), np.float32),
+                      np.zeros((4, 3), np.float32))),
+    OpCase("radam_", (P, G, LR, np.asarray(0.9, np.float32),
+                      np.asarray(0.999, np.float32),
+                      np.asarray(0.0, np.float32),
+                      np.zeros((4, 3), np.float32),
+                      np.zeros((4, 3), np.float32))),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_optim_op(case):
+    run_case(case)
+
+
+def test_adam_matches_optimizer_class():
+    """The functional adam_ kernel and the Tensor-level Adam optimizer
+    apply the same math."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.optim_ops import adam_
+
+    w0 = _w(5)
+    g = _w(5)
+    p_out, *_ = adam_(jnp.asarray(w0), jnp.asarray(g),
+                      jnp.asarray(0.01, np.float32),
+                      jnp.zeros(5), jnp.zeros(5),
+                      jnp.asarray(0.9), jnp.asarray(0.999))
+
+    pt = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[pt])
+    pt.grad = paddle.to_tensor(g)
+    opt.step()
+    np.testing.assert_allclose(np.asarray(p_out), pt.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_merged_and_amp_ops():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.optim_ops import (check_finite_and_unscale_,
+                                          merged_adam_, merged_momentum_,
+                                          update_loss_scaling_)
+
+    ps = [jnp.asarray(_w(3)), jnp.asarray(_w(2, 2))]
+    gs = [jnp.asarray(_w(3)), jnp.asarray(_w(2, 2))]
+    vs = [jnp.zeros(3), jnp.zeros((2, 2))]
+    lrs = [jnp.asarray(0.1), jnp.asarray(0.1)]
+    pout, vout, _ = merged_momentum_(ps, gs, vs, lrs)
+    assert len(pout) == 2 and pout[0].shape == (3,)
+
+    m1 = [jnp.zeros(3), jnp.zeros((2, 2))]
+    m2 = [jnp.zeros(3), jnp.zeros((2, 2))]
+    b1 = [jnp.asarray(0.9)] * 2
+    b2 = [jnp.asarray(0.999)] * 2
+    outs = merged_adam_(ps, gs, lrs, m1, m2, b1, b2)
+    assert len(outs[0]) == 2
+
+    # AMP: unscale + found_inf
+    xs = [jnp.asarray([2.0, 4.0]), jnp.asarray([jnp.inf, 1.0])]
+    outs, found = check_finite_and_unscale_(xs, jnp.asarray(2.0))
+    np.testing.assert_allclose(np.asarray(outs[0]), [1.0, 2.0])
+    assert bool(found)
+
+    # loss scaling schedule: shrink on inf, grow after n good steps
+    scale, good, bad = (jnp.asarray(1024.0), jnp.asarray(0, np.int32),
+                        jnp.asarray(0, np.int32))
+    _, scale2, good2, bad2 = update_loss_scaling_(
+        xs, jnp.asarray(True), scale, good, bad,
+        incr_every_n_steps=2, decr_every_n_nan_or_inf=1,
+        incr_ratio=2.0, decr_ratio=0.5)
+    assert float(scale2) == 512.0 and int(bad2) == 0
+    _, scale3, good3, _ = update_loss_scaling_(
+        xs, jnp.asarray(False), scale2, good2, bad2,
+        incr_every_n_steps=1, decr_every_n_nan_or_inf=1,
+        incr_ratio=2.0, decr_ratio=0.5)
+    assert float(scale3) == 1024.0 and int(good3) == 0
