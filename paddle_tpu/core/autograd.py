@@ -124,9 +124,11 @@ class GradNode:
         "cotangents",
         "released",
         "outputs",
+        "primal_fn",
     )
 
-    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals,
+                 primal_fn=None):
         self.id = next(_node_counter)
         self.name = name
         self.vjp_fn = vjp_fn
@@ -139,6 +141,13 @@ class GradNode:
         self.cotangents: List[Optional[jax.Array]] = [None] * self.n_outputs
         self.released = False
         self.outputs: List = [None] * self.n_outputs
+        # Pure function of the differentiable inputs (primal positions only),
+        # kept so create_graph=True can re-derive the pullback AS A RECORDED
+        # OP — jax.vjp of primal_fn w.r.t. (cotangent, primals) gives the
+        # second-order terms the frozen vjp_fn closure cannot (it treats the
+        # primals as constants). Reference analog: double_grad nodes emitted
+        # by eager_gen (backward.cc:105 general_grad).
+        self.primal_fn = primal_fn
 
     def set_output(self, index, tensor):
         import weakref
@@ -159,6 +168,7 @@ class GradNode:
 
     def release(self):
         self.vjp_fn = None
+        self.primal_fn = None
         self.inputs = ()
         self.cotangents = [None] * self.n_outputs
         self.released = True
@@ -172,14 +182,20 @@ def _ones_like_aval(t):
 
 
 def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
-                target_set=None):
+                target_set=None, create_graph=False):
     """Core reverse sweep. Returns dict id(tensor)->cotangent for tensors in
     target_set (when provided); otherwise accumulates into leaf .grad.
 
     Routing uses the producer links captured at record time (GradNode.inputs
     triples), never the tensor's current _grad_node — so in-place rebinding
     can't corrupt the graph. Leaf contributions are buffered and hooks fire
-    ONCE on the fully-accumulated gradient."""
+    ONCE on the fully-accumulated gradient.
+
+    create_graph=True: each node's pullback is re-derived from its primal_fn
+    and executed THROUGH THE DISPATCHER as a `grad::<op>` op whose inputs are
+    the cotangent tensors plus the node's primal inputs — so the backward
+    sweep itself lands on the tape and is differentiable again (double
+    backward). Cotangents routed in this mode are Tensors, not raw arrays."""
     heap = []  # max-heap on node id via negation
     in_heap = set()
     captured = {} if target_set is not None else None
@@ -225,12 +241,15 @@ def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
                     prev = captured.get(id(out_t))
                     captured[id(out_t)] = cot if prev is None else prev + cot
                 for hook in out_t._hooks:
-                    new = hook(_wrap(cot))
+                    new = hook(_as_hook_arg(cot))
                     if new is not None:
-                        cot = _unwrap(new)
+                        cot = new if create_graph else _unwrap(new)
                 node.cotangents[i] = cot
         cot_tree = node.materialize_cotangents()
-        input_cots = node.vjp_fn(cot_tree)
+        if create_graph:
+            input_cots = _apply_pullback_recorded(node, cot_tree)
+        else:
+            input_cots = node.vjp_fn(cot_tree)
         inputs = node.inputs
         if not retain_graph:
             node.release()
@@ -247,12 +266,46 @@ def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
             prev = captured.get(id(tensor))
             captured[id(tensor)] = cot if prev is None else prev + cot
         for hook in tensor._hooks:
-            new = hook(_wrap(cot))
+            new = hook(_as_hook_arg(cot))
             if new is not None:
-                cot = _unwrap(new)
+                cot = new if create_graph else _unwrap(new)
         if accumulate_to_grad:
-            tensor._accumulate_grad(cot)
+            tensor._accumulate_grad(_unwrap(cot))
     return captured
+
+
+def _apply_pullback_recorded(node, cot_tree):
+    """Run `node`'s pullback as a recorded op (create_graph=True path).
+
+    The op's differentiable inputs are the cotangent Tensors inside cot_tree
+    plus the node's primal input tensors; its body re-derives the vjp from the
+    primal function, so jax.vjp of THIS op yields the true second-order
+    pullback (including ∂²/∂primal² terms the frozen closure drops)."""
+    from . import dispatch
+
+    if node.primal_fn is None:
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name}' is unsupported: the "
+            "node has no primal function (PyLayer/custom nodes record only a "
+            "one-shot backward). Differentiate with the functional APIs "
+            "(paddle_tpu.autograd.vjp/jacobian) instead."
+        )
+    primal_tensors = [t for (t, _, _) in node.inputs]
+    pf = node.primal_fn
+
+    def _grad_op(cot, *primals):
+        _, vjp = jax.vjp(pf, *primals)
+        return vjp(cot)
+
+    return dispatch.apply(
+        _grad_op, cot_tree, *primal_tensors, op_name=f"grad::{node.name}"
+    )
+
+
+def _as_hook_arg(cot):
+    from .tensor import Tensor
+
+    return cot if isinstance(cot, Tensor) else _wrap(cot)
 
 
 def _wrap(arr):
@@ -302,36 +355,48 @@ def grad(
     allow_unused=False,
 ):
     """paddle.grad — return grads of outputs w.r.t. inputs without touching
-    .grad. create_graph (higher-order through the tape) is not supported on
-    the eager tape; use paddle_tpu.jit / functional autodiff for that
-    (jax.grad composes arbitrarily there)."""
+    .grad.
+
+    create_graph=True records the backward sweep itself on the tape (each
+    pullback runs through the dispatcher as a `grad::<op>` node), so the
+    returned gradients are differentiable again — the eager double-backward
+    of the reference (`paddle.grad` via general_grad, backward.cc:105)."""
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is unsupported; use "
-            "paddle_tpu.autograd functional APIs (jacobian/hessian/vjp) or "
-            "the jit path for higher-order derivatives"
-        )
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
     if grad_outputs is None:
         grad_outputs = [None] * len(outputs)
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
     seeds = []
     for t, g in zip(outputs, grad_outputs):
-        seeds.append(_ones_like_aval(t) if g is None else _unwrap(g))
+        if g is None:
+            # In create_graph mode every routed cotangent must be a Tensor:
+            # a raw seed reaching GradNode.add_cotangent as `cur` would
+            # coerce a later Tensor contribution (cur + value) to a raw
+            # array and silently drop its recorded graph.
+            ones = _ones_like_aval(t)
+            seeds.append(_wrap(ones) if create_graph else ones)
+        else:
+            seeds.append(g if create_graph else _unwrap(g))
     targets = {id(t) for t in inputs}
-    with no_grad():
-        captured = _run_engine(
-            outputs, seeds, retain_graph, accumulate_to_grad=False,
-            target_set=targets,
-        )
+    if create_graph:
+        with enable_grad():
+            captured = _run_engine(
+                outputs, seeds, retain_graph, accumulate_to_grad=False,
+                target_set=targets, create_graph=True,
+            )
+    else:
+        with no_grad():
+            captured = _run_engine(
+                outputs, seeds, retain_graph, accumulate_to_grad=False,
+                target_set=targets,
+            )
     result = []
     for t in inputs:
         c = captured.get(id(t))
@@ -342,6 +407,8 @@ def grad(
                     "allow_unused=True to return None for it"
                 )
             result.append(None)
+        elif isinstance(c, Tensor):
+            result.append(c)
         else:
             result.append(Tensor(c, stop_gradient=True))
     return result

@@ -142,6 +142,7 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
         [flat[i] for i in diff_pos],
         out_treedef,
         out_avals,
+        primal_fn=run,
     )
     wrapped_flat = [
         Tensor(o, stop_gradient=False, _grad_node=node, _out_index=i)

@@ -167,25 +167,53 @@ def _tup(v, n):
     return tuple(int(i) for i in np.asarray(v).reshape(-1))[:n]
 
 
-def _max_pool_with_index(x, kernel_size, stride, padding, n):
+def _neg_fill(dtype):
+    d = np.dtype(dtype)
+    if np.issubdtype(d, np.floating):
+        return float(np.finfo(np.float32).min) if d == jnp.bfloat16 \
+            else float(np.finfo(d).min)
+    return int(np.iinfo(d).min)
+
+
+def _max_pool_with_index(x, kernel_size, stride, padding, n,
+                         ceil_mode=False):
     """Windowed argmax via patch extraction: conv_general_dilated_patches
     lays every window out along a channel axis; argmax over it gives the
     in-window offset, converted to a flat spatial index (reference
-    max_pool2d_with_index op)."""
+    max_pool2d_with_index op).
+
+    Padding is applied explicitly with the dtype's lowest value so pad
+    positions can never win the max (lax patch extraction pads with 0,
+    which is wrong for all-negative windows); ceil_mode extends the right
+    pad so partial windows are kept."""
     ks, st = _tup(kernel_size, n), _tup(stride or kernel_size, n)
     pd = _tup(padding, n)
     B, C = x.shape[0], x.shape[1]
     spatial = x.shape[2:2 + n]
-    pads = [(p, p) for p in pd]
-    patches = jax.lax.conv_general_dilated_patches(
-        x, filter_shape=ks, window_strides=st, padding=pads,
-        dimension_numbers=("NC" + "DHW"[3 - n:], "OI" + "DHW"[3 - n:],
-                           "NC" + "DHW"[3 - n:]))
-    out_sp = patches.shape[2:]
-    # patches: [B, C*prod(ks), *out_sp] with window elements contiguous
-    # per channel
+    pads = [[0, 0], [0, 0]]
+    for d in range(n):
+        hi = pd[d]
+        if ceil_mode:
+            span = spatial[d] + 2 * pd[d] - ks[d]
+            out_d = -(-span // st[d]) + 1
+            hi = max(hi, (out_d - 1) * st[d] + ks[d] - spatial[d] - pd[d])
+        pads.append([pd[d], hi])
+    xp = jnp.pad(x, pads, constant_values=_neg_fill(x.dtype))
+    psp = xp.shape[2:]
+    out_sp = tuple((psp[d] - ks[d]) // st[d] + 1 for d in range(n))
+    # one strided slice per in-window offset (row-major over the kernel),
+    # stacked on a K axis: [B, C, K, *out_sp]. Avoids the conv-patches
+    # route, whose accumulation overflows on the -inf-like fill values.
+    import itertools
+
     K = int(np.prod(ks))
-    patches = patches.reshape((B, C, K) + out_sp)
+    slabs = []
+    for off in itertools.product(*[range(k) for k in ks]):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(off[d], off[d] + (out_sp[d] - 1) * st[d] + 1, st[d])
+            for d in range(n))
+        slabs.append(xp[idx])
+    patches = jnp.stack(slabs, axis=2)
     vals = jnp.max(patches, axis=2)
     arg = jnp.argmax(patches, axis=2)           # offset within the window
     # flat index into the (unpadded) input spatial grid
@@ -202,20 +230,58 @@ def _max_pool_with_index(x, kernel_size, stride, padding, n):
     return vals, idx.astype(jnp.int32)
 
 
+def _adaptive_max_pool_with_index(x, output_size, n):
+    """Adaptive windowed argmax: cell d spans [floor(i*S/O), ceil((i+1)*S/O))
+    — same binning as the reference's adaptive pooling. Output sizes are
+    static and small, so a per-cell slice loop unrolls fine under jit."""
+    import itertools
+
+    spatial = x.shape[2:2 + n]
+    outs = _tup(output_size, n)
+    cells_v, cells_i = {}, {}
+    for cell in itertools.product(*[range(o) for o in outs]):
+        lo = [(cell[d] * spatial[d]) // outs[d] for d in range(n)]
+        hi = [-(-((cell[d] + 1) * spatial[d]) // outs[d]) for d in range(n)]
+        region = x
+        for d in range(n):
+            region = jax.lax.slice_in_dim(region, lo[d], hi[d], axis=2 + d)
+        rs = region.shape[2:]
+        flat = region.reshape(region.shape[:2] + (-1,))
+        a = jnp.argmax(flat, axis=-1)
+        v = jnp.max(flat, axis=-1)
+        pos, rem = None, a
+        for d in range(n):
+            inner = int(np.prod(rs[d + 1:]))
+            p_d = rem // inner + lo[d]
+            rem = rem % inner
+            pos = p_d if pos is None else pos * spatial[d] + p_d
+        cells_v[cell], cells_i[cell] = v, pos
+    shape = x.shape[:2] + outs
+    vals = jnp.stack([cells_v[c] for c in sorted(cells_v)], axis=-1)
+    idx = jnp.stack([cells_i[c] for c in sorted(cells_i)], axis=-1)
+    return vals.reshape(shape), idx.reshape(shape).astype(jnp.int32)
+
+
 def max_pool2d_with_index(x, kernel_size, strides=None, paddings=0,
                           global_pooling=False, adaptive=False,
                           ceil_mode=False):
+    if adaptive:
+        return _adaptive_max_pool_with_index(x, kernel_size, 2)
     if global_pooling:
         kernel_size, strides, paddings = x.shape[2:4], None, 0
-    return _max_pool_with_index(x, kernel_size, strides, paddings, 2)
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 2,
+                                ceil_mode)
 
 
 def max_pool3d_with_index(x, kernel_size, strides=None, paddings=0,
                           global_pooling=False, adaptive=False,
                           ceil_mode=False):
+    if adaptive:
+        return _adaptive_max_pool_with_index(x, kernel_size, 3)
     if global_pooling:
         kernel_size, strides, paddings = x.shape[2:5], None, 0
-    return _max_pool_with_index(x, kernel_size, strides, paddings, 3)
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 3,
+                                ceil_mode)
 
 
 _reg("max_pool2d_with_index", max_pool2d_with_index)
@@ -268,9 +334,14 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     ks, st = _tup(kernel_size, 2), _tup(stride or kernel_size, 2)
     pd = [(i, i) for i in _tup(padding, 2)]
     powed = jnp.abs(x.astype(jnp.float32)) ** p
-    window = (1, 1) + ks
-    strides = (1, 1) + st
-    pads = [(0, 0), (0, 0)] + pd
+    if data_format == "NHWC":
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + pd + [(0, 0)]
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + pd
     s = jax.lax.reduce_window(powed, 0.0, jax.lax.add, window, strides,
                               pads)
     return (s ** (1.0 / p)).astype(x.dtype)
@@ -296,19 +367,34 @@ def _fractional_pool(x, output_size, random_u, n):
         return b
 
     bs = [bounds(spatial[d], outs[d]) for d in range(n)]
-    # windowed max via a cummax-style prefix: use reduce over gathered
-    # strips per output cell (output sizes are static + small)
-    out = x
-    for d in range(n):
-        axis = 2 + d
-        segs = []
-        for i in range(outs[d]):
-            lo, hi = int(bs[d][i]), int(max(bs[d][i + 1], bs[d][i] + 1))
-            strip = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
-            segs.append(jnp.max(strip, axis=axis, keepdims=True))
-        out = jnp.concatenate(segs, axis=axis)
-    flat_idx = jnp.zeros(x.shape[:2] + outs, jnp.int32)
-    return out, flat_idx
+    # per-cell slice + argmax (region boundaries are static and the output
+    # grid small, so the loop unrolls under jit); the argmax gives the true
+    # flat input index the unpool op scatters by.
+    import itertools
+
+    cells_v, cells_i = {}, {}
+    for cell in itertools.product(*[range(o) for o in outs]):
+        lo = [int(bs[d][cell[d]]) for d in range(n)]
+        hi = [int(max(bs[d][cell[d] + 1], bs[d][cell[d]] + 1))
+              for d in range(n)]
+        region = x
+        for d in range(n):
+            region = jax.lax.slice_in_dim(region, lo[d], hi[d], axis=2 + d)
+        rs = region.shape[2:]
+        flat = region.reshape(region.shape[:2] + (-1,))
+        cells_v[cell] = jnp.max(flat, axis=-1)
+        a = jnp.argmax(flat, axis=-1)
+        pos, rem = None, a
+        for d in range(n):
+            inner = int(np.prod(rs[d + 1:]))
+            p_d = rem // inner + lo[d]
+            rem = rem % inner
+            pos = p_d if pos is None else pos * spatial[d] + p_d
+        cells_i[cell] = pos
+    shape = x.shape[:2] + outs
+    out = jnp.stack([cells_v[c] for c in sorted(cells_v)], axis=-1)
+    flat_idx = jnp.stack([cells_i[c] for c in sorted(cells_i)], axis=-1)
+    return out.reshape(shape), flat_idx.reshape(shape).astype(jnp.int32)
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=0.0,

@@ -174,6 +174,11 @@ def rmsprop_(param, mean_square, grad, moment, learning_rate,
     ms = decay * mean_square + (1 - decay) * g * g
     lr = learning_rate.astype(w.dtype)
     if centered:
+        if mean_grad is None:
+            raise ValueError(
+                "rmsprop_ with centered=True requires a mean_grad "
+                "accumulator (reference: rmsprop op MeanGrad input)"
+            )
         mg = decay * mean_grad + (1 - decay) * g
         denom = jnp.sqrt(ms - mg * mg + epsilon)
     else:

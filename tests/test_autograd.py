@@ -139,3 +139,51 @@ def test_pylayer():
     assert np.allclose(y.numpy(), [6.0])
     y.backward()
     assert np.allclose(x.grad.numpy(), [2.0])
+
+
+def test_create_graph_double_backward():
+    # d2/dx2 (x^3) = 6x via two tape sweeps (reference: paddle.grad
+    # create_graph=True, eager general_grad in backward.cc).
+    x = paddle.to_tensor([1.5, -2.0, 3.0], stop_gradient=False)
+    y = (x * x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    assert np.allclose(g.numpy(), 3 * np.array([1.5, -2.0, 3.0]) ** 2)
+    (g2,) = paddle.grad(g.sum(), [x])
+    assert np.allclose(g2.numpy(), 6 * np.array([1.5, -2.0, 3.0]))
+
+
+def test_create_graph_matches_jax():
+    import jax
+    import jax.numpy as jnp
+
+    xa = np.array([0.3, -1.2, 2.1], np.float32)
+
+    def f(a):
+        return jnp.sum(jnp.tanh(a) * a**2)
+
+    want = jax.grad(lambda a: jax.grad(f)(a).sum())(xa)
+    xt = paddle.to_tensor(xa, stop_gradient=False)
+    yt = (xt.tanh() * xt * xt).sum()
+    (gt,) = paddle.grad(yt, [xt], create_graph=True)
+    (gt2,) = paddle.grad(gt.sum(), [xt])
+    assert np.allclose(gt2.numpy(), np.asarray(want), atol=1e-5)
+
+
+def test_create_graph_third_order():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x**4).sum()
+    (a,) = paddle.grad(y, [x], create_graph=True)
+    (b,) = paddle.grad(a.sum(), [x], create_graph=True)
+    (c,) = paddle.grad(b.sum(), [x])
+    assert np.allclose(c.numpy(), [48.0])
+
+
+def test_create_graph_grad_in_loss():
+    # gradient-penalty style: grad norm feeds back into a scalar that is
+    # then backward()ed into leaf .grad.
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    (g,) = paddle.grad(y, [x], create_graph=True)
+    penalty = (g * g).sum()  # = 4*x1^2+4*x2^2 -> d/dx = 8x
+    penalty.backward()
+    assert np.allclose(x.grad.numpy(), [8.0, 16.0])
