@@ -175,6 +175,27 @@ def _is_traced(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def _eager_tp(tensor, group):
+    """Return the cross-process transport when this call is an *eager*
+    multi-process collective (reference: ProcessGroupGloo/NCCL eager path);
+    None when traced (in-graph XLA path) or single-process."""
+    if tensor is not None and _is_traced(tensor._value):
+        return None
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        return None
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is None or not g.is_member():
+        return None
+    return tp
+
+
+def _np(tensor):
+    return np.asarray(tensor._value)
+
+
 def _axis(group) -> str:
     return (group or _get_default_group()).axis_name
 
@@ -224,6 +245,13 @@ def _track(op_name, group, tensor=None):
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     ct = _track("all_reduce", group, tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.all_reduce(_np(tensor), op, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -246,6 +274,19 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     ct = _track("all_gather", group, tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = tp.all_gather(_np(tensor), g.ranks, g.id)
+        if ct is not None:
+            ct.mark_done()
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(Tensor(p) for p in parts)
+            return Task(tensor, ct)
+        from ..ops.manipulation import stack as _stack
+
+        return _stack([Tensor(p) for p in parts], axis=0)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -271,9 +312,27 @@ def all_gather_object(object_list, obj, group=None):
     if n <= 1 or not _env.is_initialized():
         object_list.append(obj)
         return
-    from jax.experimental import multihost_utils
-
     import pickle
+
+    g = group or _get_default_group()
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is not None and g.is_member():
+        data = np.frombuffer(pickle.dumps(obj), np.uint8)
+        # pad to the max length exchanged via a size allgather first
+        size = np.asarray([data.size], np.int64)
+        sizes = tp.all_gather(size, g.ranks, g.id)
+        maxlen = int(max(int(s[0]) for s in sizes))
+        padded = np.zeros(max(maxlen, 1), np.uint8)
+        padded[: data.size] = data
+        gathered = tp.all_gather(padded, g.ranks, g.id)
+        parts = [gathered[i][: int(sizes[i][0])]
+                 for i in range(len(gathered))]
+        for p in parts:
+            object_list.append(pickle.loads(p.tobytes()))
+        return
+    from jax.experimental import multihost_utils
 
     data = np.frombuffer(pickle.dumps(obj), np.uint8)
     # pad to fixed size for allgather
@@ -291,6 +350,22 @@ def all_gather_object(object_list, obj, group=None):
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
                    group=None, sync_op=True):
     ct = _track("reduce_scatter", group, tensor)
+    g = group or _get_default_group()
+    src0 = tensor_or_tensor_list
+    probe = src0[0] if isinstance(src0, list) and src0 else \
+        (src0 if not isinstance(src0, list) else None)
+    tp = _eager_tp(probe, g) if probe is not None else None
+    if tp is not None:
+        if isinstance(src0, list):
+            full = np.concatenate([_np(t) for t in src0], axis=0)
+        else:
+            full = _np(src0)
+        red = tp.all_reduce(full, op, g.ranks, g.id)
+        shard = np.split(red, g.nranks, axis=0)[g.rank]
+        tensor.set_value(shard)
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
     ax = _axis(group)
 
     def fn(x):
@@ -315,6 +390,17 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 
 def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     ct = _track("all_to_all", group)
+    g = group or _get_default_group()
+    if isinstance(in_tensor_list, list) and in_tensor_list:
+        tp = _eager_tp(in_tensor_list[0], g)
+        if tp is not None:
+            outs = tp.all_to_all([_np(t) for t in in_tensor_list],
+                                 g.ranks, g.id)
+            if ct is not None:
+                ct.mark_done()
+            out_tensor_list.clear()
+            out_tensor_list.extend(Tensor(o) for o in outs)
+            return Task(comm_task=ct)
     ax = _axis(group)
     n = get_world_size(group)
     from ..ops.manipulation import stack
@@ -342,6 +428,15 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
                       in_split_sizes=None, group=None, sync_op=True):
     ct = _track("all_to_all_single", group, in_tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(in_tensor, g)
+    if tp is not None:
+        pieces = np.split(_np(in_tensor), g.nranks, axis=0)
+        outs = tp.all_to_all(pieces, g.ranks, g.id)
+        out_tensor.set_value(np.concatenate(outs, axis=0))
+        if ct is not None:
+            ct.mark_done()
+        return Task(out_tensor, ct)
     ax = _axis(group)
     n = get_world_size(group)
 
@@ -364,8 +459,14 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     ct = _track("broadcast", group, tensor)
-    ax = _axis(group)
     g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.broadcast(_np(tensor), src, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    ax = _axis(group)
     src_in_group = g.get_group_rank(src) if src in g.ranks else src
 
     def fn(x):
@@ -386,6 +487,24 @@ def broadcast_object_list(object_list, src=0, group=None):
     n = get_world_size(group)
     if n <= 1 or not _env.is_initialized():
         return
+    import pickle
+
+    g = group or _get_default_group()
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is not None and g.is_member():
+        # single round: the transport frame header carries shape, so
+        # receivers need no size pre-exchange
+        if _env.global_rank() == src:
+            data = np.frombuffer(pickle.dumps(list(object_list)), np.uint8)
+            tp.broadcast(data, src, g.ranks, g.id)
+        else:
+            data = tp.broadcast(np.zeros(0, np.uint8), src, g.ranks, g.id)
+            obj = pickle.loads(data.tobytes())
+            object_list.clear()
+            object_list.extend(obj)
+        return
     from jax.experimental import multihost_utils
 
     obj = object_list[0] if _env.global_rank() == src else None
@@ -397,7 +516,15 @@ def broadcast_object_list(object_list, src=0, group=None):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
-    # XLA collectives produce the result on all ranks; dst semantic kept
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        ct = _track("reduce", group, tensor)
+        tensor.set_value(tp.reduce(_np(tensor), op, dst, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    # in-graph: XLA collectives produce the result on all ranks; dst kept
     return all_reduce(tensor, op, group, sync_op)
 
 
@@ -406,6 +533,12 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     if g.nranks <= 1:
         if tensor_list:
             tensor.set_value(tensor_list[0])
+        return Task(tensor)
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = [_np(t) for t in tensor_list] \
+            if _env.global_rank() == src and tensor_list else None
+        tensor.set_value(tp.scatter(parts, src, g.ranks, g.id))
         return Task(tensor)
 
     def fn(x):
@@ -436,6 +569,14 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = tp.gather(_np(tensor), dst, g.ranks, g.id)
+        if gather_list is not None and parts is not None:
+            gather_list.clear()
+            gather_list.extend(Tensor(p) for p in parts)
+        return Task(tensor)
     tl = gather_list if gather_list is not None else []
     all_gather(tl, tensor, group, sync_op)
     return Task(tensor)
@@ -443,12 +584,24 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
 
 def send(tensor, dst=0, group=None, sync_op=True):
     """P2P send. In-graph: ppermute edge (see p2p helpers in
-    meta_parallel.pp_utils). Eager single-controller: buffered locally."""
+    meta_parallel.pp_utils). Eager multi-process: framed TCP transfer to
+    the peer (reference ProcessGroup::Send, process_group.h:162). Eager
+    single-process: local buffer (world of 1)."""
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tp.send(_np(tensor), dst, channel=f"p2p:{g.id}")
+        return Task(tensor)
     _p2p_buffer.setdefault(dst, []).append(Tensor(tensor._value))
     return Task(tensor)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.recv(src, channel=f"p2p:{g.id}"))
+        return Task(tensor)
     me = _env.global_rank()
     buf = _p2p_buffer.get(me) or []
     if buf:
@@ -463,7 +616,33 @@ def isend(tensor, dst=0, group=None):
     return send(tensor, dst, group, sync_op=False)
 
 
+class _PendingRecv(Task):
+    """Async receive: the sequence tag is claimed at post time (so ordering
+    matches the posting order, reference ProcessGroup::Recv task), the
+    blocking mailbox take happens at wait()."""
+
+    def __init__(self, tensor, tp, tag):
+        super().__init__(tensor)
+        self._tp = tp
+        self._tag = tag
+        self._done = False
+
+    def wait(self):
+        if not self._done:
+            self._tensor.set_value(self._tp.take(self._tag))
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
 def irecv(tensor, src=0, group=None):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tag = tp.reserve_recv(src, channel=f"p2p:{g.id}")
+        return _PendingRecv(tensor, tp, tag)
     return recv(tensor, src, group, sync_op=False)
 
 
@@ -476,13 +655,25 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    tasks = []
-    for op in p2p_op_list:
-        tasks.append(op.op(op.tensor, op.peer, op.group))
+    # Sends fire first regardless of listing order so two ranks posting
+    # mirrored (recv, send) batches can't deadlock; receives are posted
+    # async and complete on wait().
+    tasks = [None] * len(p2p_op_list)
+    for i, op in enumerate(p2p_op_list):
+        if op.op in (isend, send):
+            tasks[i] = isend(op.tensor, op.peer, op.group)
+    for i, op in enumerate(p2p_op_list):
+        if tasks[i] is None:
+            tasks[i] = irecv(op.tensor, op.peer, op.group)
     return tasks
 
 
 def barrier(group=None):
+    g = group or _get_default_group()
+    tp = _eager_tp(None, g)
+    if tp is not None:
+        tp.barrier(f"collective_barrier/{g.id}", g.ranks)
+        return Task()
     if _env.is_initialized() and _env.get_world_size() > 1:
         from jax.experimental import multihost_utils
 

@@ -36,7 +36,8 @@ def init_parallel_env():
     n_procs = _env_int("PADDLE_TRAINERS_NUM", 1)
     endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
     rank = _env_int("PADDLE_TRAINER_ID", 0)
-    if n_procs > 1 and endpoints:
+    use_jax_dist = os.environ.get("PADDLE_JAX_DISTRIBUTED", "1") != "0"
+    if n_procs > 1 and endpoints and use_jax_dist:
         coordinator = endpoints.split(",")[0]
         try:
             jax.distributed.initialize(
@@ -47,6 +48,12 @@ def init_parallel_env():
         except Exception as e:  # already initialized or single-node sim
             if "already" not in str(e).lower():
                 raise
+    if n_procs > 1:
+        # Eager cross-process tensor path (ProcessGroupGloo analog); the
+        # in-graph XLA collectives stay the hot path.
+        from .transport import init_transport
+
+        init_transport(rank, n_procs)
     _initialized = True
     return ParallelEnv()
 
@@ -62,19 +69,26 @@ def get_rank(group=None):
 
 
 def global_rank():
+    env_n = _env_int("PADDLE_TRAINERS_NUM", 1)
     try:
-        return jax.process_index()
+        # When jax.distributed is up it is authoritative; when the job is
+        # multi-process but only the TCP transport is live (CPU sim, tests),
+        # jax reports a world of 1 — trust the launcher env instead.
+        if jax.process_count() >= env_n:
+            return jax.process_index()
     except Exception:
-        return _env_int("PADDLE_TRAINER_ID", 0)
+        pass
+    return _env_int("PADDLE_TRAINER_ID", 0)
 
 
 def get_world_size(group=None):
     if group is not None:
         return group.nranks
+    env_n = _env_int("PADDLE_TRAINERS_NUM", 1)
     try:
-        return jax.process_count()
+        return max(jax.process_count(), env_n)
     except Exception:
-        return _env_int("PADDLE_TRAINERS_NUM", 1)
+        return env_n
 
 
 def device_world_size():

@@ -11,6 +11,7 @@ from .recompute import recompute, recompute_sequential
 from . import sequence_parallel_utils
 
 from .. import meta_parallel
+from . import layers
 from ..meta_parallel import (ColumnParallelLinear, ParallelCrossEntropy,
                              RowParallelLinear, VocabParallelEmbedding)
 

@@ -128,6 +128,8 @@ class Controller:
             "PADDLE_TRAINER_ENDPOINTS": ",".join(pod.world),
             "PADDLE_CURRENT_ENDPOINT": pod.world[pod.rank],
             "PADDLE_JOB_ID": self.args.job_id,
+            "PADDLE_MASTER": self.args.master
+            or f"127.0.0.1:{self.store.port}",
             "FLAGS_selected_tpus": "all",
         })
         return env

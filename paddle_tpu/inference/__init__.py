@@ -207,15 +207,31 @@ def save_inference_model(path_prefix: str, layer, input_spec,
             args.append(jax.ShapeDtypeStruct(shp, s.dtype))
     else:
         args = [jnp.zeros(tuple(s.shape), s.dtype) for s in input_spec]
-    exported = jexport.export(jax.jit(pure))(flat_p, flat_b, *args)
+    # Export for both chip families so the artifact deploys anywhere (the
+    # portability the reference gets from shipping ProgramDesc + re-running
+    # analysis passes on the target device).
+    exported = jexport.export(jax.jit(pure),
+                              platforms=("cpu", "tpu"))(
+        flat_p, flat_b, *args)
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(exported.serialize())
-    np.savez(path_prefix + ".pdiparams",
-             **{f"p{i}": np.asarray(a) for i, a in enumerate(flat_p)},
-             **{f"b{i}": np.asarray(a) for i, a in enumerate(flat_b)})
+
+    # bf16/fp8 (ml_dtypes, numpy kind 'V') don't round-trip through npz —
+    # store those as flat uint8 with dtype/shape recorded in the signature
+    arrays, meta = {}, {}
+    for key, a in [(f"p{i}", a) for i, a in enumerate(flat_p)] + \
+                  [(f"b{i}", a) for i, a in enumerate(flat_b)]:
+        a = np.asarray(a)
+        if a.dtype.kind == "V":
+            arrays[key] = np.frombuffer(a.tobytes(), np.uint8)
+            meta[key] = {"dtype": a.dtype.name, "shape": list(a.shape)}
+        else:
+            arrays[key] = a
+    np.savez(path_prefix + ".pdiparams", **arrays)
 
     in_names = list(input_names or
-                    [f"x{i}" for i in range(len(input_spec))])
+                    [getattr(s, "name", None) or f"x{i}"
+                     for i, s in enumerate(input_spec)])
     sig = {
         "inputs": [{"name": n, "shape": list(s.shape),
                     "dtype": str(s.dtype)}
@@ -223,6 +239,7 @@ def save_inference_model(path_prefix: str, layer, input_spec,
         "output_names": list(output_names or []),
         "precision": precision,
         "n_params": len(flat_p), "n_buffers": len(flat_b),
+        "array_meta": meta,
     }
     with open(path_prefix + ".pdconfig", "w") as f:
         json.dump(sig, f)
@@ -238,8 +255,20 @@ def load_inference_model(path_prefix: str):
     with open(path_prefix + ".pdconfig") as f:
         sig = json.load(f)
     data = np.load(path_prefix + ".pdiparams.npz")
-    params = [data[f"p{i}"] for i in range(sig["n_params"])]
-    buffers = [data[f"b{i}"] for i in range(sig["n_buffers"])]
+    meta = sig.get("array_meta", {})
+
+    def unpack(key):
+        a = data[key]
+        m = meta.get(key)
+        if m is not None:
+            import ml_dtypes
+
+            dt = np.dtype(getattr(ml_dtypes, m["dtype"]))
+            a = np.frombuffer(a.tobytes(), dt).reshape(m["shape"])
+        return a
+
+    params = [unpack(f"p{i}") for i in range(sig["n_params"])]
+    buffers = [unpack(f"b{i}") for i in range(sig["n_buffers"])]
     return exported, params, buffers, sig
 
 

@@ -1,0 +1,122 @@
+"""Two-trainer collective battery, run as a subprocess by
+test_transport_collectives.py (reference pattern:
+test/legacy_test/test_collective_base.py:155 _run_cluster — spawned
+trainers with env rendezvous, results compared to NumPy in the parent).
+
+Each rank runs every eager collective through the TCP transport and dumps
+its results to OUT_DIR/rank{r}.npz.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+def main():
+    out_dir = os.environ["COLLECTIVE_OUT_DIR"]
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, world
+    results = {}
+
+    base = np.arange(6, dtype=np.float32).reshape(2, 3) + 10 * (rank + 1)
+
+    # all_reduce (sum / max)
+    t = paddle.to_tensor(base.copy())
+    dist.all_reduce(t)
+    results["all_reduce_sum"] = np.asarray(t.numpy())
+    t = paddle.to_tensor(base.copy())
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    results["all_reduce_max"] = np.asarray(t.numpy())
+
+    # broadcast from rank 0
+    t = paddle.to_tensor(base.copy())
+    dist.broadcast(t, src=0)
+    results["broadcast"] = np.asarray(t.numpy())
+
+    # all_gather
+    gathered = []
+    dist.all_gather(gathered, paddle.to_tensor(base.copy()))
+    results["all_gather"] = np.stack([np.asarray(g.numpy())
+                                     for g in gathered])
+
+    # reduce to dst=0
+    t = paddle.to_tensor(base.copy())
+    dist.reduce(t, dst=0)
+    results["reduce"] = np.asarray(t.numpy())
+
+    # send / recv
+    p2p = np.full((4,), float(rank), np.float32)
+    t = paddle.to_tensor(p2p.copy())
+    if rank == 0:
+        dist.send(paddle.to_tensor(np.arange(4, dtype=np.float32)), dst=1)
+    else:
+        dist.recv(t, src=0)
+    results["p2p"] = np.asarray(t.numpy())
+
+    # batched p2p, recv listed FIRST on both ranks (the ordering that
+    # deadlocks naive synchronous recv)
+    peer = 1 - rank
+    rbuf = paddle.to_tensor(np.zeros((3,), np.float32))
+    sbuf = paddle.to_tensor(np.full((3,), float(rank + 1), np.float32))
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.irecv, rbuf, peer),
+        dist.P2POp(dist.isend, sbuf, peer),
+    ])
+    for t in tasks:
+        t.wait()
+    results["batch_p2p"] = np.asarray(rbuf.numpy())
+
+    # scatter from rank 0
+    t = paddle.to_tensor(np.zeros((2,), np.float32))
+    pieces = [paddle.to_tensor(np.asarray([1.0, 2.0], np.float32)),
+              paddle.to_tensor(np.asarray([3.0, 4.0], np.float32))] \
+        if rank == 0 else None
+    dist.scatter(t, pieces, src=0)
+    results["scatter"] = np.asarray(t.numpy())
+
+    # all_to_all
+    ins = [paddle.to_tensor(np.full((2,), 10.0 * rank + i, np.float32))
+           for i in range(world)]
+    outs = []
+    dist.all_to_all(outs, ins)
+    results["all_to_all"] = np.stack([np.asarray(o.numpy()) for o in outs])
+
+    # reduce_scatter
+    full = np.arange(4, dtype=np.float32) + 100 * (rank + 1)
+    shard = paddle.to_tensor(np.zeros((2,), np.float32))
+    dist.reduce_scatter(shard, paddle.to_tensor(full.copy()))
+    results["reduce_scatter"] = np.asarray(shard.numpy())
+
+    # object collectives
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    results["all_gather_object_ranks"] = np.asarray(
+        [o["rank"] for o in objs])
+    olist = [{"from": rank}] if rank == 0 else [None]
+    dist.broadcast_object_list(olist, src=0)
+    results["broadcast_object"] = np.asarray([olist[0]["from"]])
+
+    # bf16 all_reduce through the transport
+    import jax.numpy as jnp
+
+    tb = paddle.to_tensor(jnp.asarray(base, jnp.bfloat16))
+    dist.all_reduce(tb)
+    results["all_reduce_bf16"] = np.asarray(
+        tb.astype("float32").numpy())
+
+    dist.barrier()
+    np.savez(os.path.join(out_dir, f"rank{rank}.npz"), **results)
+
+
+if __name__ == "__main__":
+    main()

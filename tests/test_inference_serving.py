@@ -1,0 +1,111 @@
+"""Serving path: save_inference_model -> create_predictor, including a
+fresh-process load with no model Python (reference capability:
+paddle/fluid/inference/api/analysis_predictor.cc — deployable artifact).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import (Config, PrecisionType, create_predictor,
+                                  save_inference_model)
+from paddle_tpu.jit.api import InputSpec
+
+
+class SmallMLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serving")
+    prefix = str(d / "mlp")
+    model = SmallMLP()
+    model.eval()
+    spec = [InputSpec(shape=[None, 8], dtype="float32", name="x")]
+    save_inference_model(prefix, model, spec, output_names=["y"])
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x)).numpy())
+    return prefix, x, want
+
+
+def test_predictor_matches_eager(saved_model):
+    prefix, x, want = saved_model
+    cfg = Config(prefix)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    (got,) = pred.run([x])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_batch(saved_model):
+    prefix, x, want = saved_model
+    pred = create_predictor(Config(prefix))
+    for bs in (1, 5):
+        xb = np.random.RandomState(bs).randn(bs, 8).astype(np.float32)
+        (got,) = pred.run([xb])
+        assert got.shape == (bs, 4)
+
+
+def test_handle_api(saved_model):
+    prefix, x, want = saved_model
+    pred = create_predictor(Config(prefix))
+    h = pred.get_input_handle("x")
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), want,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fresh_process_predict(saved_model, tmp_path):
+    """The deploy contract: a process that never imports the model class
+    (only paddle_tpu.inference) loads the artifact and predicts."""
+    prefix, x, want = saved_model
+    xin = tmp_path / "x.npy"
+    yout = tmp_path / "y.npy"
+    np.save(xin, x)
+    script = textwrap.dedent(f"""
+        import numpy as np
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config({str(prefix)!r}))
+        x = np.load({str(xin)!r})
+        (y,) = pred.run([x])
+        np.save({str(yout)!r}, y)
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, timeout=180)
+    assert out.returncode == 0, out.stderr.decode()
+    got = np.load(yout)
+    # the fresh process may serve on a different chip family (the test
+    # session is CPU-pinned, the subprocess may get the real TPU) —
+    # cross-device tolerance
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_precision_knob(tmp_path):
+    model = SmallMLP()
+    model.eval()
+    prefix = str(tmp_path / "mlp_bf16")
+    spec = [InputSpec(shape=[2, 8], dtype="float32", name="x")]
+    save_inference_model(prefix, model, spec,
+                         precision=PrecisionType.Bfloat16)
+    x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x)).numpy())
+    (got,) = create_predictor(Config(prefix)).run([x])
+    assert got.dtype == np.float32
+    np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)

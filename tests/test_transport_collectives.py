@@ -1,0 +1,143 @@
+"""Cross-process eager collectives over the TCP transport.
+
+Reference analog: test/legacy_test/test_collective_base.py:155
+(_run_cluster) — spawn two trainer subprocesses with env rendezvous and
+check every collective's result against a NumPy reference computed here.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _base(rank):
+    return np.arange(6, dtype=np.float32).reshape(2, 3) + 10 * (rank + 1)
+
+
+@pytest.fixture(scope="module")
+def cluster_results(tmp_path_factory):
+    out_dir = str(tmp_path_factory.mktemp("collective"))
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "collective_worker.py")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_JAX_DISTRIBUTED": "0",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS":
+                "127.0.0.1:6170,127.0.0.1:6171",
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:617{rank}",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "COLLECTIVE_OUT_DIR": out_dir,
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail("collective worker hung:\n" + out.decode())
+        outs.append(out.decode())
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    return {r: dict(np.load(os.path.join(out_dir, f"rank{r}.npz"),
+                            allow_pickle=True))
+            for r in range(2)}
+
+
+def test_all_reduce(cluster_results):
+    want = _base(0) + _base(1)
+    for r in range(2):
+        np.testing.assert_allclose(
+            cluster_results[r]["all_reduce_sum"], want)
+        np.testing.assert_allclose(
+            cluster_results[r]["all_reduce_max"],
+            np.maximum(_base(0), _base(1)))
+
+
+def test_broadcast(cluster_results):
+    for r in range(2):
+        np.testing.assert_allclose(cluster_results[r]["broadcast"],
+                                   _base(0))
+
+
+def test_all_gather(cluster_results):
+    want = np.stack([_base(0), _base(1)])
+    for r in range(2):
+        np.testing.assert_allclose(cluster_results[r]["all_gather"], want)
+
+
+def test_reduce(cluster_results):
+    np.testing.assert_allclose(cluster_results[0]["reduce"],
+                               _base(0) + _base(1))
+    # non-dst rank keeps its own value
+    np.testing.assert_allclose(cluster_results[1]["reduce"], _base(1))
+
+
+def test_p2p(cluster_results):
+    np.testing.assert_allclose(cluster_results[1]["p2p"],
+                               np.arange(4, dtype=np.float32))
+
+
+def test_batch_p2p_mirrored_order(cluster_results):
+    # each rank received the peer's payload despite posting recv first
+    np.testing.assert_allclose(cluster_results[0]["batch_p2p"],
+                               np.full((3,), 2.0))
+    np.testing.assert_allclose(cluster_results[1]["batch_p2p"],
+                               np.full((3,), 1.0))
+
+
+def test_scatter(cluster_results):
+    np.testing.assert_allclose(cluster_results[0]["scatter"], [1.0, 2.0])
+    np.testing.assert_allclose(cluster_results[1]["scatter"], [3.0, 4.0])
+
+
+def test_all_to_all(cluster_results):
+    # rank r sends piece j=10r+j; rank r receives [10*0+r, 10*1+r]
+    for r in range(2):
+        want = np.stack([np.full((2,), 0.0 + r, np.float32),
+                         np.full((2,), 10.0 + r, np.float32)])
+        np.testing.assert_allclose(cluster_results[r]["all_to_all"], want)
+
+
+def test_reduce_scatter(cluster_results):
+    full = (np.arange(4, dtype=np.float32) + 100) + \
+           (np.arange(4, dtype=np.float32) + 200)
+    np.testing.assert_allclose(cluster_results[0]["reduce_scatter"],
+                               full[:2])
+    np.testing.assert_allclose(cluster_results[1]["reduce_scatter"],
+                               full[2:])
+
+
+def test_object_collectives(cluster_results):
+    for r in range(2):
+        np.testing.assert_array_equal(
+            cluster_results[r]["all_gather_object_ranks"], [0, 1])
+        np.testing.assert_array_equal(
+            cluster_results[r]["broadcast_object"], [0])
+
+
+def test_bf16_all_reduce(cluster_results):
+    want = (_base(0) + _base(1)).astype(np.float32)
+    for r in range(2):
+        np.testing.assert_allclose(
+            cluster_results[r]["all_reduce_bf16"], want, rtol=1e-2)
