@@ -65,6 +65,7 @@ class Tensor:
         "persistable",
         "_hooks",
         "trainable",
+        "_dist_attr",
         "__weakref__",
     )
 

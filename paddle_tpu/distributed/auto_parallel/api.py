@@ -76,18 +76,14 @@ class _DistMeta:
 
 
 def _attach(t: Tensor, mesh, placements):
-    object.__setattr__ if False else None
-    t_dist = t
-    # Tensor has __slots__; keep dist meta in a side table
-    _dist_meta[id(t_dist)] = _DistMeta(mesh, placements)
-    return t_dist
-
-
-_dist_meta = {}
+    # stored on the tensor itself (dedicated slot) — an id-keyed side table
+    # would serve stale placements once ids are recycled by the allocator
+    t._dist_attr = _DistMeta(mesh, placements)
+    return t
 
 
 def get_dist_meta(t: Tensor) -> Optional[_DistMeta]:
-    return _dist_meta.get(id(t))
+    return getattr(t, "_dist_attr", None)
 
 
 def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
@@ -167,15 +163,25 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
-              input_spec=None):
-    """reference api.py:2345 — returns a DistModel-like compiled wrapper."""
-    from ...jit.api import TrainStep
+              input_spec=None, mesh=None):
+    """reference api.py:2345 — compile `layer` for auto-parallel execution.
+    Backed by the static Engine (static_engine.py): placement completion,
+    GSPMD partitioning, donated whole-step executable, XLA cost model.
+
+    NOTE (static-graph semantics, same as the reference DistModel): the
+    engine owns the training state after this call; the eager `layer`'s
+    weights are a snapshot. Call .state_dict() to sync trained weights
+    back to the layer."""
+    from .static_engine import Engine
+
+    engine = Engine(layer, loss=loss, optimizer=optimizer, strategy=strategy)
+    if mesh is not None or optimizer is not None or loss is not None:
+        engine.prepare(mesh=mesh)
 
     class DistModel:
         def __init__(self):
             self.network = layer
-            self._step = TrainStep(layer, loss, optimizer) \
-                if optimizer is not None else None
+            self.engine = engine
             self._mode = "train"
 
         def train(self):
@@ -187,14 +193,20 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
             layer.eval()
 
         def __call__(self, *args):
-            if self._mode == "train" and self._step is not None:
-                return self._step(*args)
-            return layer(*args)
+            if self._mode == "train" and optimizer is not None:
+                return engine.run_step(*args)
+            if loss is not None:
+                # loss-only (no optimizer) or eval mode: forward + loss
+                return engine.run_eval_step(*args)
+            outs = engine.predict([tuple(args)])
+            return outs[0]
 
         def state_dict(self, mode="all"):
-            return layer.state_dict()
+            return engine.state_dict(mode)
 
-        def dist_main_program(self, mode=None):
-            return None
+        def dist_main_program(self, mode="train", *sample_batch):
+            if not sample_batch:
+                return None
+            return engine.dist_main_program(mode, *sample_batch)
 
     return DistModel()

@@ -152,6 +152,78 @@ def enable_to_static(flag: bool):
     return None
 
 
+def build_train_step(model, loss_fn, optimizer, train=True, amp_dtype=None):
+    """Build the fused forward+backward+update step function and jit it
+    with donated param/opt-state/buffer pytrees.
+
+    Shared by TrainStep (eager-facing) and the auto-parallel static Engine.
+    Non-trainable params (stop_gradient / trainable=False) and params
+    outside the optimizer's parameter list pass through untouched —
+    matching eager Optimizer.step's filter.
+    """
+    opt = optimizer
+    update = opt._update
+    grad_clip = opt._grad_clip
+    idx_of = {id(p): i for i, p in enumerate(opt._parameter_list)}
+    lr_wd_by_name = {}
+    trainable = set()
+    for name, p in model.named_parameters():
+        lr_wd_by_name[name] = opt._param_lr_wd(p, idx_of.get(id(p), 0))
+        if id(p) in idx_of and getattr(p, "trainable", True) \
+                and not p.stop_gradient:
+            trainable.add(name)
+
+    def step(params, opt_states, buffers, lr, step_i, seed, *batch):
+        frozen = {k: v for k, v in params.items() if k not in trainable}
+
+        def compute_loss(p_train):
+            p = dict(frozen)
+            p.update(p_train)
+            if amp_dtype is not None:
+                p = jax.tree.map(
+                    lambda a: a.astype(amp_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            with _TracingGuard(), rng_guard(seed):
+                out, new_buf = FB.call_functional(
+                    model, p, buffers, batch[:-1] if loss_fn else batch,
+                    train=train)
+                if loss_fn is not None:
+                    with no_grad():
+                        out_t = jax.tree.map(lambda x: Tensor(x), out)
+                        label = Tensor(batch[-1])
+                        loss_t = loss_fn(out_t, label)
+                    loss = loss_t._value
+                else:
+                    loss = out
+            return loss.astype(jnp.float32), new_buf
+
+        p_train = {k: v for k, v in params.items() if k in trainable}
+        (loss, new_buf), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(p_train)
+        names = list(p_train.keys())
+        gs = [grads[k] for k in names]
+        if grad_clip is not None:
+            gs = grad_clip.apply(gs)
+        new_params = dict(frozen)
+        new_states = {}
+        for k, g in zip(names, gs):
+            st = dict(opt_states.get(k) or {})
+            st["_step"] = step_i
+            lr_mult, wd = lr_wd_by_name.get(k, (1.0, 0.0))
+            p_new, st_new = update(params[k], g.astype(params[k].dtype),
+                                   st, lr * lr_mult, wd)
+            st_new.pop("_step", None)
+            new_params[k] = p_new
+            new_states[k] = st_new
+        # untouched states pass through (donated buffers must be returned)
+        for k, st in opt_states.items():
+            if k not in new_states:
+                new_states[k] = st
+        return new_params, new_states, new_buf, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
 class TrainStep:
     """One fused XLA executable: forward + backward + optimizer update.
 
@@ -174,55 +246,8 @@ class TrainStep:
         self._param_names = None
 
     def _build(self):
-        model = self.model
-        loss_fn = self.loss_fn
-        opt = self.optimizer
-        update = opt._update
-        grad_clip = opt._grad_clip
-        train = self.train
-        # per-param lr multiplier / weight decay (groups + decay exclusions)
-        idx_of = {id(p): i for i, p in enumerate(opt._parameter_list)}
-        lr_wd_by_name = {}
-        for name, p in model.named_parameters():
-            i = idx_of.get(id(p), 0)
-            lr_wd_by_name[name] = opt._param_lr_wd(p, i)
-
-        def step(params, opt_states, buffers, lr, step_i, seed, *batch):
-            def compute_loss(p):
-                with _TracingGuard(), rng_guard(seed):
-                    out, new_buf = FB.call_functional(
-                        model, p, buffers, batch[:-1] if loss_fn else batch,
-                        train=train)
-                    if loss_fn is not None:
-                        with no_grad():
-                            out_t = jax.tree.map(
-                                lambda x: Tensor(x), out)
-                            label = Tensor(batch[-1])
-                            loss_t = loss_fn(out_t, label)
-                        loss = loss_t._value
-                    else:
-                        loss = out
-                return loss.astype(jnp.float32), new_buf
-
-            (loss, new_buf), grads = jax.value_and_grad(
-                compute_loss, has_aux=True)(params)
-            names = list(params.keys())
-            gs = [grads[k] for k in names]
-            if grad_clip is not None:
-                gs = grad_clip.apply(gs)
-            new_params = {}
-            new_states = {}
-            for k, g in zip(names, gs):
-                st = dict(opt_states.get(k) or {})
-                st["_step"] = step_i
-                lr_mult, wd = lr_wd_by_name.get(k, (1.0, 0.0))
-                p_new, st_new = update(params[k], g, st, lr * lr_mult, wd)
-                st_new.pop("_step", None)
-                new_params[k] = p_new
-                new_states[k] = st_new
-            return new_params, new_states, new_buf, loss
-
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        return build_train_step(self.model, self.loss_fn, self.optimizer,
+                                train=self.train)
 
     def _opt_states(self, params: Dict) -> Dict:
         opt = self.optimizer

@@ -1,0 +1,150 @@
+"""Auto-parallel static Engine (reference:
+python/paddle/distributed/auto_parallel/static/engine.py:68, fit :1213).
+
+BERT (a non-Llama model) trains under mesh placements via dist.to_static /
+Engine with no model-specific trainer code, on the 8-device CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.auto_parallel import (Engine, ProcessMesh,
+                                                  Replicate, Shard)
+from paddle_tpu.models.bert import BERT_PRESETS, BertForSequenceClassification
+
+
+def _mk_model_and_mesh():
+    cfg = BERT_PRESETS["debug"]
+    model = BertForSequenceClassification(cfg, num_classes=4)
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4),
+                       dim_names=["dp", "mp"])
+    # user placements: TP-shard every encoder FFN weight on the mp axis;
+    # completion must propagate the rest
+    for name, p in model.named_parameters():
+        if "linear1.weight" in name:
+            dist.shard_tensor(p, mesh, [Replicate(), Shard(1)])
+        elif "linear2.weight" in name:
+            dist.shard_tensor(p, mesh, [Replicate(), Shard(0)])
+    return cfg, model, mesh
+
+
+def _batches(cfg, n, bs=8, seqlen=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seqlen)).astype(np.int64)
+    y = rng.randint(0, 4, (bs,)).astype(np.int64)
+    # fixed batch -> loss must decrease
+    return [(paddle.to_tensor(ids), paddle.to_tensor(y)) for _ in range(n)]
+
+
+class _Loss(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ce = nn.CrossEntropyLoss()
+
+    def forward(self, logits, label):
+        return self.ce(logits, label)
+
+
+def test_engine_fit_bert_tp():
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    engine = Engine(model, loss=_Loss(), optimizer=opt)
+    engine.prepare(mesh=mesh)
+    # FFN params staged with an mp-sharded NamedSharding
+    specs = [str(v.sharding.spec) for k, v in engine._params.items()
+             if "linear1.weight" in k]
+    assert specs and all("mp" in s for s in specs), specs
+    history = engine.fit(_batches(cfg, 12), epochs=1, verbose=0)
+    assert len(history) == 12
+    assert history[-1] < history[0], history
+
+
+def test_engine_cost_analysis():
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    engine = Engine(model, loss=_Loss(), optimizer=opt)
+    engine.prepare(mesh=mesh)
+    (x, y) = _batches(cfg, 1)[0]
+    cost = engine.cost_analysis(x, y)
+    assert cost["flops"] > 0
+    hlo = engine.dist_main_program("train", x, y)
+    assert "stablehlo" in hlo or "module" in hlo
+
+
+def test_dist_to_static_bert():
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    dist_model = dist.to_static(model, loss=_Loss(), optimizer=opt,
+                                mesh=mesh)
+    losses = []
+    for (x, y) in _batches(cfg, 10, seed=3):
+        losses.append(float(dist_model(x, y).numpy()))
+    assert losses[-1] < losses[0], losses
+    sd = dist_model.state_dict()
+    assert any("linear1" in k for k in sd)
+
+
+def test_state_dict_mid_training_then_continue():
+    # state_dict must COPY out of the donation-owned buffers: snapshotting
+    # mid-training then continuing must not touch deleted arrays
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    engine = Engine(model, loss=_Loss(), optimizer=opt)
+    engine.prepare(mesh=mesh)
+    batches = _batches(cfg, 3)
+    engine.run_step(*batches[0])
+    sd = engine.state_dict()
+    engine.run_step(*batches[1])           # donates engine buffers
+    w = np.asarray(sd["bert.encoder.layers.0.linear1.weight"].numpy())
+    assert np.isfinite(w).all()
+    engine.run_step(*batches[2])
+
+
+def test_frozen_params_not_updated():
+    cfg, model, mesh = _mk_model_and_mesh()
+    emb = dict(model.named_parameters())[
+        "bert.embeddings.word_embeddings.weight"]
+    emb.stop_gradient = True
+    before = np.asarray(emb.numpy()).copy()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-2)
+    engine = Engine(model, loss=_Loss(), optimizer=opt)
+    engine.prepare(mesh=mesh)
+    for b in _batches(cfg, 3):
+        engine.run_step(*b)
+    after = np.asarray(
+        engine.state_dict()["bert.embeddings.word_embeddings.weight"]
+        .numpy())
+    np.testing.assert_array_equal(before, after)
+
+
+def test_dist_model_eval_mode_returns_tensor():
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    dm = dist.to_static(model, loss=_Loss(), optimizer=opt, mesh=mesh)
+    (x, y) = _batches(cfg, 1)[0]
+    dm.eval()
+    loss = dm(x, y)
+    assert np.isfinite(float(loss.numpy()))
+    dm.train()
+    assert np.isfinite(float(dm(x, y).numpy()))
+
+
+def test_engine_evaluate_predict():
+    cfg, model, mesh = _mk_model_and_mesh()
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-3)
+    engine = Engine(model, loss=_Loss(), optimizer=opt)
+    engine.prepare(mesh=mesh)
+    batches = _batches(cfg, 2)
+    res = engine.evaluate(batches)
+    assert np.isfinite(res["loss"])
+    outs = engine.predict([(b[0],) for b in batches])
+    assert np.asarray(outs[0]).shape == (8, 4)
