@@ -199,7 +199,7 @@ def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
                 # loss-only (no optimizer) or eval mode: forward + loss
                 return engine.run_eval_step(*args)
             outs = engine.predict([tuple(args)])
-            return outs[0]
+            return jax.tree_util.tree_map(Tensor, outs[0])
 
         def state_dict(self, mode="all"):
             return engine.state_dict(mode)

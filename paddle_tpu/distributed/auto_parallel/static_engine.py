@@ -228,9 +228,6 @@ class Engine:
         self._params, self._opt_states, self._buffers, loss = \
             self._train_step(self._params, self._opt_states, self._buffers,
                              lr, step_i, next_key(), *arrays)
-        if getattr(self.optimizer, "_learning_rate", None) is not None and \
-                hasattr(self.optimizer._learning_rate, "step"):
-            self.optimizer._learning_rate.step()
         return Tensor(loss)
 
     def fit(self, train_data, epochs: int = 1, steps_per_epoch=None,
@@ -244,6 +241,9 @@ class Engine:
                 batch = batch if isinstance(batch, (tuple, list)) else \
                     (batch,)
                 loss = self.run_step(*batch)
+                lr_sched = getattr(self.optimizer, "_learning_rate", None)
+                if hasattr(lr_sched, "step"):
+                    lr_sched.step()
                 self.history.append(float(np.asarray(loss._value)))
                 if verbose and i % log_freq == 0:
                     print(f"[auto_parallel.Engine] epoch {epoch} "
@@ -253,26 +253,28 @@ class Engine:
         return self.history
 
     def run_eval_step(self, *batch) -> Tensor:
-        """One compiled forward+loss step (no update)."""
+        """One compiled forward(+loss when a loss_fn is set) step."""
         self._ensure_prepared()
         if self._eval_step is None:
-            self._eval_step = self._build_eval(with_loss=True)
-        loss = self._eval_step(self._params, self._buffers, next_key(),
-                               *self._stage_batch(batch))
-        return Tensor(loss)
+            self._eval_step = self._build_eval(
+                with_loss=self.loss is not None)
+        out = self._eval_step(self._params, self._buffers, next_key(),
+                              *self._stage_batch(batch))
+        return jax.tree_util.tree_map(Tensor, out) \
+            if self.loss is None else Tensor(out)
 
     def evaluate(self, eval_data, steps=None, verbose: int = 0):
+        if self.loss is None:
+            raise ValueError("Engine.evaluate requires a loss function; "
+                             "use predict() for raw outputs")
         self._ensure_prepared()
-        if self._eval_step is None:
-            self._eval_step = self._build_eval(with_loss=True)
         losses = []
         for i, batch in enumerate(eval_data):
             if steps is not None and i >= steps:
                 break
             batch = batch if isinstance(batch, (tuple, list)) else (batch,)
-            loss = self._eval_step(self._params, self._buffers, next_key(),
-                                   *self._stage_batch(batch))
-            losses.append(float(np.asarray(loss)))
+            loss = self.run_eval_step(*batch)
+            losses.append(float(np.asarray(loss._value)))
         mean = float(np.mean(losses)) if losses else float("nan")
         if verbose:
             print(f"[auto_parallel.Engine] eval loss {mean:.5f}")
@@ -329,8 +331,9 @@ class Engine:
         """Measured cost/memory of the compiled step, for the auto-tuner
         (reference static/cost/ estimates these from op tables)."""
         key = ("c", mode) + tuple(
-            (tuple(np.shape(b._value if isinstance(b, Tensor) else b)),)
-            for b in batch)
+            (tuple(np.shape(a)), str(np.asarray(a).dtype))
+            for a in ((b._value if isinstance(b, Tensor) else b)
+                      for b in batch))
         if key in self._compiled_cache:
             compiled = self._compiled_cache[key]
         else:
@@ -374,9 +377,17 @@ class Engine:
     def save(self, path: str, training: bool = True):
         from ...framework.io import save as fsave
 
-        fsave({"state_dict": {
+        blob = {"state_dict": {
             k: np.asarray(v._value if isinstance(v, Tensor) else v)
-            for k, v in self.state_dict().items()}}, path + ".pdparams")
+            for k, v in self.state_dict().items()}}
+        if training and self._opt_states is not None:
+            # training-resumable checkpoint carries the optimizer moments
+            # (reference Engine.save(training=True))
+            blob["opt_states"] = {
+                k: {sk: np.asarray(sv) for sk, sv in st.items()}
+                for k, st in self._opt_states.items()}
+            blob["opt_step_count"] = int(self.optimizer._step_count)
+        fsave(blob, path + ".pdparams")
 
     def load(self, path: str):
         from ...framework.io import load as fload
@@ -385,3 +396,15 @@ class Engine:
         self.model.set_state_dict(data["state_dict"])
         if self._params is not None:
             self.prepare()
+        if "opt_states" in data and self._opt_states is not None:
+            for k, st in data["opt_states"].items():
+                if k in self._opt_states:
+                    sh = self._params[k].sharding
+                    self._opt_states[k] = {
+                        sk: jax.device_put(jnp.asarray(sv), sh)
+                        if tuple(np.shape(sv)) == tuple(
+                            self._params[k].shape)
+                        else jnp.asarray(sv)
+                        for sk, sv in st.items()}
+            self.optimizer._step_count = int(
+                data.get("opt_step_count", self.optimizer._step_count))

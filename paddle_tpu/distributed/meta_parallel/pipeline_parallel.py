@@ -171,11 +171,11 @@ class _ChunkExecutor:
 
     def run(self, scheds, micros, split_bw: bool, scaler=None):
         """Execute per-stage schedules; returns mean loss (detached).
-        split_bw=False fuses W into B (1F1B/VPP); True defers the weight-
-        grad accumulation to W instructions (ZB). On the single controller
-        the B sweep computes both cotangent sets in one graph traversal —
-        the B/W split models the reference schedule's deferred weight-grad
-        *application*; real compute overlap belongs to the compiled path."""
+        split_bw=False fuses W into B (1F1B/VPP). split_bw=True is the
+        genuine zero-bubble split: B runs ONLY the input-grad pullback
+        (critical path, graph retained), and each W instruction runs the
+        weight-grad pullback itself — real deferred compute in the bubble
+        slot, matching pipeline_zero_bubble.py's B/W decomposition."""
         from ...core import autograd
 
         n_micro = len(micros)
@@ -222,21 +222,31 @@ class _ChunkExecutor:
                     x_in, out = acts[(mi, gv)]
                     dy = cots.pop((mi, gv), None)
                     params = self._chunk_params[gv]
-                    grads = autograd.grad(
-                        out, [x_in] + params, grad_outputs=dy,
-                        retain_graph=False, allow_unused=True)
-                    if gv > 0 and grads[0] is not None:
-                        cots[(mi, gv - 1)] = grads[0]
-                    del acts[(mi, gv)]
                     if split_bw:
-                        dws[(mi, gv)] = grads[1:]
+                        # input-grad pullback only; graph retained for W
+                        gx = autograd.grad(
+                            out, [x_in], grad_outputs=dy,
+                            retain_graph=True, allow_unused=True)
+                        if gv > 0 and gx[0] is not None:
+                            cots[(mi, gv - 1)] = gx[0]
+                        dws[(mi, gv)] = (out, dy)
                     else:
+                        grads = autograd.grad(
+                            out, [x_in] + params, grad_outputs=dy,
+                            retain_graph=False, allow_unused=True)
+                        if gv > 0 and grads[0] is not None:
+                            cots[(mi, gv - 1)] = grads[0]
                         self._accum(params, grads[1:])
+                    del acts[(mi, gv)]
                 else:  # W
                     if (mi, gv) not in dws:
                         continue
-                    self._accum(self._chunk_params[gv],
-                                dws.pop((mi, gv)))
+                    out, dy = dws.pop((mi, gv))
+                    params = self._chunk_params[gv]
+                    gw = autograd.grad(
+                        out, params, grad_outputs=dy,
+                        retain_graph=False, allow_unused=True)
+                    self._accum(params, gw)
                 ptr[s] += 1
                 pending -= 1
                 progressed = True

@@ -15,6 +15,14 @@ the scanned ring), so memory stays O(S/n) per device.
 Causality uses ABSOLUTE positions: device i's queries attend to a rotating
 KV shard whose global offset is derived from the hop index, so masks are
 exact for any n.
+
+Backward is a hand-written ring VJP (jax.custom_vjp) using the flash
+recurrences per hop: residuals are only (q, k, v, o, lse) locals — O(S/n)
+per device — and dk/dv accumulators travel around the ring with their KV
+shards, so the backward makes the same n ppermute hops as the forward
+instead of retracing the scan (reference capability: flash-attention
+backward kernels + p2p segment exchange; see also
+pipeline_zero_bubble-style decoupled grads).
 """
 from __future__ import annotations
 
@@ -58,9 +66,9 @@ def _merge(o, lse, o_new, lse_new):
     return o_merged, lse_merged
 
 
-@functools.partial(jax.checkpoint, static_argnums=(3, 4))
-def _ring_core(q, k, v, axis_name: str, causal: bool):
-    """q,k,v: [B,H,Sl,D] local shards inside shard_map over axis_name."""
+def _ring_fwd_impl(q, k, v, axis_name: str, causal: bool):
+    """q,k,v: [B,H,Sl,D] local shards inside shard_map over axis_name.
+    Returns (o normalized in q.dtype, lse [B,H,Sl] f32)."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, sl, d = q.shape
@@ -86,7 +94,70 @@ def _ring_core(q, k, v, axis_name: str, causal: bool):
         body, (o0, lse0, k.astype(jnp.float32), v.astype(jnp.float32)),
         jnp.arange(n))
     # denominator already folded into the merge weights; o is normalized
-    return o.astype(q.dtype)
+    return o.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_core(q, k, v, axis_name: str, causal: bool):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return o
+
+
+def _ring_core_fwd(q, k, v, axis_name, causal):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_core_bwd(axis_name, causal, res, do):
+    """Flash backward per hop; dk/dv accumulators ride the ring with their
+    KV shards and arrive home after n hops."""
+    q, k, v, o, lse = res
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, sl, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qpos = idx * sl + jnp.arange(sl)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    # flash 'delta': rowwise sum(do * o) — the softmax normalization term
+    delta = jnp.sum(do32 * o.astype(jnp.float32), axis=-1)
+    safe_lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    visible = jnp.isfinite(lse)
+
+    def body(carry, hop):
+        dq, kk, vv, dk, dv = carry
+        src = (idx - hop) % n
+        kpos = src * sl + jnp.arange(sl)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q32, kk) * scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        # p normalized by the FINAL lse -> exact softmax probabilities
+        p = jnp.exp(logits - safe_lse[..., None])
+        p = jnp.where(jnp.isfinite(logits) & visible[..., None], p, 0.0)
+        dv = dv + jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do32, vv)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kk) * scale
+        dk = dk + jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+        kk = jax.lax.ppermute(kk, axis_name, perm)
+        vv = jax.lax.ppermute(vv, axis_name, perm)
+        dk = jax.lax.ppermute(dk, axis_name, perm)
+        dv = jax.lax.ppermute(dv, axis_name, perm)
+        return (dq, kk, vv, dk, dv), None
+
+    zeros_kv = jnp.zeros((b, h, sl, d), jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((b, h, sl, d), jnp.float32),
+         k.astype(jnp.float32), v.astype(jnp.float32), zeros_kv, zeros_kv),
+        jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention_bhsd(q, k, v, axis_name: str = "sep",

@@ -228,3 +228,30 @@ def test_zero_bubble_with_grad_scaler_matches_unscaled():
 
     np.testing.assert_allclose(train(True), train(False),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_zero_bubble_w_instructions_do_real_pullbacks(monkeypatch):
+    """The ZB split must run the input-grad pullback at B (graph retained)
+    and the weight-grad pullback at W — not one fused grad call at B with
+    deferred application."""
+    from paddle_tpu.core import autograd as ag
+    from paddle_tpu.distributed.meta_parallel.pipeline_parallel import (
+        PipelineParallelZeroBubble)
+
+    calls = []
+    real_grad = ag.grad
+
+    def spy(outputs, inputs, *a, **kw):
+        ins = inputs if isinstance(inputs, list) else [inputs]
+        calls.append(len(ins))
+        return real_grad(outputs, ins, *a, **kw)
+
+    monkeypatch.setattr(ag, "grad", spy)
+    loss, grads = _run_engine(PipelineParallelZeroBubble)
+    assert grads, "no grads produced"
+    # B pullbacks see exactly 1 input (x_in); W pullbacks see the chunk's
+    # params (>1). Both kinds must be present, in equal numbers.
+    b_calls = [c for c in calls if c == 1]
+    w_calls = [c for c in calls if c > 1]
+    assert b_calls and w_calls and len(b_calls) == len(w_calls), \
+        (len(b_calls), len(w_calls))
