@@ -217,7 +217,9 @@ class Engine:
 
     def run_step(self, *batch) -> Tensor:
         """One compiled train step (params/opt-state live on the mesh and
-        are donated; write back to the eager model via state_dict/save)."""
+        are donated; write back to the eager model via state_dict/save).
+        LR schedulers follow the eager convention: the caller steps them
+        (fit() does it for you)."""
         self._ensure_prepared()
         if self._train_step is None:
             self._train_step = self._build_train()
@@ -331,7 +333,7 @@ class Engine:
         """Measured cost/memory of the compiled step, for the auto-tuner
         (reference static/cost/ estimates these from op tables)."""
         key = ("c", mode) + tuple(
-            (tuple(np.shape(a)), str(np.asarray(a).dtype))
+            (tuple(np.shape(a)), str(getattr(a, "dtype", type(a))))
             for a in ((b._value if isinstance(b, Tensor) else b)
                       for b in batch))
         if key in self._compiled_cache:
@@ -394,7 +396,10 @@ class Engine:
 
         data = fload(path + ".pdparams")
         self.model.set_state_dict(data["state_dict"])
-        if self._params is not None:
+        if self._params is not None or self.optimizer is not None:
+            # re-stage now so a checkpointed optimizer state can be
+            # restored below (loading before prepare() must not silently
+            # drop the moments)
             self.prepare()
         if "opt_states" in data and self._opt_states is not None:
             for k, st in data["opt_states"].items():

@@ -26,6 +26,30 @@ from . import functional as FB
 __all__ = ["to_static", "TrainStep", "in_to_static_tracing", "save", "load",
            "ignore_module", "not_to_static", "enable_to_static"]
 
+
+def _trace_break_errors():
+    """Exceptions that mean 'this Python cannot be traced' — the
+    graph-break condition. Reference: SOT (python/paddle/jit/sot/) exists
+    to eval-frame-capture exactly these cases; the TPU-native 80/20 is to
+    fall back to eager for the offending callable with a warning."""
+    import jax.errors as jerr
+
+    return (jerr.TracerBoolConversionError,
+            jerr.TracerArrayConversionError,
+            jerr.TracerIntegerConversionError,
+            jerr.ConcretizationTypeError)
+
+
+def _warn_graph_break(name: str, exc: Exception):
+    import warnings
+
+    warnings.warn(
+        f"to_static: '{name}' contains Python that cannot be traced "
+        f"({type(exc).__name__}: {str(exc).splitlines()[0][:120]}). "
+        f"Falling back to EAGER execution for this callable (graph break). "
+        f"Use jax-compatible control flow (lax.cond/where) to recover "
+        f"whole-graph compilation.", RuntimeWarning, stacklevel=3)
+
 _tracing = threading.local()
 
 
@@ -94,20 +118,41 @@ class StaticFunction:
         return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        if getattr(self, "_fallback", False):
+            return self._eager_call(*args, **kwargs)
         in_arrays = [a._value if isinstance(a, Tensor) else a for a in args]
         seed = next_key()
-        if self._is_layer:
-            if self._compiled is None:
-                self._compiled = self._build_layer_fn()
-            params = FB.current_params(self._target)
-            buffers = FB.current_buffers(self._target)
-            out, new_buf = self._compiled(params, buffers, seed, *in_arrays)
-            FB.write_back(self._target, {}, new_buf)
-        else:
-            if self._compiled is None:
-                self._compiled = self._build_fn()
-            out = self._compiled(seed, *in_arrays, **kwargs)
+        try:
+            if self._is_layer:
+                if self._compiled is None:
+                    self._compiled = self._build_layer_fn()
+                params = FB.current_params(self._target)
+                buffers = FB.current_buffers(self._target)
+                out, new_buf = self._compiled(params, buffers, seed,
+                                              *in_arrays)
+                FB.write_back(self._target, {}, new_buf)
+            else:
+                if self._compiled is None:
+                    self._compiled = self._build_fn()
+                out = self._compiled(seed, *in_arrays, **kwargs)
+        except _trace_break_errors() as e:
+            _warn_graph_break(getattr(self._target, "__name__",
+                                      type(self._target).__name__), e)
+            self._fallback = True
+            return self._eager_call(*args, **kwargs)
         return jax.tree.map(lambda x: Tensor(x), out)
+
+    def _eager_call(self, *args, **kwargs):
+        # mirror the compiled path's semantics: plain functions traced
+        # under no_grad with stop_gradient inputs stay that way eagerly
+        if self._is_layer:
+            ins = [a if isinstance(a, Tensor) else Tensor(a)
+                   for a in args]
+            return self._target(*ins, **kwargs)
+        ins = [a if isinstance(a, Tensor)
+               else Tensor(a, stop_gradient=True) for a in args]
+        with no_grad():
+            return self._target(*ins, **kwargs)
 
     # compat surface
     def concrete_program(self):
@@ -262,6 +307,8 @@ class TrainStep:
         return states
 
     def __call__(self, *batch):
+        if getattr(self, "_fallback", False):
+            return self._eager_step(*batch)
         if self._compiled is None:
             self._compiled = self._build()
         params = FB.current_params(self.model)
@@ -273,8 +320,14 @@ class TrainStep:
         seed = next_key()
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
-        new_params, new_states, new_buf, loss = self._compiled(
-            params, opt_states, buffers, lr, step_i, seed, *arrays)
+        try:
+            new_params, new_states, new_buf, loss = self._compiled(
+                params, opt_states, buffers, lr, step_i, seed, *arrays)
+        except _trace_break_errors() as e:
+            _warn_graph_break(type(self.model).__name__, e)
+            self._fallback = True
+            self.optimizer._step_count -= 1   # eager step re-counts
+            return self._eager_step(*batch)
         FB.write_back(self.model, new_params, new_buf)
         name_to_param = dict(self.model.named_parameters())
         for k, st in new_states.items():
@@ -282,6 +335,27 @@ class TrainStep:
             if p is not None:
                 self.optimizer._accumulators[id(p)] = st
         return Tensor(loss)
+
+    def _eager_step(self, *batch):
+        """Graph-break path: plain eager forward/backward/update — the
+        numerics of the compiled step without whole-graph compilation."""
+        ins = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
+        was_training = self.model.training
+        if was_training != self.train:
+            self.model.train() if self.train else self.model.eval()
+        try:
+            if self.loss_fn is not None:
+                out = self.model(*ins[:-1])
+                loss = self.loss_fn(out, ins[-1])
+            else:
+                loss = self.model(*ins)
+            loss.backward()
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+        finally:
+            if was_training != self.train:
+                self.model.train() if was_training else self.model.eval()
+        return loss.detach()
 
 
 def save(layer, path, input_spec=None, **configs):

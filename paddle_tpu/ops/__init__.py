@@ -17,6 +17,8 @@ from .random import *  # noqa: F401,F403
 from . import creation, math, manipulation, linalg, logic, search, random
 from . import optim_ops  # registers the optimizer/AMP yaml op surface
 from . import nn_compat  # registers the nn yaml op surface
+from . import yaml_extra  # framework/signal/sequence/moe/quant/... surface
+from . import vision_ops  # detection/roi/yolo surface
 from ..core.tensor import Tensor
 
 _METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search,

@@ -1,0 +1,84 @@
+"""Graph-break fallback for untraceable Python (reference: SOT,
+python/paddle/jit/sot/ — eval-frame capture with graph breaks; the
+TPU-native 80/20 is detect-the-trace-failure + eager fallback with a
+warning). A model with a data-dependent Python branch must train under
+to_static / TrainStep without user changes.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import TrainStep, to_static
+
+
+class BranchyNet(nn.Layer):
+    """Data-dependent Python control flow: untraceable under jit."""
+
+    def __init__(self):
+        super().__init__()
+        self.a = nn.Linear(8, 8)
+        self.b = nn.Linear(8, 8)
+
+    def forward(self, x):
+        h = self.a(x)
+        if float(h.mean().numpy()) > 0:      # Python branch on a value
+            h = self.b(h)
+        return h.mean(axis=-1, keepdim=True) if False else h
+
+
+def test_to_static_graph_break_warns_and_runs():
+    paddle.seed(0)
+    model = BranchyNet()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"))
+    want = np.asarray(model(x).numpy())
+    sf = to_static(lambda t: model(t))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = sf(x)
+        assert any("graph break" in str(wi.message).lower()
+                   or "eager" in str(wi.message).lower() for wi in w), \
+            [str(wi.message) for wi in w]
+    np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+    # second call takes the fallback path silently
+    out2 = sf(x)
+    np.testing.assert_allclose(np.asarray(out2.numpy()), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_trainstep_graph_break_trains():
+    paddle.seed(1)
+    model = BranchyNet()
+    opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                               learning_rate=0.1)
+    step = TrainStep(model, nn.MSELoss(), opt)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(np.zeros((16, 8), "float32"))
+    losses = []
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(8):
+            losses.append(float(step(x, y).numpy()))
+        assert any("eager" in str(wi.message).lower() for wi in w)
+    assert losses[-1] < losses[0], losses
+
+
+def test_traceable_model_stays_compiled():
+    paddle.seed(2)
+    model = nn.Linear(8, 8)
+    opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                               learning_rate=0.1)
+    step = TrainStep(model, nn.MSELoss(), opt)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.zeros((4, 8), "float32"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        step(x, y)
+        assert not any("eager" in str(wi.message).lower() for wi in w)
+    assert not getattr(step, "_fallback", False)

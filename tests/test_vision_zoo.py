@@ -1,0 +1,58 @@
+"""Model zoo: every family builds and runs a forward pass with the right
+output shape (reference: python/paddle/vision/models/ — 12 families)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _run(model, size=64, classes=10):
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, size, size)
+        .astype("float32"))
+    out = model(x)
+    assert tuple(out.shape) == (2, classes), out.shape
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+@pytest.mark.parametrize("factory,kwargs,size", [
+    (models.alexnet, {}, 64),
+    (models.vgg11, {}, 64),
+    (models.resnet18, {}, 64),
+    (models.resnext50_32x4d, {}, 64),
+    (models.wide_resnet50_2, {}, 64),
+    (models.mobilenet_v1, {"scale": 0.25}, 64),
+    (models.mobilenet_v2, {"scale": 0.35}, 64),
+    (models.mobilenet_v3_small, {"scale": 0.5}, 64),
+    (models.mobilenet_v3_large, {"scale": 0.35}, 64),
+    (models.shufflenet_v2_x0_25, {}, 64),
+    (models.shufflenet_v2_swish, {}, 64),
+    (models.squeezenet1_0, {}, 64),
+    (models.squeezenet1_1, {}, 64),
+    (models.densenet121, {}, 64),
+    (models.googlenet, {}, 64),
+    (models.inception_v3, {}, 128),
+], ids=lambda p: getattr(p, "__name__", str(p)))
+def test_zoo_forward(factory, kwargs, size):
+    _run(factory(num_classes=10, **kwargs), size=size)
+
+
+def test_vgg16_trains():
+    model = models.vgg11(num_classes=4)
+    opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                               learning_rate=0.01)
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (4,)).astype("int64"))
+    model.train()
+    losses = []
+    for _ in range(4):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
