@@ -37,9 +37,100 @@ def peak_flops_per_chip():
 
 
 def model_flops_per_token(cfg, n_params, seq):
-    # 6ND for the matmuls + attention flops 12*L*h*s (fwd+bwd, causal/2)
-    attn = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq / 2 * 2
-    return 6 * n_params + attn
+    """Standard MFU accounting (PaLM appendix B): per-token train FLOPs =
+    6N (fwd+bwd matmuls) + 12*L*h*s (attention scores+values, fwd+bwd)."""
+    return 6 * n_params + \
+        12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+
+
+def bench_resnet50(on_tpu):
+    """ResNet-50 DP images/sec (BASELINE row 'ResNet-50 ImageNet')."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        batch, size, steps = 128, 224, 8
+    else:
+        batch, size, steps = 4, 64, 2
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(parameters=model.parameters(),
+                                    learning_rate=0.1, momentum=0.9)
+    step = TrainStep(model, nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(0)
+    # stage once: feeding host arrays per step would measure the host
+    # tunnel, not the chip
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size)
+                         .astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    loss = step(x, y)
+    jax.device_get(loss._value)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    jax.device_get(loss._value)
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(batch * steps / dt, 1),
+            "batch": batch, "image_size": size,
+            "loss": float(jax.device_get(loss._value))}
+
+
+def bench_bert(on_tpu):
+    """BERT-base MLM pretrain tokens/sec/chip (BASELINE row
+    'ERNIE-3.0 / BERT-base pretrain'), bf16 autocast regime."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    if on_tpu:
+        cfg = BertConfig(dtype="bfloat16")     # bert-base
+        batch, seq, steps = 32, 512, 8
+    else:
+        from paddle_tpu.models.bert import BERT_PRESETS
+
+        cfg = BERT_PRESETS["debug"]
+        batch, seq, steps = 2, 64, 2
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+
+    class MLMLoss(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ce = nn.CrossEntropyLoss()
+
+        def forward(self, outs, labels):
+            mlm_logits = outs[0] if isinstance(outs, (tuple, list)) \
+                else outs
+            return self.ce(
+                mlm_logits.reshape([-1, cfg.vocab_size]),
+                labels.reshape([-1]))
+
+    opt = paddle.optimizer.AdamW(parameters=model.parameters(),
+                                 learning_rate=1e-4)
+    step = TrainStep(model, MLMLoss(), opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+    loss = step(ids, labels)
+    jax.device_get(loss._value)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    jax.device_get(loss._value)
+    dt = time.perf_counter() - t0
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    tps = batch * seq * steps / dt
+    mfu = tps * model_flops_per_token(cfg, n_params, seq) \
+        / peak_flops_per_chip()
+    return {"tokens_per_sec_per_chip": round(tps, 1),
+            "mfu": round(mfu, 4), "batch": batch, "seq": seq,
+            "n_params": n_params,
+            "loss": float(jax.device_get(loss._value))}
 
 
 def main():
@@ -86,11 +177,33 @@ def main():
     flops_per_token = model_flops_per_token(cfg, n_params, seq)
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / peak_flops_per_chip()
+    loss_val = float(jax.device_get(loss))
+
+    import gc
+
+    # free the ~10GB of Llama params/opt state before the next model
+    del trainer, loss
+    gc.collect()
+    jax.clear_caches()
+
+    try:
+        resnet = bench_resnet50(on_tpu)
+    except Exception as e:  # never let a secondary row kill the bench
+        resnet = {"error": str(e)[:200]}
+    gc.collect()
+    jax.clear_caches()
+    try:
+        bert = bench_bert(on_tpu)
+    except Exception as e:
+        bert = {"error": str(e)[:200]}
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
+        # single-chip Llama MFU vs the 0.45 north-star target; the target
+        # is defined for Llama-13B on v5p-128 — same metric, easier
+        # (single-chip) regime, stated here honestly as a proxy
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": {
             "mfu": round(mfu, 4),
@@ -98,9 +211,13 @@ def main():
             "batch": batch,
             "seq": seq,
             "steps": steps,
-            "loss": float(jax.device_get(loss)),
+            "loss": loss_val,
             "backend": jax.default_backend(),
             "device": str(jax.devices()[0]),
+            "vs_baseline_semantics":
+                "single-chip MFU proxy for the v5p-128 13B target",
+            "resnet50_dp": resnet,
+            "bert_base_pretrain": bert,
         },
     }))
 

@@ -144,13 +144,25 @@ class StaticFunction:
 
     def _eager_call(self, *args, **kwargs):
         # mirror the compiled path's semantics: plain functions traced
-        # under no_grad with stop_gradient inputs stay that way eagerly
+        # under no_grad with stop_gradient inputs stay that way eagerly.
+        # Only array-like args become Tensors — None/str/flags pass
+        # through untouched, as they did through the traced pytree.
+        def wrap(a, stop_grad):
+            if isinstance(a, Tensor) or a is None \
+                    or isinstance(a, (str, bool)):
+                return a
+            if hasattr(a, "__array__") or isinstance(
+                    a, (int, float, complex, list, tuple)):
+                try:
+                    return Tensor(a, stop_gradient=stop_grad)
+                except (TypeError, ValueError):
+                    return a
+            return a
+
         if self._is_layer:
-            ins = [a if isinstance(a, Tensor) else Tensor(a)
-                   for a in args]
+            ins = [wrap(a, False) for a in args]
             return self._target(*ins, **kwargs)
-        ins = [a if isinstance(a, Tensor)
-               else Tensor(a, stop_gradient=True) for a in args]
+        ins = [wrap(a, True) for a in args]
         with no_grad():
             return self._target(*ins, **kwargs)
 

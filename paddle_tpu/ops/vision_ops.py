@@ -25,6 +25,7 @@ def _reg(name, fn=None, differentiable=True, tags=("vision",)):
     def deco(f):
         f.__name__ = name
         register(name, f, differentiable=differentiable, tags=tags)
+        globals()[name] = f        # keep `from ... import *` valid
         __all__.append(name)
         return f
     if fn is not None:
@@ -444,24 +445,52 @@ def _deformable_conv(x, offset, filter, mask=None, strides=(1, 1),
         cols = jax.vmap(lambda f, yy, xx: per_img(f, yy, xx, None))(
             xp, sy, sx)
     cols = cols.reshape(B, C, kh, kw, Ho, Wo)
-    return jnp.einsum("bckhyx,ockh->boyx", cols, w)
+    if groups == 1:
+        return jnp.einsum("bckhyx,ockh->boyx", cols, w)
+    # grouped conv: filter [Co, C/groups, kh, kw]; split channels
+    cg = C // groups
+    og = Co // groups
+    colsg = cols.reshape(B, groups, cg, kh, kw, Ho, Wo)
+    wg = w.reshape(groups, og, Ci, kh, kw)
+    out = jnp.einsum("bgckhyx,gockh->bgoyx", colsg, wg)
+    return out.reshape(B, Co, Ho, Wo)
 
 
 @_reg("correlation")
 def _correlation(input1, input2, pad_size=0, kernel_size=1,
                  max_displacement=1, stride1=1, stride2=1,
                  corr_type_multiply=1):
-    """FlowNet correlation as shifted dot products."""
+    """FlowNet correlation: patch dot products of input1 against
+    displaced input2 patches (reference correlation op)."""
     a = jnp.asarray(input1, jnp.float32)
     b = jnp.asarray(input2, jnp.float32)
     B, C, H, W = a.shape
+    p = max(pad_size, max_displacement)
+    ap = jnp.pad(a, ((0, 0), (0, 0), (p, p), (p, p)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (p, p), (p, p)))
     d = max_displacement
-    bp = jnp.pad(b, ((0, 0), (0, 0), (d, d), (d, d)))
+    k = kernel_size
+    kr = k // 2
+
+    def patch_mean(x):
+        """mean over the kernel window at every position (same-size)."""
+        if k == 1:
+            return x
+        xs = jnp.pad(x, ((0, 0), (0, 0), (kr, kr), (kr, kr)))
+        acc = 0.0
+        for oy in range(k):
+            for ox in range(k):
+                acc = acc + xs[:, :, oy:oy + x.shape[2],
+                               ox:ox + x.shape[3]]
+        return acc / (k * k)
+
     outs = []
-    for dy in range(0, 2 * d + 1, stride2):
-        for dx in range(0, 2 * d + 1, stride2):
-            shifted = bp[:, :, dy:dy + H, dx:dx + W]
-            outs.append(jnp.mean(a * shifted, axis=1))
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jnp.roll(bp, (-dy, -dx), axis=(2, 3))
+            prod = patch_mean(ap * shifted)
+            outs.append(jnp.mean(
+                prod[:, :, p:p + H:stride1, p:p + W:stride1], axis=1))
     return jnp.stack(outs, axis=1)
 
 
@@ -483,6 +512,8 @@ def _multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
             if c == background_label:
                 continue
             order = jnp.argsort(-sc[c])
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
             bs = boxes[order]
             ss = sc[c][order]
             keep_idx = _nms(bs, nms_threshold)

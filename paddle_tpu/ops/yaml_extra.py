@@ -28,6 +28,7 @@ def _reg(name, fn=None, differentiable=True, tags=("yaml_extra",)):
     def deco(f):
         f.__name__ = name
         register(name, f, differentiable=differentiable, tags=tags)
+        globals()[name] = f        # keep `from ... import *` valid
         __all__.append(name)
         return f
     if fn is not None:
@@ -313,33 +314,37 @@ _reg("fft_c2r", lambda x, axes, normalization="backward", forward=False,
 
 @_reg("frame")
 def _frame(x, frame_length, hop_length, axis=-1):
+    """reference signal.frame: axis=-1 -> [..., frame_length, num_frames];
+    axis=0 -> [num_frames, frame_length, ...]."""
     x = jnp.asarray(x)
-    if axis != -1 and axis != x.ndim - 1:
-        x = jnp.moveaxis(x, axis, -1)
+    if axis == 0:
+        x = jnp.moveaxis(x, 0, -1)
     n = x.shape[-1]
     n_frames = 1 + (n - frame_length) // hop_length
     idx = (jnp.arange(frame_length)[None, :]
            + hop_length * jnp.arange(n_frames)[:, None])
     out = x[..., idx]                      # [..., n_frames, frame_length]
-    out = jnp.swapaxes(out, -1, -2)        # [..., frame_length, n_frames]
-    if axis != -1 and axis != out.ndim - 1:
-        out = jnp.moveaxis(out, -1, axis)
-    return out
+    if axis == 0:
+        return jnp.moveaxis(out, (-2, -1), (0, 1))
+    return jnp.swapaxes(out, -1, -2)       # [..., frame_length, n_frames]
 
 
 @_reg("overlap_add")
 def _overlap_add(x, hop_length, axis=-1):
+    """reference signal.overlap_add: axis=-1 input
+    [..., frame_length, num_frames]; axis=0 input
+    [frame_length, num_frames, ...]."""
     x = jnp.asarray(x)
-    if axis != -1 and axis != x.ndim - 1:
-        x = jnp.moveaxis(x, axis, -1)
+    if axis == 0:
+        x = jnp.moveaxis(x, (0, 1), (-2, -1))
     frame_length, n_frames = x.shape[-2], x.shape[-1]
     out_len = (n_frames - 1) * hop_length + frame_length
     out = jnp.zeros(x.shape[:-2] + (out_len,), x.dtype)
     for f in range(n_frames):
         out = out.at[..., f * hop_length:f * hop_length + frame_length] \
             .add(x[..., :, f])
-    if axis != -1:
-        out = jnp.moveaxis(out, -1, axis)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
     return out
 
 
@@ -965,7 +970,9 @@ def _c_reduce(op):
                 return jax.lax.pmax(x, axis_name)
             if op == "min":
                 return jax.lax.pmin(x, axis_name)
-            return jnp.exp(jax.lax.psum(jnp.log(x), axis_name))
+            # prod: gather + multiply (log-space psum would NaN on
+            # non-positive elements)
+            return jnp.prod(jax.lax.all_gather(x, axis_name), axis=0)
         return x
     return kernel
 
@@ -1037,41 +1044,66 @@ def _gru_cell(x, h, wi, wh, b_ih, b_hh):
     return (1 - z) * n + z * h
 
 
+def _run_direction(outs, h_init, c_init, wi, wh, b_ih, b_hh, mode,
+                   reverse):
+    if reverse:
+        outs = jnp.flip(outs, axis=0)
+    if mode == "LSTM":
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = _lstm_cell(xt, h, c, wi, wh, b_ih + b_hh)
+            return (h2, c2), h2
+
+        (hT, cT), ys = jax.lax.scan(step, (h_init, c_init), outs)
+    else:
+        def step(carry, xt):
+            h2 = _gru_cell(xt, carry, wi, wh, b_ih, b_hh)
+            return h2, h2
+
+        hT, ys = jax.lax.scan(step, h_init, outs)
+        cT = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, hT, cT
+
+
 @_reg("rnn")
 def _rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
          is_bidirec=False, input_size=0, hidden_size=0, num_layers=1,
          mode="LSTM", seed=0, is_test=False):
-    """Multi-layer unidirectional LSTM/GRU scan (reference rnn op; the
-    cudnn descriptor knobs collapse into lax.scan over time)."""
+    """Multi-layer (optionally bidirectional) LSTM/GRU scan (reference rnn
+    op; the cudnn descriptor knobs collapse into lax.scan over time).
+    Weight layout per direction per layer: [wi, wh, b_ih, b_hh], forward
+    then backward direction (cudnn order)."""
     x = jnp.asarray(x)                      # [T, B, I]
     ws = [jnp.asarray(w) for w in weight_list]
     per_layer = 4
+    n_dir = 2 if is_bidirec else 1
     outs = x
     hs, cs = [], []
-    h0 = jnp.asarray(pre_state[0])
+    h0 = jnp.asarray(pre_state[0])          # [L*n_dir, B, H]
     c0 = jnp.asarray(pre_state[1]) if mode == "LSTM" and \
         len(pre_state) > 1 else None
     for layer in range(num_layers):
-        wi, wh, b_ih, b_hh = ws[layer * per_layer:(layer + 1) * per_layer]
-        h_init = h0[layer]
-        if mode == "LSTM":
-            c_init = c0[layer]
-
-            def step(carry, xt):
-                h, c = carry
-                h2, c2 = _lstm_cell(xt, h, c, wi, wh, b_ih + b_hh)
-                return (h2, c2), h2
-
-            (hT, cT), outs = jax.lax.scan(step, (h_init, c_init), outs)
+        dir_outs = []
+        for d in range(n_dir):
+            slot = (layer * n_dir + d)
+            wi, wh, b_ih, b_hh = ws[slot * per_layer:
+                                    (slot + 1) * per_layer]
+            h_init = h0[slot]
+            c_init = c0[slot] if c0 is not None else None
+            ys, hT, cT = _run_direction(outs, h_init, c_init, wi, wh,
+                                        b_ih, b_hh, mode, reverse=d == 1)
+            dir_outs.append(ys)
             hs.append(hT)
-            cs.append(cT)
-        else:
-            def step(carry, xt):
-                h2 = _gru_cell(xt, carry, wi, wh, b_ih, b_hh)
-                return h2, h2
-
-            hT, outs = jax.lax.scan(step, h_init, outs)
-            hs.append(hT)
+            if cT is not None:
+                cs.append(cT)
+        outs = jnp.concatenate(dir_outs, axis=-1) if n_dir == 2 \
+            else dir_outs[0]
+        if dropout_prob and not is_test and layer != num_layers - 1:
+            keep = jax.random.bernoulli(_key(seed or 1), 1 - dropout_prob,
+                                        outs.shape)
+            outs = outs * keep / (1 - dropout_prob)
     state = (jnp.stack(hs), jnp.stack(cs)) if mode == "LSTM" \
         else (jnp.stack(hs),)
     return outs, state
