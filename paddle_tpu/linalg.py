@@ -1,7 +1,9 @@
 """paddle.linalg namespace (reference: python/paddle/linalg.py re-exports)."""
-from .ops.linalg import (cholesky, cholesky_solve, cond, corrcoef, cov, det,
+from .ops.linalg import (cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det,
                          eig, eigh, eigvals, eigvalsh, householder_product,
                          inv, lstsq, lu, lu_unpack, matmul, matrix_power,
                          matrix_rank, multi_dot, norm, pca_lowrank, pinv, qr,
-                         slogdet, solve, svd, triangular_solve, vander)
+                         matrix_exp, matrix_norm, ormqr, slogdet, solve,
+                         svd, svd_lowrank, triangular_solve, vander,
+                         vector_norm)
 from .ops.math import cross, dot

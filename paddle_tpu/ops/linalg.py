@@ -19,6 +19,8 @@ __all__ = [
     "det", "slogdet", "matrix_power", "matrix_rank", "triangular_solve",
     "cholesky_solve", "einsum", "cond", "cov", "corrcoef", "householder_product",
     "lu", "lu_unpack", "vander", "multi_dot", "tensordot", "mv",
+    "cholesky_inverse", "matrix_norm", "vector_norm", "matrix_exp",
+    "svd_lowrank", "ormqr",
 ]
 
 
@@ -270,6 +272,90 @@ def tensordot(x, y, axes=2, name=None):
         axes = axes.numpy().tolist()
     return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
                  op_name="tensordot")
+
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor (reference linalg.cholesky_inverse)."""
+    def fn(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        return jax.scipy.linalg.cho_solve((a, not upper), eye)
+    return apply(fn, x, op_name="cholesky_inverse")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(d % a.ndim for d in axis)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax,
+                                    keepdims=keepdim))
+        # move the matrix axes to the end so svd/norm see them, then put
+        # the kept dims back where they belong
+        moved = jnp.moveaxis(a, ax, (-2, -1))
+        if p == "nuc":
+            s = jnp.linalg.svd(moved, compute_uv=False)
+            out = jnp.sum(s, axis=-1)
+        elif p in (1, -1, 2, -2, jnp.inf, -jnp.inf):
+            out = jnp.linalg.norm(moved, ord=p, axis=(-2, -1))
+        else:
+            raise ValueError(f"unsupported matrix norm order {p!r}")
+        if keepdim:
+            out = out[..., None, None]
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
+    return apply(fn, x, op_name="matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == jnp.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -jnp.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax,
+                           keepdims=keepdim)
+        s = jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim)
+        return jnp.power(s, 1.0 / p)
+    return apply(fn, x, op_name="vector_norm")
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x, op_name="matrix_exp")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD of x (or x - M) — reference
+    linalg.svd_lowrank."""
+    if M is not None:
+        from .math import subtract
+
+        x = subtract(x, M)
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(q, m, n)
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, k), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_t, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_t, s, jnp.swapaxes(vh, -1, -2)
+    return apply(fn, x, op_name="svd_lowrank")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply by Q from a QR factorization (reference linalg.ormqr)."""
+    q = householder_product(x, tau)
+
+    def fn(qm, ym):
+        qq = jnp.swapaxes(qm, -1, -2) if transpose else qm
+        return qq @ ym if left else ym @ qq
+    return apply(fn, q, other, op_name="ormqr")
 
 
 for _n in __all__:

@@ -31,15 +31,24 @@ class LRScheduler:
     def get_lr(self):
         raise NotImplementedError
 
+    def state_keys(self):
+        """Set self.keys — the attributes state_dict persists (reference
+        LRScheduler.state_keys contract, optimizer/lr.py). Subclasses
+        override to persist extra state."""
+        self.keys = ["last_epoch", "last_lr"]
+
     def state_dict(self):
-        return {k: v for k, v in self.__dict__.items()
-                if isinstance(v, (int, float, bool, str, list))}
+        self.state_keys()
+        return {k: getattr(self, k) for k in self.keys
+                if hasattr(self, k)}
 
     def set_state_dict(self, state):
-        self.__dict__.update(state)
+        self.state_keys()
+        for k in self.keys:
+            if k in state:
+                setattr(self, k, state[k])
 
     set_dict = set_state_dict
-    state_keys = state_dict
 
 
 class NoamDecay(LRScheduler):
@@ -232,6 +241,12 @@ class ReduceOnPlateau(LRScheduler):
         self.last_lr = self.base_lr
         self.last_epoch = 0
 
+
+    def state_keys(self):
+        # plateau tracking must survive checkpoints (reference
+        # ReduceOnPlateau.state_keys)
+        self.keys = ["cooldown_counter", "best", "num_bad",
+                     "last_epoch", "last_lr"]
     def get_lr(self):
         return self.last_lr
 

@@ -15,7 +15,8 @@ from .. import nn
 
 __all__ = ["AbsmaxObserver", "HistObserver", "AbsMaxChannelWiseObserver",
            "FakeQuanterWithAbsMax", "QuantConfig", "QAT", "PTQ",
-           "quanter", "QuantedLinear"]
+           "quanter", "QuantedLinear", "QuantedConv2D",
+           "ConvertedQuantLinear", "save_quantized_model"]
 
 
 class _BaseObserver:
@@ -141,13 +142,63 @@ class QuantedLinear(nn.Layer):
         return F.linear(x, w, self.inner.bias)
 
 
+class QuantedConv2D(nn.Layer):
+    def __init__(self, conv, q_config=None):
+        super().__init__()
+        self.inner = conv
+        self.act_quanter = FakeQuanterWithAbsMax()
+        self.weight_quanter = FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        from ..nn import functional as F
+
+        c = self.inner
+        return F.conv2d(x, w, c.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups)
+
+
+class ConvertedQuantLinear(nn.Layer):
+    """Deploy form after QAT/PTQ convert: int8 weight + per-channel scale,
+    dequantized into the matmul (the weight_only_linear kernel)."""
+
+    def __init__(self, linear: nn.Linear, act_scale=None):
+        super().__init__()
+        import numpy as np
+
+        w = np.asarray(linear.weight._value, np.float32)
+        scale = np.abs(w).max(axis=0) / 127.0
+        self.qweight = np.clip(
+            np.round(w / np.maximum(scale, 1e-12)[None, :]),
+            -127, 127).astype(np.int8)
+        self.register_buffer("weight_scale", __import__(
+            "paddle_tpu").to_tensor(scale.astype(np.float32)))
+        self.bias = linear.bias
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        from ..ops.registry import get
+
+        out = get("weight_only_linear").fn(
+            x._value, self.qweight, None, self.weight_scale._value)
+        from ..core.tensor import Tensor
+
+        y = Tensor(out)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
 class QuantConfig:
     """reference: quantization/config.py."""
 
     def __init__(self, activation=None, weight=None):
         self.activation = activation or (lambda: FakeQuanterWithAbsMax())
         self.weight = weight or (lambda: FakeQuanterWithAbsMax())
-        self._types = {nn.Linear: QuantedLinear}
+        self._types = {nn.Linear: QuantedLinear,
+                       nn.Conv2D: QuantedConv2D}
 
     def add_layer_config(self, layers, activation=None, weight=None):
         pass
@@ -180,6 +231,24 @@ class QAT:
         return model
 
     def convert(self, model, inplace=False):
+        """Fold trained fake-quant observers into deployable int8 weights
+        (reference qat.py convert -> quantized inference program)."""
+        def fold(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, QuantedLinear):
+                    act_scale = float(sub.act_quanter.scale._value)
+                    layer._sub_layers[name] = ConvertedQuantLinear(
+                        sub.inner, act_scale=act_scale)
+                elif isinstance(sub, QuantedConv2D):
+                    # conv deploy form keeps fake-quant folded weights
+                    import jax.numpy as jnp
+
+                    w = sub.weight_quanter(sub.inner.weight)
+                    sub.inner.weight._value = jnp.asarray(w._value)
+                    layer._sub_layers[name] = sub.inner
+                else:
+                    fold(sub)
+        fold(model)
         return model
 
 
@@ -204,4 +273,28 @@ class PTQ:
         return model
 
     def convert(self, model, inplace=False):
+        """Apply observed scales: swap observed Linears to the int8 deploy
+        form (reference ptq.py convert)."""
+        name_to_obs = dict(self.observers)
+
+        def fold(layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                full = f"{prefix}.{name}" if prefix else name
+                if isinstance(sub, nn.Linear) and full in name_to_obs:
+                    obs = name_to_obs[full]
+                    scale = obs.scales()
+                    layer._sub_layers[name] = ConvertedQuantLinear(
+                        sub, act_scale=float(scale)
+                        if scale is not None else None)
+                else:
+                    fold(sub, full)
+        fold(model)
         return model
+
+
+def save_quantized_model(model, path, input_spec, **configs):
+    """Export a converted (int8-weight) model through the serving path
+    (reference: QAT export via paddle.jit.save + quant passes)."""
+    from ..inference import save_inference_model
+
+    return save_inference_model(path, model, input_spec)

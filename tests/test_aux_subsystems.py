@@ -174,11 +174,14 @@ def test_onnx_stablehlo_export(tmp_path):
     model = nn.Linear(4, 2)
     from paddle_tpu.jit.api import InputSpec
 
-    path = paddle.onnx.export(
+    prefix = paddle.onnx.export(
         model, str(tmp_path / "m"),
         input_spec=[InputSpec([1, 4], "float32")])
-    text = open(path).read()
+    text = open(prefix + ".stablehlo.mlir").read()
     assert "stablehlo" in text or "mhlo" in text or "func" in text
+    import os
+
+    assert os.path.exists(prefix + ".pdmodel")   # deployable artifact too
 
 
 def test_registry_dump():

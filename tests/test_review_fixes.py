@@ -113,3 +113,74 @@ def test_param_groups_lr_and_wd():
     assert np.allclose(a.weight.numpy(), aw)
     # group b: wd overridden to 0 -> pure sgd step
     assert np.allclose(b.weight.numpy(), bw - 0.1, rtol=1e-5)
+
+
+def test_lr_scheduler_state_keys_contract():
+    import paddle_tpu as paddle
+
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2)
+    sched.state_keys()
+    assert sched.keys == ["last_epoch", "last_lr"]
+    sd = sched.state_dict()
+    assert set(sd) <= {"last_epoch", "last_lr"}
+    sched.step()
+    sched2 = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2)
+    sched2.set_state_dict(sd)
+    assert sched2.last_epoch == sd["last_epoch"]
+
+
+def test_qat_convert_and_export(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit.api import InputSpec
+    from paddle_tpu.quantization import QAT, save_quantized_model
+
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype("float32"))
+    float_out = np.asarray(model(x).numpy())
+
+    qat = QAT()
+    qat.quantize(model)
+    for _ in range(3):          # calibrate the moving-average scales
+        model(x)
+    qat.convert(model)
+    from paddle_tpu.quantization import ConvertedQuantLinear
+
+    assert any(isinstance(m, ConvertedQuantLinear)
+               for _, m in model.named_sublayers())
+    q_out = np.asarray(model(x).numpy())
+    np.testing.assert_allclose(q_out, float_out, rtol=0.1, atol=0.15)
+
+    prefix = str(tmp_path / "qmodel")
+    save_quantized_model(model, prefix,
+                         [InputSpec([None, 8], "float32", "x")])
+    from paddle_tpu.inference import Config, create_predictor
+
+    (got,) = create_predictor(Config(prefix)).run([np.asarray(x.numpy())])
+    np.testing.assert_allclose(got, q_out, rtol=1e-3, atol=1e-3)
+
+
+def test_ptq_observe_convert():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import PTQ, ConvertedQuantLinear
+
+    paddle.seed(4)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(16, 8).astype("float32"))
+    want = np.asarray(model(x).numpy())
+    ptq = PTQ()
+    ptq.quantize(model)
+    model(x)                     # observe
+    ptq.convert(model)
+    assert any(isinstance(m, ConvertedQuantLinear)
+               for _, m in model.named_sublayers())
+    got = np.asarray(model(x).numpy())
+    np.testing.assert_allclose(got, want, rtol=0.1, atol=0.1)
