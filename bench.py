@@ -133,6 +133,48 @@ def bench_bert(on_tpu):
             "loss": float(jax.device_get(loss._value))}
 
 
+def bench_sd_unet(on_tpu):
+    """Stable-Diffusion UNet denoise throughput via the compiler path
+    (BASELINE row 'Stable-Diffusion UNet')."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+    from paddle_tpu.models.unet import UNET_PRESETS, UNetConfig, UNetModel
+
+    if on_tpu:
+        # sd-shaped, sized so eager init + compile stay in the bench
+        # budget over the tunneled chip
+        cfg = UNetConfig(base_channels=128, channel_mults=(1, 2, 4),
+                         num_res_blocks=1, attention_levels=(1, 2),
+                         num_heads=8, context_dim=768)
+        batch, hw, steps = 4, 32, 8
+    else:
+        cfg = UNET_PRESETS["debug"]
+        batch, hw, steps = 1, 16, 2
+    paddle.seed(0)
+    # construct on CPU: eager per-op param init over the device tunnel
+    # costs minutes; jit moves the params to the chip at compile
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = UNetModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 4, hw, hw).astype(np.float32))
+    t = paddle.to_tensor(np.full((batch,), 500, np.int64))
+    ctx = paddle.to_tensor(rng.randn(batch, 77, cfg.context_dim)
+                           .astype(np.float32))
+    step = to_static(lambda a, b, c: model(a, b, c))
+    out = step(x, t, ctx)
+    jax.device_get(out._value)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(x, t, ctx)
+    jax.device_get(out._value)
+    dt = time.perf_counter() - t0
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    return {"denoise_steps_per_sec": round(steps / dt, 2),
+            "latents_per_sec": round(batch * steps / dt, 2),
+            "batch": batch, "latent_hw": hw, "n_params": n_params}
+
+
 def main():
     on_tpu = jax.default_backend() in ("tpu", "axon")
     from paddle_tpu.models import llama
@@ -196,6 +238,12 @@ def main():
         bert = bench_bert(on_tpu)
     except Exception as e:
         bert = {"error": str(e)[:200]}
+    gc.collect()
+    jax.clear_caches()
+    try:
+        unet = bench_sd_unet(on_tpu)
+    except Exception as e:
+        unet = {"error": str(e)[:200]}
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -218,6 +266,7 @@ def main():
                 "single-chip MFU proxy for the v5p-128 13B target",
             "resnet50_dp": resnet,
             "bert_base_pretrain": bert,
+            "sd_unet": unet,
         },
     }))
 

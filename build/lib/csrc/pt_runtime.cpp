@@ -1,0 +1,196 @@
+// pt_runtime: native host-side runtime for paddle_tpu.
+//
+// Reference analog: the C++ pieces of the reference's host pipeline —
+// shared-memory DataLoader transport (python/paddle/io/dataloader/worker.py
+// + paddle/fluid/memory shared storage) and host trace spans
+// (paddle/fluid/platform/profiler/host_tracer.h). The TPU compute path is
+// XLA; this library covers the host side: a lock-free SPSC shared-memory
+// ring buffer so multiprocess DataLoader workers hand batches to the
+// trainer process without pickling through pipes, plus nanosecond timestamp
+// helpers for the profiler.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 pt_runtime.cpp -o libpt_runtime.so
+// (driven by paddle_tpu/utils/native.py at first use; pure-python fallback
+// exists so the framework works without a toolchain.)
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHeader {
+  std::atomic<uint64_t> head;   // next write offset (monotonic)
+  std::atomic<uint64_t> tail;   // next read offset (monotonic)
+  uint64_t capacity;            // data bytes
+  uint32_t magic;
+  uint32_t closed;
+};
+
+constexpr uint32_t kMagic = 0x50545231;  // "PTR1"
+
+struct Ring {
+  RingHeader* hdr;
+  char* data;
+  size_t map_size;
+  int fd;
+  char name[256];
+};
+
+inline uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+
+// copy n bytes into the ring at logical offset pos (wrapping)
+void ring_put(Ring* r, uint64_t pos, const char* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (n < cap - off) ? n : cap - off;
+  std::memcpy(r->data + off, src, first);
+  if (n > first) std::memcpy(r->data, src + first, n - first);
+}
+
+void ring_get(Ring* r, uint64_t pos, char* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (n < cap - off) ? n : cap - off;
+  std::memcpy(dst, r->data + off, first);
+  if (n > first) std::memcpy(dst + first, r->data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns opaque handle or null. create=1 initializes a fresh segment.
+void* pt_ring_open(const char* name, uint64_t capacity, int create) {
+  int flags = create ? (O_CREAT | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(RingHeader) + capacity;
+  if (create) {
+    if (ftruncate(fd, (off_t)total) != 0) {
+      close(fd);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(RingHeader)) {
+      close(fd);
+      return nullptr;
+    }
+    total = (size_t)st.st_size;
+    capacity = total - sizeof(RingHeader);
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Ring* r = new Ring();
+  r->hdr = reinterpret_cast<RingHeader*>(mem);
+  r->data = reinterpret_cast<char*>(mem) + sizeof(RingHeader);
+  r->map_size = total;
+  r->fd = fd;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->hdr->head.store(0);
+    r->hdr->tail.store(0);
+    r->hdr->capacity = capacity;
+    r->hdr->closed = 0;
+    r->hdr->magic = kMagic;
+  } else if (r->hdr->magic != kMagic) {
+    munmap(mem, total);
+    close(fd);
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+
+// write one length-prefixed message; blocks (sleep-polling) until space or
+// timeout_ms elapses. returns 0 ok, -1 timeout, -2 closed/oversized.
+int pt_ring_write(void* handle, const char* buf, uint64_t n,
+                  int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t need = n + 8;
+  if (need > r->hdr->capacity) return -2;
+  uint64_t deadline = now_ns() + uint64_t(timeout_ms) * 1000000ull;
+  for (;;) {
+    if (r->hdr->closed) return -2;
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (r->hdr->capacity - (head - tail) >= need) {
+      ring_put(r, head, reinterpret_cast<const char*>(&n), 8);
+      ring_put(r, head + 8, buf, n);
+      r->hdr->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (timeout_ms >= 0 && now_ns() > deadline) return -1;
+    struct timespec ts = {0, 200000};  // 0.2 ms
+    nanosleep(&ts, nullptr);
+  }
+}
+
+// peek next message size; -1 if empty.
+int64_t pt_ring_next_size(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint64_t n;
+  ring_get(r, tail, reinterpret_cast<char*>(&n), 8);
+  return (int64_t)n;
+}
+
+// read one message into buf (must be >= its size); blocks until data or
+// timeout. returns size, -1 timeout, -2 closed-and-empty.
+int64_t pt_ring_read(void* handle, char* buf, uint64_t maxn,
+                     int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t deadline = now_ns() + uint64_t(timeout_ms) * 1000000ull;
+  for (;;) {
+    uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+    uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t n;
+      ring_get(r, tail, reinterpret_cast<char*>(&n), 8);
+      if (n > maxn) return -3;
+      ring_get(r, tail + 8, buf, n);
+      r->hdr->tail.store(tail + n + 8, std::memory_order_release);
+      return (int64_t)n;
+    }
+    if (r->hdr->closed) return -2;
+    if (timeout_ms >= 0 && now_ns() > deadline) return -1;
+    struct timespec ts = {0, 200000};
+    nanosleep(&ts, nullptr);
+  }
+}
+
+void pt_ring_mark_closed(void* handle) {
+  static_cast<Ring*>(handle)->hdr->closed = 1;
+}
+
+void pt_ring_close(void* handle, int unlink_seg) {
+  Ring* r = static_cast<Ring*>(handle);
+  char name[256];
+  std::snprintf(name, sizeof(name), "%s", r->name);
+  munmap(r->hdr, r->map_size);
+  close(r->fd);
+  if (unlink_seg) shm_unlink(name);
+  delete r;
+}
+
+uint64_t pt_now_ns() { return now_ns(); }
+
+}  // extern "C"

@@ -1,0 +1,140 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built on JAX/XLA/Pallas.
+
+Import layout mirrors the reference's `import paddle` contract
+(/root/reference/python/paddle/__init__.py): the top-level module exposes
+tensor creation + all tensor ops, with nn/optimizer/io/amp/distributed as
+submodules and op methods patched onto Tensor.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# core first (reference: `from .base import core` must precede all else)
+from .core.tensor import Tensor, Parameter
+from .core import autograd as _autograd_mod
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .core.place import (
+    CPUPlace,
+    CUDAPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    set_device,
+)
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    float8_e4m3fn, float8_e5m2, int8, int16, int32, int64, uint8, uint16,
+    uint32, uint64,
+)
+
+bool = bool_  # paddle.bool
+
+# ops onto the namespace + Tensor method patching happens inside ops import
+from .ops import *  # noqa: F401,F403
+from . import ops
+
+from .framework.random import seed, get_rng_state, set_rng_state
+from . import framework
+
+from . import nn
+from . import optimizer
+from . import io
+from . import amp
+from . import autograd
+from . import jit
+from . import static
+from . import metric
+from . import vision
+from . import incubate
+from . import distributed
+from . import device
+from . import distribution
+from . import fft
+from . import signal
+from . import sparse
+from . import quantization
+from . import linalg
+from . import onnx
+from . import geometric
+from . import audio
+from . import text
+from .hapi.model import Model
+from . import hapi
+from . import profiler
+from .framework.io import save, load
+from .utils import flags as _flags
+from .utils.flags import get_flags, set_flags
+from .jit.api import to_static
+
+from .nn.layer.layers import disable_dynamic  # noqa: F401  (compat hook)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str = "tpu"):
+    return True
+
+
+def in_dynamic_mode():
+    from .jit import api as _jit_api
+
+    return not _jit_api.in_to_static_tracing()
+
+
+def grad(*args, **kwargs):
+    return _autograd_mod.grad(*args, **kwargs)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def get_default_dtype():
+    from .framework import defaults
+
+    return defaults.get_default_dtype()
+
+
+def set_default_dtype(d):
+    from .framework import defaults
+
+    return defaults.set_default_dtype(d)
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ("precision", "threshold", "edgeitems",
+                                    "linewidth")})
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(net, input_size, dtypes, input)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    return 0

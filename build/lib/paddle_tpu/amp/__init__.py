@@ -1,0 +1,184 @@
+"""AMP (reference: python/paddle/amp/ — auto_cast.py:383, grad_scaler.py:41).
+
+bf16-first: on TPU bfloat16 shares fp32's exponent range, so O1 bf16 needs no
+loss scaling and GradScaler degenerates to a pass-through (kept for fp16 and
+API parity, including dynamic scaling + inf/nan skip)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core import amp_state
+from ..core.autograd import no_grad
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "amp_decorate",
+           "is_bfloat16_supported", "is_float16_supported", "white_list",
+           "black_list"]
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True
+
+
+def white_list():
+    return {"float16": amp_state.WHITE_LIST, "bfloat16": amp_state.WHITE_LIST}
+
+
+def black_list():
+    return {"float16": amp_state.BLACK_LIST, "bfloat16": amp_state.BLACK_LIST}
+
+
+class auto_cast:
+    """Context manager: paddle.amp.auto_cast(enable, custom_white_list,
+    custom_black_list, level, dtype)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.white = custom_white_list
+        self.black = custom_black_list
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+
+    def __enter__(self):
+        self._prev = amp_state.set_amp(self.enable, self.dtype, self.level,
+                                       self.white, self.black)
+        return self
+
+    def __exit__(self, *exc):
+        amp_state.restore_amp(self._prev)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model parameters to the AMP dtype (keeping norm layers fp32,
+    reference amp.decorate semantics)."""
+    from ..nn.layer.norm import (_BatchNormBase, GroupNorm, LayerNorm,
+                                 RMSNorm)
+
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        target = convert_dtype(dtype)
+        skip = (_BatchNormBase, LayerNorm, GroupNorm, RMSNorm)
+        excluded = tuple(excluded_layers) if excluded_layers else ()
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if isinstance(layer, skip) or (
+                        excluded and isinstance(layer, excluded)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and np.issubdtype(p.dtype, np.floating):
+                        p._value = p._value.astype(target)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaler (reference: python/paddle/amp/grad_scaler.py:41).
+    With bf16 the scale stays 1.0 and scale/unscale are no-ops, but the
+    inf/nan skip logic still protects the optimizer step."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        self._unscaled = True
+        inv = 1.0 / self._scale
+        found = False
+        with no_grad():
+            for p in optimizer._parameter_list:
+                if p.grad is None:
+                    continue
+                g = p.grad._value.astype(jnp.float32) * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                if not finite:
+                    found = True
+                p.grad._value = g.astype(p.grad._value.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_scale_ratio(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good_steps = state["good_steps"]
+        self._bad_steps = state["bad_steps"]

@@ -1,0 +1,148 @@
+"""paddle.audio (reference: python/paddle/audio/ — features + functional).
+Spectrogram/MelSpectrogram/MFCC built on paddle_tpu.signal.stft."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import signal as _signal
+
+__all__ = ["features", "functional"]
+
+
+class functional:
+    @staticmethod
+    def hz_to_mel(freq, htk=False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+        f = np.asarray(freq, np.float64)
+        mel = 3 * f / 200.0
+        min_log_hz = 1000.0
+        min_log_mel = 15.0
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep, mel)
+
+    @staticmethod
+    def mel_to_hz(mel, htk=False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+        m = np.asarray(mel, np.float64)
+        f = 200.0 * m / 3.0
+        min_log_hz = 1000.0
+        min_log_mel = 15.0
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)), f)
+
+    @staticmethod
+    def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                             htk=False, norm="slaney"):
+        f_max = f_max or sr / 2
+        n_freqs = n_fft // 2 + 1
+        freqs = np.linspace(0, sr / 2, n_freqs)
+        mel_pts = np.linspace(functional.hz_to_mel(f_min, htk),
+                              functional.hz_to_mel(f_max, htk), n_mels + 2)
+        hz_pts = functional.mel_to_hz(mel_pts, htk)
+        fb = np.zeros((n_mels, n_freqs))
+        for i in range(n_mels):
+            lo, c, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+            up = (freqs - lo) / max(c - lo, 1e-10)
+            down = (hi - freqs) / max(hi - c, 1e-10)
+            fb[i] = np.maximum(0, np.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+            fb *= enorm[:, None]
+        return Tensor(fb.astype(np.float32))
+
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho"):
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+        if norm == "ortho":
+            dct[0] *= 1.0 / math.sqrt(2)
+            dct *= math.sqrt(2.0 / n_mels)
+        return Tensor(dct.astype(np.float32).T)
+
+    @staticmethod
+    def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+        def fn(s):
+            db = 10.0 * jnp.log10(jnp.maximum(s, amin))
+            db = db - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+            if top_db is not None:
+                db = jnp.maximum(db, db.max() - top_db)
+            return db
+        return apply(fn, spect, op_name="power_to_db")
+
+
+class features:
+    class Spectrogram(nn.Layer):
+        def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                     window="hann", power=2.0, center=True,
+                     pad_mode="reflect", dtype="float32"):
+            super().__init__()
+            self.n_fft = n_fft
+            self.hop_length = hop_length or n_fft // 4
+            self.power = power
+            self.center = center
+            self.pad_mode = pad_mode
+            wl = win_length or n_fft
+            if window == "hann":
+                w = np.hanning(wl + 1)[:-1]
+            elif window == "hamming":
+                w = np.hamming(wl + 1)[:-1]
+            else:
+                w = np.ones(wl)
+            self.register_buffer("window", Tensor(w.astype(np.float32)))
+
+        def forward(self, x):
+            spec = _signal.stft(x, self.n_fft, self.hop_length,
+                                window=self.window, center=self.center,
+                                pad_mode=self.pad_mode)
+            return apply(lambda s: jnp.abs(s) ** self.power, spec,
+                         op_name="spec_power")
+
+    class MelSpectrogram(nn.Layer):
+        def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                     win_length=None, window="hann", power=2.0, center=True,
+                     pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                     htk=False, norm="slaney", dtype="float32"):
+            super().__init__()
+            self.spectrogram = features.Spectrogram(
+                n_fft, hop_length, win_length, window, power, center,
+                pad_mode)
+            self.register_buffer("fbank", functional.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+        def forward(self, x):
+            spec = self.spectrogram(x)
+            return apply(lambda s, fb: jnp.einsum("...ft,mf->...mt", s, fb),
+                         spec, self.fbank, op_name="mel_spec")
+
+    class MFCC(nn.Layer):
+        def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                     n_mels=64, f_min=50.0, f_max=None, top_db=80.0,
+                     dtype="float32", **kw):
+            super().__init__()
+            self.melspectrogram = features.MelSpectrogram(
+                sr=sr, n_fft=n_fft, hop_length=hop_length, n_mels=n_mels,
+                f_min=f_min, f_max=f_max)
+            self.register_buffer("dct", functional.create_dct(n_mfcc,
+                                                              n_mels))
+            self.top_db = top_db
+
+        def forward(self, x):
+            mel = self.melspectrogram(x)
+            db = functional.power_to_db(mel, top_db=self.top_db)
+            return apply(lambda s, d: jnp.einsum("...mt,mk->...kt", s, d),
+                         db, self.dct, op_name="mfcc")
+
+
+class datasets:
+    """Offline env: no downloadable audio datasets in-tree."""

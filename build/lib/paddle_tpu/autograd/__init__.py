@@ -1,0 +1,198 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import (backward, enable_grad, grad, is_grad_enabled,
+                             no_grad, set_grad_enabled)
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian",
+           "hessian", "vjp", "jvp", "saved_tensors_hooks"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace_tensors = args
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op (reference:
+    python/paddle/autograd/py_layer.py). forward/backward are written against
+    Tensors; the tape records a node whose pullback calls the user backward.
+
+    This is the hook mechanism the distributed stack uses for TP/SP
+    scatter-gather ops (reference mp_ops.py / sequence_parallel_utils.py)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import autograd as ag
+
+        ctx = PyLayerContext()
+        with ag.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        record = ag.is_grad_enabled() and any(
+            isinstance(a, Tensor) and not a.stop_gradient
+            for a in jax.tree.leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        )
+        if not record:
+            return outputs
+
+        in_tensors = [
+            a for a in jax.tree.leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+            if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+
+        tensor_outs = [o for o in out_list if isinstance(o, Tensor)]
+
+        def vjp_fn(cot_tree):
+            cots = jax.tree.leaves(cot_tree)
+            grad_in = cls.backward(
+                ctx, *[Tensor(c, stop_gradient=True) for c in cots])
+            if not isinstance(grad_in, (tuple, list)):
+                grad_in = (grad_in,)
+            flat = [g._value if isinstance(g, Tensor) else g
+                    for g in grad_in if g is not None or True]
+            # align with in_tensors: user returns one grad per forward
+            # tensor input (reference contract)
+            out = []
+            gi = [g for g in grad_in]
+            for i, t in enumerate(in_tensors):
+                g = gi[i] if i < len(gi) else None
+                out.append(None if g is None else
+                           (g._value if isinstance(g, Tensor) else g))
+            return tuple(out)
+
+        out_avals = [jax.ShapeDtypeStruct(o._value.shape, o._value.dtype)
+                     for o in tensor_outs]
+        out_treedef = jax.tree.structure(
+            [0] * len(tensor_outs))
+        node = ag.GradNode(cls.__name__, vjp_fn, in_tensors, out_treedef,
+                           out_avals)
+        for i, o in enumerate(tensor_outs):
+            o._grad_node = node
+            o._out_index = i
+            o.stop_gradient = False
+            node.set_output(i, o)
+        return outputs
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _pure_fn(func, xs):
+    """Build a pure jax function from a Tensor->Tensor callable."""
+    def fn(*arrays):
+        with no_grad():
+            ins = [Tensor(a, stop_gradient=True) for a in arrays]
+            out = func(*ins)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value for o in out)
+        return out._value
+    return fn
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Functional jacobian: ys is a function OR output tensors are not
+    supported on the eager tape — use callable form (TPU-idiomatic)."""
+    if callable(ys):
+        func = ys
+        single = isinstance(xs, Tensor)
+        xs_list = [xs] if single else list(xs)
+        fn = _pure_fn(func, xs_list)
+        jac = jax.jacobian(fn, argnums=tuple(range(len(xs_list))))(
+            *[x._value for x in xs_list])
+        if single:
+            return Tensor(jac[0])
+        return [Tensor(j) for j in jac]
+    raise NotImplementedError(
+        "tensor-form jacobian requires create_graph; pass a callable instead")
+
+
+def hessian(func, xs, batch_axis=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    fn = _pure_fn(func, xs_list)
+    h = jax.hessian(fn, argnums=tuple(range(len(xs_list))))(
+        *[x._value for x in xs_list])
+    if single:
+        return Tensor(h[0][0])
+    return [[Tensor(hh) for hh in row] for row in h]
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    fn = _pure_fn(func, xs_list)
+    out, vjp_fn = jax.vjp(fn, *[x._value for x in xs_list])
+    if v is None:
+        cot = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else tuple(
+            t._value for t in v)
+    grads = vjp_fn(cot)
+    outs = Tensor(out) if not isinstance(out, tuple) else [
+        Tensor(o) for o in out]
+    gs = [Tensor(g) for g in grads]
+    return outs, (gs[0] if single else gs)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    fn = _pure_fn(func, xs_list)
+    primals = [x._value for x in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        vs = [v] if isinstance(v, Tensor) else list(v)
+        tangents = [t._value for t in vs]
+    out, tangent_out = jax.jvp(fn, tuple(primals), tuple(tangents))
+    outs = Tensor(out) if not isinstance(out, tuple) else [
+        Tensor(o) for o in out]
+    touts = Tensor(tangent_out) if not isinstance(tangent_out, tuple) else [
+        Tensor(t) for t in tangent_out]
+    return outs, touts

@@ -1,0 +1,3 @@
+from . import dtype, place, autograd
+from .tensor import Tensor, Parameter
+from .dispatch import apply, defop

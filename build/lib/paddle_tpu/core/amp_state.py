@@ -1,0 +1,86 @@
+"""AMP autocast state consulted by the dispatcher.
+
+Reference analog: the AMP auto-cast hook baked into every generated
+*_ad_func (paddle/fluid/eager/amp_auto_cast.h) driven by op allow/block
+lists (python/paddle/amp/amp_lists.py). bf16 is the TPU-native low
+precision: MXU-native, same exponent range as fp32, so no loss scaling is
+required at O1 (GradScaler still provided for fp16 parity).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+# ops that benefit from low precision (matmul/conv class — MXU ops)
+WHITE_LIST = {
+    "matmul", "bmm", "mm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention", "flash_attention",
+}
+
+# ops that must stay fp32 for numerics
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "reciprocal", "rsqrt", "softmax", "log_softmax", "cross_entropy",
+    "softmax_with_cross_entropy", "layer_norm", "batch_norm", "group_norm",
+    "instance_norm", "rms_norm", "mse_loss", "l1_loss", "nll_loss",
+    "binary_cross_entropy", "bce_with_logits", "kl_div", "sum", "mean",
+    "logsumexp", "norm", "cumsum", "erf", "erfinv",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = None  # np.dtype target
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_enabled():
+    return _state.enabled
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def amp_level():
+    return _state.level
+
+
+def set_amp(enabled, dtype=None, level="O1", custom_white=None,
+            custom_black=None):
+    prev = (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+            _state.custom_black)
+    _state.enabled = enabled
+    _state.dtype = dtype
+    _state.level = level
+    _state.custom_white = set(custom_white or ())
+    _state.custom_black = set(custom_black or ())
+    return prev
+
+
+def restore_amp(prev):
+    (_state.enabled, _state.dtype, _state.level, _state.custom_white,
+     _state.custom_black) = prev
+
+
+def cast_policy(op_name):
+    """Return the dtype ops' float inputs should be cast to, or None."""
+    if not _state.enabled:
+        return None
+    name = op_name or ""
+    if name in _state.custom_black or name in BLACK_LIST:
+        return np.dtype(np.float32)
+    if _state.level == "O2":
+        # O2: everything not blacklisted runs in low precision
+        return _state.dtype
+    if name in _state.custom_white or name in WHITE_LIST:
+        return _state.dtype
+    return None

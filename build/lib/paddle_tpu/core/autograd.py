@@ -1,0 +1,414 @@
+"""Define-by-run autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(/root/reference/paddle/fluid/eager/: GradNodeBase in grad_node_info.h, engine
+egr::RunBackward in backward.cc:105, GradTensorHolder accumulation). Instead of
+hand-written per-op GradNodes calling CUDA backward kernels, every recorded op
+carries a `jax.vjp`-derived pullback — so each backward node is itself an XLA
+computation and the whole tape stays on-device.
+
+The tape exists for the *eager* path and, critically, for the hook points the
+distributed stack needs (DP reducer overlap, sequence-parallel allreduce hooks
+— reference reducer.h:88, sequence_parallel_utils.py:192). The compiled
+training path (paddle_tpu.jit) bypasses the tape entirely and differentiates
+the pure traced function with jax.grad, which is the idiomatic TPU fast path.
+
+Creation order is a valid topological order for a define-by-run graph, so the
+engine processes nodes off a max-heap keyed by creation id — the same
+ready-queue discipline as the reference engine, without explicit in-degree
+bookkeeping.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "GradNode",
+    "no_grad",
+    "enable_grad",
+    "set_grad_enabled",
+    "is_grad_enabled",
+    "backward",
+    "grad",
+]
+
+_node_counter = itertools.count()
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+class set_grad_enabled:
+    """Context manager / callable mirroring paddle.set_grad_enabled."""
+
+    def __init__(self, mode: bool):
+        self.prev = _state.enabled
+        _state.enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+
+class no_grad:
+    """Both a context manager and a decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.enabled = self.prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self.prev = _state.enabled
+        _state.enabled = True
+        return self
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    vjp_fn: pullback taking the output-cotangent pytree, returning a tuple of
+        cotangents for each differentiable input tensor.
+    inputs: list of (tensor, producer_node, producer_out_index) — the
+        producer link is CAPTURED AT RECORD TIME so in-place ops that later
+        rebind tensor._grad_node (add_, setitem, collectives) cannot create
+        self-loops in the backward graph.
+    out_treedef / n_outputs: structure of the op's output so flat per-output
+        cotangents can be reassembled for vjp_fn.
+    outputs: weakrefs to the produced Tensors (for firing their grad hooks
+        exactly once, on the fully-accumulated cotangent).
+    """
+
+    __slots__ = (
+        "id",
+        "name",
+        "vjp_fn",
+        "inputs",
+        "out_treedef",
+        "out_avals",
+        "n_outputs",
+        "cotangents",
+        "released",
+        "outputs",
+        "primal_fn",
+    )
+
+    def __init__(self, name, vjp_fn, inputs, out_treedef, out_avals,
+                 primal_fn=None):
+        self.id = next(_node_counter)
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = [
+            (t, t._grad_node, t._out_index) for t in inputs
+        ]
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals  # list of jax.ShapeDtypeStruct per flat output
+        self.n_outputs = len(out_avals)
+        self.cotangents: List[Optional[jax.Array]] = [None] * self.n_outputs
+        self.released = False
+        self.outputs: List = [None] * self.n_outputs
+        # Pure function of the differentiable inputs (primal positions only),
+        # kept so create_graph=True can re-derive the pullback AS A RECORDED
+        # OP — jax.vjp of primal_fn w.r.t. (cotangent, primals) gives the
+        # second-order terms the frozen vjp_fn closure cannot (it treats the
+        # primals as constants). Reference analog: double_grad nodes emitted
+        # by eager_gen (backward.cc:105 general_grad).
+        self.primal_fn = primal_fn
+
+    def set_output(self, index, tensor):
+        import weakref
+
+        self.outputs[index] = weakref.ref(tensor)
+
+    def add_cotangent(self, index: int, value):
+        cur = self.cotangents[index]
+        self.cotangents[index] = value if cur is None else cur + value
+
+    def materialize_cotangents(self):
+        cots = []
+        for aval, c in zip(self.out_avals, self.cotangents):
+            if c is None:
+                c = jnp.zeros(aval.shape, aval.dtype)
+            cots.append(c)
+        return jax.tree.unflatten(self.out_treedef, cots)
+
+    def release(self):
+        self.vjp_fn = None
+        self.primal_fn = None
+        self.inputs = ()
+        self.cotangents = [None] * self.n_outputs
+        self.released = True
+
+    def __repr__(self):
+        return f"GradNode({self.name}, id={self.id}, n_out={self.n_outputs})"
+
+
+def _ones_like_aval(t):
+    return jnp.ones(t._value.shape, t._value.dtype)
+
+
+def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
+                target_set=None, create_graph=False):
+    """Core reverse sweep. Returns dict id(tensor)->cotangent for tensors in
+    target_set (when provided); otherwise accumulates into leaf .grad.
+
+    Routing uses the producer links captured at record time (GradNode.inputs
+    triples), never the tensor's current _grad_node — so in-place rebinding
+    can't corrupt the graph. Leaf contributions are buffered and hooks fire
+    ONCE on the fully-accumulated gradient.
+
+    create_graph=True: each node's pullback is re-derived from its primal_fn
+    and executed THROUGH THE DISPATCHER as a `grad::<op>` op whose inputs are
+    the cotangent tensors plus the node's primal inputs — so the backward
+    sweep itself lands on the tape and is differentiable again (double
+    backward). Cotangents routed in this mode are Tensors, not raw arrays."""
+    heap = []  # max-heap on node id via negation
+    in_heap = set()
+    captured = {} if target_set is not None else None
+    leaf_buf = {}  # id(tensor) -> [tensor, cot_sum]
+
+    def route(tensor, cot, producer):
+        node, out_idx = producer
+        if node is None or tensor.stop_gradient:
+            if not tensor.stop_gradient:
+                entry = leaf_buf.get(id(tensor))
+                if entry is None:
+                    leaf_buf[id(tensor)] = [tensor, cot]
+                else:
+                    entry[1] = entry[1] + cot
+            return
+        node.add_cotangent(out_idx, cot)
+        if node.id not in in_heap:
+            heapq.heappush(heap, (-node.id, node))
+            in_heap.add(node.id)
+
+    for t, g in zip(roots, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            continue
+        route(t, g, (t._grad_node, t._out_index))
+
+    while heap:
+        _, node = heapq.heappop(heap)
+        in_heap.discard(node.id)
+        if node.released:
+            raise RuntimeError(
+                f"backward through released graph at node {node.name}; "
+                "pass retain_graph=True to backward() to allow re-entry"
+            )
+        # per-output: capture + fire hooks once on the accumulated cotangent
+        for i in range(node.n_outputs):
+            cot = node.cotangents[i]
+            if cot is None:
+                continue
+            ref = node.outputs[i]
+            out_t = ref() if ref is not None else None
+            if out_t is not None:
+                if target_set is not None and id(out_t) in target_set:
+                    prev = captured.get(id(out_t))
+                    captured[id(out_t)] = cot if prev is None else prev + cot
+                for hook in out_t._hooks:
+                    new = hook(_as_hook_arg(cot))
+                    if new is not None:
+                        cot = new if create_graph else _unwrap(new)
+                node.cotangents[i] = cot
+        cot_tree = node.materialize_cotangents()
+        if create_graph:
+            input_cots = _apply_pullback_recorded(node, cot_tree)
+        else:
+            input_cots = node.vjp_fn(cot_tree)
+        inputs = node.inputs
+        if not retain_graph:
+            node.release()
+        else:
+            node.cotangents = [None] * node.n_outputs
+        for (t, pnode, pidx), c in zip(inputs, input_cots):
+            if c is None:
+                continue
+            route(t, c, (pnode, pidx))
+
+    # finalize leaves: capture + hooks once + accumulate
+    for tensor, cot in leaf_buf.values():
+        if target_set is not None and id(tensor) in target_set:
+            prev = captured.get(id(tensor))
+            captured[id(tensor)] = cot if prev is None else prev + cot
+        for hook in tensor._hooks:
+            new = hook(_as_hook_arg(cot))
+            if new is not None:
+                cot = new if create_graph else _unwrap(new)
+        if accumulate_to_grad:
+            tensor._accumulate_grad(_unwrap(cot))
+    return captured
+
+
+def _apply_pullback_recorded(node, cot_tree):
+    """Run `node`'s pullback as a recorded op (create_graph=True path).
+
+    The op's differentiable inputs are the cotangent Tensors inside cot_tree
+    plus the node's primal input tensors; its body re-derives the vjp from the
+    primal function, so jax.vjp of THIS op yields the true second-order
+    pullback (including ∂²/∂primal² terms the frozen closure drops)."""
+    from . import dispatch
+
+    if node.primal_fn is None:
+        raise NotImplementedError(
+            f"create_graph=True through op '{node.name}' is unsupported: the "
+            "node has no primal function (PyLayer/custom nodes record only a "
+            "one-shot backward). Differentiate with the functional APIs "
+            "(paddle_tpu.autograd.vjp/jacobian) instead."
+        )
+    primal_tensors = [t for (t, _, _) in node.inputs]
+    pf = node.primal_fn
+
+    def _grad_op(cot, *primals):
+        _, vjp = jax.vjp(pf, *primals)
+        return vjp(cot)
+
+    return dispatch.apply(
+        _grad_op, cot_tree, *primal_tensors, op_name=f"grad::{node.name}"
+    )
+
+
+def _as_hook_arg(cot):
+    from .tensor import Tensor
+
+    return cot if isinstance(cot, Tensor) else _wrap(cot)
+
+
+def _wrap(arr):
+    from .tensor import Tensor
+
+    return Tensor(arr, stop_gradient=True)
+
+
+def _unwrap(x):
+    from .tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else x
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward — accumulate into leaf .grad."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    seeds = []
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._value.shape)}"
+                )
+            g = _ones_like_aval(t)
+        else:
+            g = _unwrap(g)
+        seeds.append(g)
+    with no_grad():
+        _run_engine(tensors, seeds, retain_graph, accumulate_to_grad=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad — return grads of outputs w.r.t. inputs without touching
+    .grad.
+
+    create_graph=True records the backward sweep itself on the tape (each
+    pullback runs through the dispatcher as a `grad::<op>` node), so the
+    returned gradients are differentiable again — the eager double-backward
+    of the reference (`paddle.grad` via general_grad, backward.cc:105)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = bool(create_graph)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    seeds = []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            # In create_graph mode every routed cotangent must be a Tensor:
+            # a raw seed reaching GradNode.add_cotangent as `cur` would
+            # coerce a later Tensor contribution (cur + value) to a raw
+            # array and silently drop its recorded graph.
+            ones = _ones_like_aval(t)
+            seeds.append(_wrap(ones) if create_graph else ones)
+        else:
+            seeds.append(g if create_graph else _unwrap(g))
+    targets = {id(t) for t in inputs}
+    if create_graph:
+        with enable_grad():
+            captured = _run_engine(
+                outputs, seeds, retain_graph, accumulate_to_grad=False,
+                target_set=targets, create_graph=True,
+            )
+    else:
+        with no_grad():
+            captured = _run_engine(
+                outputs, seeds, retain_graph, accumulate_to_grad=False,
+                target_set=targets,
+            )
+    result = []
+    for t in inputs:
+        c = captured.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to return None for it"
+                )
+            result.append(None)
+        elif isinstance(c, Tensor):
+            result.append(c)
+        else:
+            result.append(Tensor(c, stop_gradient=True))
+    return result

@@ -1,0 +1,213 @@
+"""Op dispatch: the bridge from Tensor-level calls to XLA.
+
+Reference analog: the generated `*_ad_func` + phi-API dispatch chain
+(/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:301,
+paddle/phi/core/kernel_factory.cc:230 SelectKernelOrThrowError). There, every
+op call selects a hand-written CUDA kernel and a hand-written GradNode. Here,
+every op is a pure jax function: dispatch just unwraps Tensors, runs the
+function (XLA compiles+caches per shape under the hood), and — when autograd
+is recording — obtains the pullback with jax.vjp and records one GradNode.
+
+`apply(fn, *args, **kwargs)` is the single entry point all ops go through,
+the analog of the phi kernel-dispatch funnel.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import FLOATING, COMPLEX
+from .tensor import Tensor
+
+__all__ = ["apply", "defop", "param_capture"]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class _Capture:
+    """Records leaf requires-grad tensors (parameters) flowing through the
+    dispatcher — used by recompute to discover closure-captured params."""
+
+    active = None
+
+
+class param_capture:
+    def __enter__(self):
+        self.prev = _Capture.active
+        self.seen = {}
+        _Capture.active = self.seen
+        return self
+
+    def __exit__(self, *exc):
+        _Capture.active = self.prev
+        return False
+
+    @property
+    def params(self):
+        return list(self.seen.values())
+
+
+def _differentiable_dtype(arr) -> bool:
+    import numpy as np
+
+    d = np.dtype(arr.dtype)
+    return d in FLOATING or d in COMPLEX
+
+
+def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
+          **kwargs):
+    """Run `fn` (a pure jax function) on Tensor/array args.
+
+    Tensors anywhere in the (args, kwargs) pytree are unwrapped; if any of
+    them requires grad and grad mode is on, a GradNode with the jax.vjp
+    pullback is recorded. Output arrays are wrapped back into Tensors.
+    """
+    name = op_name or getattr(fn, "__name__", "op")
+    flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
+
+    if _Capture.active is not None:
+        for i in tensor_pos:
+            t = flat[i]
+            if not t.stop_gradient and t._grad_node is None:
+                _Capture.active[id(t)] = t
+
+    # AMP autocast hook (reference: amp_auto_cast.h in every *_ad_func)
+    from . import amp_state
+
+    target = amp_state.cast_policy(name)
+    if target is not None:
+        import numpy as np
+
+        for i in tensor_pos:
+            t = flat[i]
+            d = np.dtype(t._value.dtype)
+            if d != target and d in (np.dtype(np.float32),
+                                     np.dtype(jnp.bfloat16),
+                                     np.dtype(np.float16)):
+                flat[i] = t.astype(target)
+    record = (
+        differentiable
+        and autograd.is_grad_enabled()
+        and any(
+            not flat[i].stop_gradient and _differentiable_dtype(flat[i]._value)
+            for i in tensor_pos
+        )
+    )
+
+    if not record:
+        flat2 = [x._value if _is_tensor(x) else x for x in flat]
+        a2, k2 = jax.tree.unflatten(treedef, flat2)
+        with autograd.no_grad():
+            out = fn(*a2, **k2)
+        from ..utils import flags as _flags
+
+        if _flags.flag("check_nan_inf"):
+            check_nan_inf(name, jax.tree.leaves(out))
+        return _wrap_outputs(out, node=None)
+
+    diff_pos = [
+        i
+        for i in tensor_pos
+        if not flat[i].stop_gradient and _differentiable_dtype(flat[i]._value)
+    ]
+    diff_set = set(diff_pos)
+    base = [x._value if _is_tensor(x) else x for x in flat]
+
+    def run(*diff_arrays):
+        merged = list(base)
+        for i, arr in zip(diff_pos, diff_arrays):
+            merged[i] = arr
+        a2, k2 = jax.tree.unflatten(treedef, merged)
+        return fn(*a2, **k2)
+
+    primals = [base[i] for i in diff_pos]
+    with autograd.no_grad():
+        out, vjp_fn = jax.vjp(run, *primals)
+
+    out_flat, out_treedef = jax.tree.flatten(out)
+    from ..utils import flags as _flags
+
+    if _flags.flag("check_nan_inf"):
+        check_nan_inf(name, out_flat)
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
+    node = autograd.GradNode(
+        name,
+        vjp_fn,
+        [flat[i] for i in diff_pos],
+        out_treedef,
+        out_avals,
+        primal_fn=run,
+    )
+    wrapped_flat = [
+        Tensor(o, stop_gradient=False, _grad_node=node, _out_index=i)
+        for i, o in enumerate(out_flat)
+    ]
+    for i, t in enumerate(wrapped_flat):
+        node.set_output(i, t)
+    return jax.tree.unflatten(out_treedef, wrapped_flat)
+
+
+def _wrap_outputs(out, node):
+    out_flat, out_treedef = jax.tree.flatten(out)
+    wrapped = [Tensor(o, stop_gradient=True) for o in out_flat]
+    return jax.tree.unflatten(out_treedef, wrapped)
+
+
+def check_nan_inf(name, arrays):
+    """FLAGS_check_nan_inf debug mode (reference: paddle/common/flags.cc:72,
+    nan_inf_utils hooks in eager + new_executor). Eager-only: sync-checks
+    every op output; level>=3 reports instead of raising."""
+    import numpy as np
+
+    from ..utils import flags as _flags
+
+    for a in arrays:
+        if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype,
+                                                         jnp.inexact):
+            continue
+        if isinstance(a, jax.core.Tracer):
+            continue
+        bad = int(jax.device_get(jnp.sum(~jnp.isfinite(a))))
+        if bad:
+            msg = (f"op [{name}] output contains {bad} NaN/Inf values "
+                   f"(shape {tuple(a.shape)}, dtype {a.dtype})")
+            if int(_flags.flag("check_nan_inf_level") or 0) >= 3:
+                print("WARNING:", msg)
+            else:
+                raise FloatingPointError(msg)
+
+
+def defop(name: str = None, differentiable: bool = True):
+    """Decorator turning a pure jax function into an eager framework op.
+
+    The YAML op registry (paddle_tpu.ops.registry) records each op defined
+    this way, mirroring the single-source-of-truth role of
+    /root/reference/paddle/phi/ops/yaml/ops.yaml.
+    """
+
+    def deco(fn):
+        import functools
+
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return apply(
+                fn, *args, op_name=op_name, differentiable=differentiable,
+                **kwargs
+            )
+
+        wrapper.__wrapped_jax_fn__ = fn
+        wrapper.__op_name__ = op_name
+        from ..ops import registry
+
+        registry.register(op_name, fn, differentiable=differentiable)
+        return wrapper
+
+    return deco

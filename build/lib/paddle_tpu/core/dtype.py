@@ -1,0 +1,111 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference's dtype surface (paddle.float32 etc., see
+/root/reference/python/paddle/framework/dtype.py) but maps directly onto
+jax.numpy scalar types so arrays stay XLA-native. bfloat16 is first-class —
+it is the TPU matmul dtype (MXU-native).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical scalar types (these ARE the jnp types, so jnp ops accept them).
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "uint8": uint8,
+    "uint16": uint16,
+    "uint32": uint32,
+    "uint64": uint64,
+    "bool": bool_,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+    "half": float16,
+    "float": float32,
+    "double": float64,
+    "int": int32,
+    "long": int64,
+}
+
+FLOATING = frozenset(
+    np.dtype(t)
+    for t in (float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2)
+)
+COMPLEX = frozenset(np.dtype(t) for t in (complex64, complex128))
+
+
+# XLA on TPU runs with 64-bit types disabled (jax x64 off): int64/uint64/
+# float64 are LOGICAL dtypes that map onto their 32-bit physical forms, the
+# same way the reference runs int64 indices through 32-bit CUDA kernels when
+# safe. This keeps MXU/VPU codegen on native widths.
+_LOGICAL_64 = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp type, None) to the
+    physical np.dtype used on device."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"unknown dtype name: {dtype!r}")
+        d = np.dtype(_NAME_TO_DTYPE[dtype])
+    else:
+        d = np.dtype(dtype)
+    return _LOGICAL_64.get(d, d)
+
+
+def is_floating_point(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in FLOATING
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer)
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name

@@ -1,0 +1,156 @@
+"""paddle_tpu.device (reference: python/paddle/device/__init__.py:62,191)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (CPUPlace, Place, TPUPlace, get_device, set_device)
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_all_custom_device_type", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cinn",
+           "device_count", "synchronize", "Stream", "Event",
+           "current_stream", "stream_guard", "cuda", "xpu"]
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def get_available_device():
+    out = ["cpu"]
+    try:
+        if jax.default_backend() != "cpu":
+            out += [f"tpu:{i}" for i in range(len(jax.devices()))]
+    except Exception:
+        pass
+    return out
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if d != "cpu"]
+
+
+def device_count():
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+def is_compiled_with_cinn():
+    return False
+
+
+def synchronize(device=None):
+    """Block until all dispatched device work finishes (the analog of
+    cudaDeviceSynchronize; XLA exposes it as blocking on array readiness)."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class Stream:
+    """XLA manages its own streams; kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _CudaCompat:
+    """paddle.device.cuda compatibility namespace -> TPU."""
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def is_available():
+        return jax.default_backend() != "cpu"
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return _CudaCompat.max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return _CudaCompat.memory_allocated(device)
+
+    Stream = Stream
+    Event = Event
+
+
+cuda = _CudaCompat()
+xpu = _CudaCompat()

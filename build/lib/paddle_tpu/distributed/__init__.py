@@ -1,0 +1,53 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/)."""
+from __future__ import annotations
+
+from . import collective
+from . import env
+from . import topology
+from .collective import (P2POp, ReduceOp, all_gather, all_gather_object,
+                         all_reduce, all_to_all, all_to_all_single, barrier,
+                         batch_isend_irecv, broadcast, broadcast_object_list,
+                         destroy_process_group, gather, get_backend,
+                         get_group, irecv, isend, new_group, recv, reduce,
+                         reduce_scatter, scatter, scatter_object_list, send,
+                         stream, wait)
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized)
+from .topology import (build_mesh, get_hybrid_communicate_group, get_mesh,
+                       HybridCommunicateGroup)
+
+from . import fleet
+from . import auto_parallel
+from .auto_parallel.api import (shard_tensor, reshard, shard_layer,
+                                shard_optimizer, to_static, dtensor_from_fn,
+                                unshard_dtensor)
+from .auto_parallel.process_mesh import ProcessMesh
+from .auto_parallel.placement import (Placement, Partial, Replicate, Shard)
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict
+from .parallel import DataParallel
+from . import utils
+from . import auto_tuner
+from . import elastic
+from .watchdog import (comm_task_manager, disable_comm_watchdog,
+                       enable_comm_watchdog)
+from . import launch
+from .store import TCPStore
+from . import rpc
+from . import ps
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: paddle.distributed.spawn. Single-controller JAX drives all
+    local chips from one process, so spawn runs func once in-process with the
+    env already initialized; multi-host jobs use the launch CLI."""
+    init_parallel_env()
+    return func(*args)
+
+
+def get_trainer_endpoints():
+    return ParallelEnv().trainer_endpoints
+
+
+def get_current_endpoint():
+    return ParallelEnv().current_endpoint

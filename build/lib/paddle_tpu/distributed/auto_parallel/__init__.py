@@ -1,0 +1,6 @@
+from . import api
+from .api import (dtensor_from_fn, reshard, shard_layer, shard_optimizer,
+                  shard_tensor, to_static, unshard_dtensor)
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+from .static_engine import Engine, Strategy

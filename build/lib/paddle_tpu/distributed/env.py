@@ -1,0 +1,123 @@
+"""Process/topology environment.
+
+Reference analog: role-maker env contract (PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS, fleet/base/role_maker.py) + ParallelEnv
+(python/paddle/distributed/parallel.py).
+
+TPU-native execution model: JAX is single-controller-per-host SPMD. A
+"rank" is a host process (jax.process_index()); each process drives several
+local TPU chips, and collectives are XLA ops over the global device mesh.
+Multi-host rendezvous uses the JAX coordination service (the TCPStore
+analog), initialized from the same env contract the reference launcher sets.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def _env_int(name, default=0):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def init_parallel_env():
+    """reference: paddle.distributed.init_parallel_env. Brings up the JAX
+    distributed runtime when launched multi-process (coordinator address from
+    the launcher env), no-op single-process."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n_procs = _env_int("PADDLE_TRAINERS_NUM", 1)
+    endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    rank = _env_int("PADDLE_TRAINER_ID", 0)
+    use_jax_dist = os.environ.get("PADDLE_JAX_DISTRIBUTED", "1") != "0"
+    if n_procs > 1 and endpoints and use_jax_dist:
+        coordinator = endpoints.split(",")[0]
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=n_procs,
+                process_id=rank,
+            )
+        except Exception as e:  # already initialized or single-node sim
+            if "already" not in str(e).lower():
+                raise
+    if n_procs > 1:
+        # Eager cross-process tensor path (ProcessGroupGloo analog); the
+        # in-graph XLA collectives stay the hot path.
+        from .transport import init_transport
+
+        init_transport(rank, n_procs)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(global_rank())
+    return global_rank()
+
+
+def global_rank():
+    env_n = _env_int("PADDLE_TRAINERS_NUM", 1)
+    try:
+        # When jax.distributed is up it is authoritative; when the job is
+        # multi-process but only the TCP transport is live (CPU sim, tests),
+        # jax reports a world of 1 — trust the launcher env instead.
+        if jax.process_count() >= env_n:
+            return jax.process_index()
+    except Exception:
+        pass
+    return _env_int("PADDLE_TRAINER_ID", 0)
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    env_n = _env_int("PADDLE_TRAINERS_NUM", 1)
+    try:
+        return max(jax.process_count(), env_n)
+    except Exception:
+        return env_n
+
+
+def device_world_size():
+    """Total number of chips in the job (the SPMD 'world' the mesh spans)."""
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = global_rank()
+        self.world_size = get_world_size()
+        self.device_id = 0
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.trainer_endpoints = [
+            e for e in os.environ.get(
+                "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e
+        ]
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def dev_id(self):
+        return self.device_id

@@ -1,0 +1,19 @@
+"""paddle_tpu.distributed.fleet (reference: python/paddle/distributed/fleet/)."""
+from . import base
+from .base import DistributedStrategy, PaddleCloudRoleMaker, UserDefinedRoleMaker
+from .fleet import (barrier_worker, distributed_model, distributed_optimizer,
+                    distributed_scaler, get_hybrid_communicate_group, init,
+                    init_server, init_worker, is_first_worker, is_initialized,
+                    is_server, is_worker, run_server, server_num, stop_server,
+                    stop_worker, worker_endpoints, worker_index, worker_num)
+from . import recompute as _recompute_mod
+from .recompute import recompute, recompute_sequential
+from . import sequence_parallel_utils
+
+from .. import meta_parallel
+from . import layers
+from ..meta_parallel import (ColumnParallelLinear, ParallelCrossEntropy,
+                             RowParallelLinear, VocabParallelEmbedding)
+
+# reference exposes fleet.meta_parallel.* via fleet namespace in places
+from ..topology import CommunicateTopology, HybridCommunicateGroup

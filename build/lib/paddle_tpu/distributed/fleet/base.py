@@ -1,0 +1,142 @@
+"""DistributedStrategy (reference:
+python/paddle/distributed/fleet/base/distributed_strategy.py:175 over the
+distributed_strategy.proto). Plain-python config object with the same field
+names Fleet scripts set."""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class _Dotted(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+            "order": ["dp", "pp", "sharding", "sep", "mp"],
+            "mp_configs": _Dotted(),
+            "pp_configs": _Dotted(
+                micro_batch_size=1,
+                accumulate_steps=1,
+                schedule_mode="1F1B",
+            ),
+            "sharding_configs": _Dotted(stage=1, offload=False),
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False,
+                            "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "sharding_degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.sync_nccl_allreduce = False
+        self.heter_ccl_mode = False
+        self.auto_search = False
+        self.a_sync = False
+        self.without_graph_optimization = True
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class PaddleCloudRoleMaker:
+    """reference: fleet/base/role_maker.py — reads the launcher env.
+
+    Collective mode: rank/world from the collective env. PS mode
+    (is_collective=False): reads the reference's PS env contract —
+    TRAINING_ROLE (TRAINER|PSERVER), PADDLE_PSERVERS_IP_PORT_LIST,
+    PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID, POD_IP, PADDLE_PORT."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        import os
+
+        self._is_collective = is_collective
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_eps = [e for e in eps.split(",") if e]
+        self._trainers_num = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                                "1") or 1)
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+        self._pod_ip = os.environ.get("POD_IP", "127.0.0.1")
+        self._port = os.environ.get("PADDLE_PORT", "")
+
+    def _worker_num(self):
+        if not self._is_collective:
+            return self._trainers_num
+        from .. import env
+
+        return env.get_world_size()
+
+    def _worker_index(self):
+        if not self._is_collective:
+            return self._trainer_id
+        from .. import env
+
+        return env.global_rank()
+
+    def _is_worker(self):
+        return self._is_collective or self._role == "TRAINER"
+
+    def _is_server(self):
+        return not self._is_collective and self._role == "PSERVER"
+
+    def _server_num(self):
+        return len(self._server_eps)
+
+    def _server_endpoints(self):
+        return list(self._server_eps)
+
+    def _server_endpoint(self):
+        """This PSERVER node's own endpoint (must be one of the
+        advertised endpoints or clients will never route to it)."""
+        me = f"{self._pod_ip}:{self._port}"
+        if not self._port or (self._server_eps
+                              and me not in self._server_eps):
+            raise RuntimeError(
+                f"PSERVER endpoint {me!r} not in "
+                f"PADDLE_PSERVERS_IP_PORT_LIST={self._server_eps}; set "
+                "POD_IP/PADDLE_PORT to one of the advertised endpoints")
+        return me
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Programmatic role maker (reference fleet/base/role_maker.py
+    UserDefinedRoleMaker): pass role/endpoints directly instead of env."""
+
+    def __init__(self, is_collective=False, current_id=0, role="TRAINER",
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._role = role.upper()
+        self._trainer_id = current_id
+        self._trainers_num = worker_num
+        self._server_eps = list(server_endpoints or [])
+        if self._role == "PSERVER" and self._server_eps:
+            ep = self._server_eps[current_id % len(self._server_eps)]
+            self._pod_ip, self._port = ep.rsplit(":", 1)

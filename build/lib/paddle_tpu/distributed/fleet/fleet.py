@@ -1,0 +1,218 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py —
+init:167, distributed_model via model.py:32, distributed_optimizer:1326).
+
+fleet.init builds the hybrid topology over the device mesh; distributed_model
+picks the engine by parallel mode (TensorParallel / PipelineParallel /
+ShardingParallel / SegmentParallel / DataParallel wrapper), and
+distributed_optimizer wraps with HybridParallelOptimizer. Same dispatch
+shape as the reference, engines re-designed for XLA SPMD.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import env as _env
+from .. import topology as _topology
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from .base import DistributedStrategy
+
+_fleet_state = {
+    "initialized": False,
+    "strategy": None,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    # PS mode (reference fleet.init(role) / fleet.init(is_collective=False)
+    # under the PS env contract): stand up TheOnePs instead of the
+    # collective topology
+    import os as _os
+
+    if (role_maker is None and not is_collective
+            and _os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST")):
+        from .base import PaddleCloudRoleMaker
+
+        role_maker = PaddleCloudRoleMaker(is_collective=False)
+    if role_maker is not None and not getattr(
+            role_maker, "_is_collective", True):
+        from ..ps.the_one_ps import TheOnePs, set_runtime
+
+        rt = TheOnePs(role_maker)
+        set_runtime(rt)
+        _fleet_state.update(initialized=True,
+                            strategy=strategy or DistributedStrategy(),
+                            hcg=None, role_maker=role_maker, ps_runtime=rt)
+        return None
+    _env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    import jax
+
+    n_dev = len(jax.devices())
+    degrees = {
+        "dp": hc.get("dp_degree", 1) or 1,
+        "pp": hc.get("pp_degree", 1) or 1,
+        "sharding": hc.get("sharding_degree", 1) or 1,
+        "sep": hc.get("sep_degree", 1) or 1,
+        "mp": hc.get("mp_degree", 1) or 1,
+    }
+    import numpy as np
+
+    specified = int(np.prod(list(degrees.values())))
+    if degrees["dp"] == -1 or (specified < n_dev and degrees["dp"] == 1
+                               and specified > 1):
+        degrees["dp"] = max(n_dev // (specified // max(degrees["dp"], 1)), 1)
+    topo = CommunicateTopology(
+        ["dp", "pp", "sharding", "sep", "mp"],
+        [degrees["dp"], degrees["pp"], degrees["sharding"], degrees["sep"],
+         degrees["mp"]])
+    hcg = HybridCommunicateGroup(topo)
+    if topo.world_size() <= n_dev:
+        hcg.build_mesh()
+    _topology.set_hybrid_communicate_group(hcg)
+    _fleet_state.update(initialized=True, strategy=strategy, hcg=hcg,
+                        role_maker=None, ps_runtime=None)
+    return None
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def _ps_runtime():
+    rt = _fleet_state.get("ps_runtime")
+    if rt is None:
+        raise RuntimeError("fleet is not in parameter-server mode; "
+                           "init with a PS role maker first")
+    return rt
+
+
+def is_server():
+    rm = _fleet_state.get("role_maker")
+    return bool(rm is not None and rm._is_server())
+
+
+def is_worker():
+    rm = _fleet_state.get("role_maker")
+    return rm is None or rm._is_worker()
+
+
+def server_num():
+    rm = _fleet_state.get("role_maker")
+    return rm._server_num() if rm is not None else 0
+
+
+def init_server(*args, **kwargs):
+    _ps_runtime().init_server(*args, **kwargs)
+
+
+def run_server():
+    _ps_runtime().run_server()
+
+
+def stop_server():
+    _ps_runtime().stop_server()
+
+
+def init_worker():
+    _ps_runtime().init_worker()
+
+
+def stop_worker(stop_servers=False):
+    _ps_runtime().stop_worker(stop_servers=stop_servers)
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def _hcg() -> HybridCommunicateGroup:
+    if _fleet_state["hcg"] is None:
+        init(is_collective=True)
+    return _fleet_state["hcg"]
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:32 — dispatch on parallel mode."""
+    from ..meta_parallel import (PipelineParallel, SegmentParallel,
+                                 ShardingParallel, TensorParallel)
+    from ..parallel import DataParallel
+
+    hcg = _hcg()
+    strategy = _fleet_state["strategy"]
+    mode = hcg.get_parallel_mode()
+    if mode == "single":
+        return model
+    if mode == "data_parallel":
+        return DataParallel(model, group=hcg.get_data_parallel_group())
+    if mode == "tensor_parallel":
+        return TensorParallel(model, hcg, strategy=strategy)
+    if mode == "segment_parallel":
+        return SegmentParallel(model, hcg, strategy=strategy)
+    if mode == "sharding_parallel":
+        return ShardingParallel(model, hcg, strategy=strategy)
+    if mode == "pipeline":
+        from ..meta_parallel.pipeline_parallel import (
+            PipelineParallelWithInterleave, PipelineParallelZeroBubble)
+        from ..meta_parallel.pp_layers import PipelineLayer
+
+        pp_cfg = dict(strategy.hybrid_configs.get("pp_configs", {}) or {}) \
+            if strategy is not None else {}
+        sched = str(pp_cfg.get("schedule_mode", "1F1B")).upper()
+        v = 1
+        if isinstance(model, PipelineLayer):
+            v = model.get_num_virtual_stages()
+        if sched in ("ZBH1", "ZB-H1", "ZERO_BUBBLE"):
+            return PipelineParallelZeroBubble(model, hcg, strategy=strategy)
+        if v > 1 or sched == "VPP":
+            return PipelineParallelWithInterleave(
+                model, hcg, strategy=strategy,
+                num_virtual_pipeline_stages=max(v, 1))
+        return PipelineParallel(model, hcg, strategy=strategy)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if _fleet_state.get("ps_runtime") is not None:
+        from ..ps.the_one_ps import PSOptimizer
+
+        return PSOptimizer(optimizer, _fleet_state["ps_runtime"])
+    """reference: fleet.py:1326 -> HybridParallelOptimizer."""
+    from ..meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+
+    hcg = _hcg()
+    return HybridParallelOptimizer(
+        optimizer, hcg, _fleet_state["strategy"] or strategy)
+
+
+def distributed_scaler(scaler):
+    return scaler
+
+
+# info APIs (reference fleet.py worker_num etc.)
+def worker_num():
+    rm = _fleet_state.get("role_maker")
+    return rm._worker_num() if rm is not None else _env.get_world_size()
+
+
+def worker_index():
+    rm = _fleet_state.get("role_maker")
+    return rm._worker_index() if rm is not None else _env.global_rank()
+
+
+def is_first_worker():
+    return is_worker() and worker_index() == 0
+
+def worker_endpoints(to_string=False):
+    eps = _env.ParallelEnv().trainer_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    if _fleet_state.get("ps_runtime") is not None:
+        _ps_runtime().barrier_worker()
+        return
+    from .. import collective
+
+    collective.barrier()

@@ -1,0 +1,5 @@
+"""fleet.layers — reference namespace parity
+(python/paddle/distributed/fleet/layers/)."""
+from . import mpu
+
+__all__ = ["mpu"]

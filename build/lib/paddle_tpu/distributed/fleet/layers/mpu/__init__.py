@@ -1,0 +1,15 @@
+"""fleet.layers.mpu — model-parallel utility layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py:47,334,
+541,742 (VocabParallelEmbedding / ColumnParallelLinear / RowParallelLinear /
+ParallelCrossEntropy). Implementations live in
+paddle_tpu.distributed.meta_parallel.mp_layers (GSPMD placements instead of
+hand-rolled NCCL collectives); this package is the import-path parity shim.
+"""
+from ....meta_parallel.mp_layers import (  # noqa: F401
+    ColumnParallelLinear, ParallelCrossEntropy, RowParallelLinear,
+    VocabParallelEmbedding)
+from . import mp_ops  # noqa: F401
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy", "mp_ops"]

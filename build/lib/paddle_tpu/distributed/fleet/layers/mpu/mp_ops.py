@@ -1,0 +1,18 @@
+"""mp_ops — collective ops used by TP layers (reference
+fleet/layers/mpu/mp_ops.py: _c_identity/_c_concat/_c_split/_mp_allreduce).
+On TPU these are the mesh collectives from paddle_tpu.distributed."""
+from ....collective import (all_gather, all_reduce, reduce_scatter,
+                            scatter)  # noqa: F401
+from ....topology import get_mesh  # noqa: F401
+
+
+def _c_identity(tensor, group=None):
+    """Identity forward / allreduce backward (reference mp_ops.py). Under
+    GSPMD the backward allreduce is inserted by XLA from the shardings."""
+    return tensor
+
+
+def _mp_allreduce(tensor, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    all_reduce(tensor, group=group)
+    return tensor

@@ -1,0 +1,89 @@
+"""Recompute / activation checkpointing.
+
+Reference analog: RecomputeFunction PyLayer + recompute_sequential
+(fleet/recompute/recompute.py:109,403,567) with an RNG-state tracker for TP
+determinism. TPU-native: the segment is traced as a pure function of
+(explicit tensor args + every parameter the segment touches — discovered via
+the dispatcher's param-capture hook) and wrapped in jax.checkpoint, so its
+vjp recomputes the forward instead of keeping residuals. RNG determinism
+between the two passes comes from replaying the same functional key — no
+CUDA RNG state juggling."""
+from __future__ import annotations
+
+import jax
+
+from ...core import autograd
+from ...core.dispatch import apply, param_capture
+from ...core.tensor import Tensor
+from ...framework.random import next_key, rng_guard
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+
+    if not autograd.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    in_tensors = [a for a in args if isinstance(a, Tensor)]
+    key = next_key()
+
+    # discovery pass: find closure-captured parameters (runs the segment
+    # once without recording; its FLOPs are the price of recompute anyway)
+    with autograd.no_grad(), rng_guard(key), param_capture() as cap:
+        function(*args, **kwargs)
+    params = cap.params
+    # exclude explicit inputs from the captured set
+    explicit = {id(t) for t in in_tensors}
+    params = [p for p in params if id(p) not in explicit]
+
+    all_inputs = in_tensors + params
+
+    def pure(*arrays):
+        arg_arrays = arrays[: len(in_tensors)]
+        param_arrays = arrays[len(in_tensors):]
+        it = iter(arg_arrays)
+        new_args = [Tensor(next(it), stop_gradient=True)
+                    if isinstance(a, Tensor) else a for a in args]
+        originals = [p._value for p in params]
+        try:
+            for p, arr in zip(params, param_arrays):
+                p._value = arr
+            with autograd.no_grad(), rng_guard(key):
+                out = function(*new_args, **kwargs)
+        finally:
+            for p, orig in zip(params, originals):
+                p._value = orig
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value
+
+    ckpt_fn = jax.checkpoint(pure)
+    return apply(ckpt_fn, *all_inputs, op_name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference :567 — recompute over a Sequential in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    if hasattr(functions, "_sub_layers"):
+        functions = list(functions._sub_layers.values())
+    n = len(functions)
+    seg_size = max(n // max(segments, 1), 1)
+
+    def run_segment(lo, hi):
+        def seg_fn(x):
+            for f in functions[lo:hi]:
+                x = f(x)
+            return x
+        return seg_fn
+
+    x = args[0]
+    lo = 0
+    while lo < n:
+        hi = min(lo + seg_size, n)
+        x = recompute(run_segment(lo, hi), x, **kwargs)
+        lo = hi
+    return x

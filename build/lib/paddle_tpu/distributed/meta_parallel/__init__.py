@@ -1,0 +1,16 @@
+from .engines import (MetaParallelBase, SegmentParallel, ShardingParallel,
+                      TensorParallel)
+from .hybrid_optimizer import HybridParallelOptimizer
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from . import pipeline_schedules
+from .pipeline_parallel import (PipelineParallel,
+                                PipelineParallelWithInterleave,
+                                PipelineParallelZeroBubble, spmd_pipeline,
+                                spmd_pipeline_interleaved)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .sharding_optimizer import (DygraphShardingOptimizer,
+                                 DygraphShardingOptimizerV2,
+                                 GroupShardedOptimizerStage2,
+                                 GroupShardedStage2, GroupShardedStage3,
+                                 group_sharded_parallel)

@@ -1,0 +1,88 @@
+"""Hybrid-parallel engines (reference: fleet/meta_parallel/
+tensor_parallel.py, sharding_parallel.py, segment_parallel.py —
+MetaParallelBase wrappers that sync params and scope the model for the
+topology)."""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from .. import collective
+
+__all__ = ["MetaParallelBase", "TensorParallel", "ShardingParallel",
+           "SegmentParallel"]
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._prepare_for_model()
+
+    def _prepare_for_model(self):
+        pass
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def sublayers(self, include_self=False):
+        return self._layers.sublayers(include_self)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class TensorParallel(MetaParallelBase):
+    """reference: fleet/meta_parallel/tensor_parallel.py. Param broadcast
+    within mp group happens implicitly: mp-sharded params are global arrays;
+    replicated ones are single-copy by construction (single controller)."""
+
+    def _prepare_for_model(self):
+        # in multi-controller mode, broadcast non-distributed params so all
+        # mp ranks agree (reference broadcast_mp_parameters)
+        if collective.get_world_size(self._hcg.get_model_parallel_group()) \
+                > 1 and not _single_controller():
+            for p in self._layers.parameters():
+                if not getattr(p, "is_distributed", False):
+                    collective.broadcast(
+                        p, src=self._hcg.get_model_parallel_group().ranks[0],
+                        group=self._hcg.get_model_parallel_group())
+
+
+def _single_controller():
+    import jax
+
+    try:
+        return jax.process_count() == 1
+    except Exception:
+        return True
+
+
+class ShardingParallel(MetaParallelBase):
+    """Model wrapper for sharding-only topology (the optimizer does the
+    actual state partitioning — see sharding_optimizer.py)."""
+
+
+class SegmentParallel(MetaParallelBase):
+    """Context/sequence parallel engine (reference:
+    fleet/meta_parallel/segment_parallel.py:26). Inputs arrive with the
+    sequence dim sharded over the 'sep' axis; attention uses ring attention
+    over sep (paddle_tpu.ops.pallas.ring_attention via
+    nn.functional.scaled_dot_product_attention when inside shard_map)."""

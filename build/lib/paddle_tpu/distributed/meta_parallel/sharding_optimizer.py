@@ -1,0 +1,185 @@
+"""ZeRO sharding stages 1-3.
+
+Reference analog: DygraphShardingOptimizer(V2)
+(fleet/meta_optimizers/dygraph_optimizer/dygraph_sharding_optimizer.py:44,566)
+and the group_sharded stack (GroupShardedOptimizerStage2 / Stage2 / Stage3,
+fleet/meta_parallel/sharding/group_sharded_*.py) — manual param-to-rank
+assignment, reduce-scatter of grads, broadcast of updated params, h2d
+prefetch for stage-3.
+
+TPU-native collapse: sharding is a *placement*, not a protocol.
+- stage 1/2: optimizer-state (and grad) arrays get a NamedSharding over the
+  'sharding' mesh axis — each chip stores 1/N of m/v. The fused optimizer
+  update is compiled by XLA with reduce-scatter + all-gather inserted and
+  overlapped automatically.
+- stage 3: parameters themselves are sharded over 'sharding'; XLA
+  all-gathers just-in-time at each use and frees afterwards (the FSDP
+  gather/release loop, scheduled by the compiler instead of Python hooks).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+from ..topology import get_mesh
+
+__all__ = ["DygraphShardingOptimizer", "DygraphShardingOptimizerV2",
+           "GroupShardedOptimizerStage2", "GroupShardedStage2",
+           "GroupShardedStage3", "group_sharded_parallel", "shard_sharding_spec"]
+
+
+def shard_sharding_spec(shape, axis_name="sharding", mesh=None):
+    """Pick the largest dim divisible by the axis size to shard; None if no
+    dim divides."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        return None
+    n = mesh.shape[axis_name]
+    if n <= 1 or not shape:
+        return None
+    order = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for dim in order:
+        if shape[dim] % n == 0:
+            entries = [None] * len(shape)
+            entries[dim] = axis_name
+            return PartitionSpec(*entries)
+    return None
+
+
+def _shard_array(arr, axis_name="sharding"):
+    mesh = get_mesh()
+    if mesh is None or isinstance(arr, jax.core.Tracer):
+        return arr
+    spec = shard_sharding_spec(arr.shape, axis_name, mesh)
+    if spec is None:
+        return arr
+    try:
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+    except Exception:
+        return arr
+
+
+class DygraphShardingOptimizer:
+    """Wraps an inner optimizer; states (stages>=1) and params (stage 3)
+    carry 'sharding'-axis placements."""
+
+    def __init__(self, optimizer: Optimizer, hcg=None, stage: int = 1):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self.stage = stage
+        self._sharded_states = False
+        if stage >= 3:
+            self._shard_params()
+        self._wrap_init_state()
+
+    def _shard_params(self):
+        for p in self._inner_opt._parameter_list:
+            p._value = _shard_array(p._value)
+
+    def _wrap_init_state(self):
+        inner = self._inner_opt
+        orig_init = inner._init_state
+
+        def sharded_init(p):
+            st = orig_init(p)
+            return {k: _shard_array(v) for k, v in st.items()}
+
+        inner._init_state = sharded_init
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        if self.stage >= 2:
+            for p in self._inner_opt._parameter_list:
+                if p.grad is not None:
+                    p.grad._value = _shard_array(p.grad._value)
+        self._inner_opt.step()
+        if self.stage >= 3:
+            self._shard_params()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self._inner_opt.set_state_dict(state)
+
+
+DygraphShardingOptimizerV2 = DygraphShardingOptimizer
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """reference: group_sharded_optimizer_stage2.py:53."""
+
+    def __init__(self, params, optim, group=None, offload=False, device=None,
+                 **kw):
+        super().__init__(optim, stage=2)
+
+
+class _GroupShardedModel:
+    def __init__(self, layer, stage):
+        self._layer = layer
+        for p in layer.parameters():
+            if stage >= 3:
+                p._value = _shard_array(p._value)
+
+    def __call__(self, *a, **k):
+        return self._layer(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+class GroupShardedStage2(_GroupShardedModel):
+    """reference: group_sharded_stage2.py:46."""
+
+    def __init__(self, layer, sharding_optimizer=None, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kw):
+        super().__init__(layer, stage=2)
+
+
+class GroupShardedStage3(_GroupShardedModel):
+    """reference: group_sharded_stage3.py:85 — param shard + JIT gather.
+    On TPU the just-in-time all-gather + release is XLA's job once params
+    carry the sharding placement."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 device=None, segment_size=2 ** 20, pertrain_sync_models=True,
+                 offload=False, **kw):
+        super().__init__(layer, stage=3)
+        self._optim = optimizer
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """reference: python/paddle/distributed/sharding/group_sharded.py."""
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer, stage=1)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(None, optimizer)
+        mdl = GroupShardedStage2(model, opt)
+        return mdl, opt, scaler
+    if level == "p_g_os":
+        opt = DygraphShardingOptimizer(optimizer, stage=3)
+        mdl = GroupShardedStage3(model, opt)
+        return mdl, opt, scaler
+    raise ValueError(f"unknown group_sharded level {level}")

@@ -1,0 +1,73 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:202 +
+EagerReducer reducer.h:88).
+
+TPU-native: in the compiled path DP is a mesh axis — the batch is sharded,
+params replicated, and XLA inserts+overlaps the gradient psum (that IS the
+EagerReducer's bucketed-overlap job, done by the compiler). This wrapper
+keeps the reference API: it broadcasts initial params across the dp group
+and registers grad hooks that allreduce in eager multi-controller mode."""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import collective, env
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._world = collective.get_world_size(group)
+        if self._world > 1:
+            self._sync_params()
+            self._register_hooks()
+
+    def _sync_params(self):
+        for p in self._layers.parameters():
+            collective.broadcast(p, src=0, group=self.group)
+
+    def _register_hooks(self):
+        world = self._world
+        group = self.group
+
+        def make_hook():
+            def hook(grad):
+                collective.all_reduce(grad, group=group)
+                return grad / world
+            return hook
+
+        for p in self._layers.parameters():
+            if not p.stop_gradient:
+                p.register_hook(make_hook())
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    @property
+    def _inner_layers(self):
+        return self._layers
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            yield
+        return guard()

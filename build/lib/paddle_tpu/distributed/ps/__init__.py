@@ -1,0 +1,15 @@
+"""Parameter-server stack (reference: paddle/fluid/distributed/ps/ C++ +
+python/paddle/distributed/ps/ — brpc services, sharded tables, async
+communicator, the_one_ps runtime), rebuilt host-native for TPU clusters:
+TCP services over the framework's socket framing, numpy host tables, and a
+DistributedEmbedding whose device side only ever sees the batch's unique
+rows (the TPU-friendly contract — HBM never holds the table)."""
+from .client import AsyncCommunicator, PsClient
+from .server import PsServer
+from .table import DenseTable, SparseTable
+from .the_one_ps import (DistributedEmbedding, PSOptimizer, TheOnePs,
+                         get_runtime)
+
+__all__ = ["PsServer", "PsClient", "AsyncCommunicator", "SparseTable",
+           "DenseTable", "TheOnePs", "DistributedEmbedding", "PSOptimizer",
+           "get_runtime"]

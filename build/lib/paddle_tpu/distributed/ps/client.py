@@ -1,0 +1,229 @@
+"""Parameter-server client + async communicator.
+
+Reference analogs:
+- BrpcPsClient (paddle/fluid/distributed/ps/service/brpc_ps_client.h):
+  routes keys to table shards, batches pull/push RPCs.
+- Communicator (paddle/fluid/distributed/ps/service/communicator/
+  communicator.h) — the async-SGD engine: trainer-side background thread
+  aggregating gradients and flushing them to servers on an interval
+  (a_sync mode), or accumulating local deltas and syncing every k steps
+  (geo mode).
+
+Key routing is ``key % num_servers`` over the sorted endpoint list — all
+clients and the embedding layer agree on the layout.
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..store import _recv_msg, _send_msg
+from .wire import decode_msg, encode_msg
+
+__all__ = ["PsClient", "AsyncCommunicator"]
+
+
+class _Conn:
+    """One persistent connection; a lock serializes request/response."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.lock = threading.Lock()
+
+    def call(self, req: dict) -> dict:
+        with self.lock:
+            _send_msg(self.sock, *encode_msg(req))
+            parts = _recv_msg(self.sock)
+        resp = decode_msg(parts)
+        if isinstance(resp, dict) and "err" in resp:
+            raise RuntimeError(f"ps server error: {resp['err']}")
+        return resp
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    def __init__(self, endpoints: Sequence[str]):
+        self.endpoints = sorted(endpoints)
+        self._conns: List[_Conn] = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._conns.append(_Conn(host, int(port)))
+        self.n = len(self._conns)
+        self._barrier_seq: Dict[str, int] = {}
+
+    # -- table management -------------------------------------------------
+    def create_sparse_table(self, table_id: int, dim: int, rule="sgd",
+                            **rule_kw):
+        cfg = {"dim": dim, "rule": rule, **rule_kw}
+        for c in self._conns:
+            c.call({"op": "create_table", "table_id": table_id,
+                    "kind": "sparse", "cfg": cfg})
+
+    def create_dense_table(self, table_id: int, shape, rule="sgd",
+                           **rule_kw):
+        # dense tables live whole on server 0 (reference: dense params are
+        # range-sharded; a single block keeps the host copy authoritative)
+        self._conns[0].call({"op": "create_table", "table_id": table_id,
+                             "kind": "dense",
+                             "cfg": {"shape": tuple(shape), "rule": rule,
+                                     **rule_kw}})
+
+    # -- sparse ------------------------------------------------------------
+    def _route(self, keys: np.ndarray):
+        keys = np.asarray(keys, np.int64).ravel()
+        shard = (keys % self.n).astype(np.int64)
+        return keys, shard
+
+    def pull_sparse(self, table_id: int, keys) -> np.ndarray:
+        keys, shard = self._route(keys)
+        out: Optional[np.ndarray] = None
+        for s in range(self.n):
+            idx = np.nonzero(shard == s)[0]
+            if idx.size == 0:
+                continue
+            rows = self._conns[s].call(
+                {"op": "pull_sparse", "table_id": table_id,
+                 "keys": keys[idx]})["rows"]
+            if out is None:
+                out = np.empty((len(keys), rows.shape[1]), np.float32)
+            out[idx] = rows
+        return out if out is not None \
+            else np.empty((0, 0), np.float32)
+
+    def push_sparse(self, table_id: int, keys, grads: np.ndarray):
+        keys, shard = self._route(keys)
+        grads = np.asarray(grads, np.float32)
+        for s in range(self.n):
+            idx = np.nonzero(shard == s)[0]
+            if idx.size == 0:
+                continue
+            self._conns[s].call(
+                {"op": "push_sparse", "table_id": table_id,
+                 "keys": keys[idx], "grads": grads[idx]})
+
+    def table_size(self, table_id: int) -> int:
+        return sum(c.call({"op": "table_size",
+                           "table_id": table_id})["size"]
+                   for c in self._conns)
+
+    # -- dense -------------------------------------------------------------
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._conns[0].call(
+            {"op": "pull_dense", "table_id": table_id})["value"]
+
+    def set_dense(self, table_id: int, value: np.ndarray):
+        self._conns[0].call({"op": "set_dense", "table_id": table_id,
+                             "value": np.asarray(value, np.float32)})
+
+    def push_dense(self, table_id: int, grad: np.ndarray):
+        self._conns[0].call({"op": "push_dense", "table_id": table_id,
+                             "grad": np.asarray(grad, np.float32)})
+
+    # -- control ------------------------------------------------------------
+    def save(self, path_prefix: str):
+        for i, c in enumerate(self._conns):
+            c.call({"op": "save", "path": f"{path_prefix}.shard{i}"})
+
+    def load(self, path_prefix: str):
+        for i, c in enumerate(self._conns):
+            c.call({"op": "load", "path": f"{path_prefix}.shard{i}"})
+
+    def barrier(self, name: str, world: int, timeout: float = 60.0):
+        # per-name generation counter: every participant calls barriers in
+        # program order, so the k-th barrier of `name` on every worker maps
+        # to the same server-side key (fresh counter per generation)
+        seq = self._barrier_seq.get(name, 0) + 1
+        self._barrier_seq[name] = seq
+        self._conns[0].call({"op": "barrier", "name": name, "gen": seq,
+                             "world": world, "arrive": True})
+        t0 = time.time()
+        while True:
+            if self._conns[0].call({"op": "barrier", "name": name,
+                                    "gen": seq, "world": world})["done"]:
+                return
+            if time.time() - t0 > timeout:
+                raise TimeoutError(f"ps barrier {name!r} timed out")
+            time.sleep(0.01)
+
+    def stop_servers(self):
+        for c in self._conns:
+            try:
+                c.call({"op": "stop"})
+            except (RuntimeError, ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+
+
+class AsyncCommunicator:
+    """Trainer-side async-SGD engine (reference Communicator::Start —
+    send-queue draining thread). push_sparse calls enqueue; the worker
+    aggregates by (table, key) within a send window and flushes every
+    `send_interval_s` or `send_queue_size` batches — the a_sync mode knobs
+    from the reference's DistributedStrategy."""
+
+    def __init__(self, client: PsClient, send_interval_s: float = 0.01,
+                 send_queue_size: int = 16):
+        self.client = client
+        self.interval = send_interval_s
+        self.max_batch = send_queue_size
+        self._q: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="ps_communicator")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.flush()
+
+    def push_sparse(self, table_id: int, keys, grads):
+        self._q.put((table_id, np.asarray(keys, np.int64).ravel(),
+                     np.asarray(grads, np.float32)))
+        if self._q.qsize() >= self.max_batch:
+            self.flush()       # backpressure: send on the caller thread
+
+    def flush(self):
+        """Drain + aggregate + send everything queued (synchronous)."""
+        pending: Dict[int, list] = {}
+        while True:
+            try:
+                tid, keys, grads = self._q.get_nowait()
+            except queue.Empty:
+                break
+            pending.setdefault(tid, []).append((keys, grads))
+        for tid, items in pending.items():
+            keys = np.concatenate([k for k, _ in items])
+            grads = np.concatenate([g for _, g in items])
+            # pre-aggregate duplicates so the wire carries unique keys
+            uniq, inv = np.unique(keys, return_inverse=True)
+            agg = np.zeros((len(uniq), grads.shape[1]), np.float32)
+            np.add.at(agg, inv, grads)
+            self.client.push_sparse(tid, uniq, agg)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            if self._q.qsize() >= 1:
+                self.flush()
+        self.flush()

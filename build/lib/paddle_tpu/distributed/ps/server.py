@@ -1,0 +1,170 @@
+"""Parameter-server service.
+
+Reference analog: BrpcPsServer (paddle/fluid/distributed/ps/service/
+brpc_ps_server.h) — a PS node hosting table shards and serving
+pull/push/save/load RPCs from trainer clients.
+
+TPU-native: brpc is replaced by the framework's TCP message framing (the
+TCPStore/rpc layer); the protocol is safe JSON+ndarray messages
+(op, table_id, payload — see wire.py), matching the reference's use of
+non-executable protobuf payloads. One server == one shard; clients route
+sparse keys by ``key % num_servers`` (the reference's hash routing in
+BrpcPsClient). The listener binds to the advertised pod IP
+(POD_IP / PADDLE_LOCAL_IP) rather than all interfaces unless the caller
+asks for 0.0.0.0 explicitly.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..store import _recv_msg, _send_msg
+from .table import DenseTable, SparseTable
+from .wire import decode_msg, dump_obj, encode_msg, load_obj
+
+__all__ = ["PsServer", "default_bind_host"]
+
+
+def default_bind_host() -> str:
+    """Bind address for PS/RPC listeners: the pod's advertised IP when the
+    launcher set one, else loopback — never 0.0.0.0 implicitly."""
+    return os.environ.get("POD_IP") or os.environ.get("PADDLE_LOCAL_IP") \
+        or "127.0.0.1"
+
+
+class PsServer:
+    """Hosts this shard's tables and serves client RPCs on a TCP port."""
+
+    def __init__(self, host: str = "", port: int = 0):
+        host = host or default_bind_host()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._tables: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # name -> [generation, arrival_count]; only the latest generation
+        # per name is kept (clients hit barriers in program order, so an
+        # arrival at gen k proves every gen < k completed) — bounded memory
+        self._barriers: Dict[str, list] = {}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"ps_server:{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def run(self):
+        """Blocking serve (reference: run_server); returns on stop()."""
+        if self._thread is None:
+            self.start()
+        self._stop.wait()
+
+    # -- serving ----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                parts = _recv_msg(conn)
+                try:
+                    req = decode_msg(parts)
+                    resp = self._handle(req)
+                except Exception as e:      # fault isolation per request
+                    req = {}
+                    resp = {"err": f"{type(e).__name__}: {e}"}
+                _send_msg(conn, *encode_msg(resp))
+                if req.get("op") == "stop":
+                    break
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, req):
+        op = req["op"]
+        if op == "create_table":
+            tid, kind = req["table_id"], req["kind"]
+            with self._lock:
+                if tid not in self._tables:
+                    if kind == "sparse":
+                        self._tables[tid] = SparseTable(**req["cfg"])
+                    else:
+                        self._tables[tid] = DenseTable(**req["cfg"])
+            return {"ok": True}
+        if op == "pull_sparse":
+            return {"rows": self._tables[req["table_id"]].pull(req["keys"])}
+        if op == "push_sparse":
+            self._tables[req["table_id"]].push(req["keys"], req["grads"])
+            return {"ok": True}
+        if op == "pull_dense":
+            return {"value": self._tables[req["table_id"]].pull()}
+        if op == "set_dense":
+            self._tables[req["table_id"]].set(req["value"])
+            return {"ok": True}
+        if op == "push_dense":
+            self._tables[req["table_id"]].push(req["grad"])
+            return {"ok": True}
+        if op == "table_size":
+            return {"size": self._tables[req["table_id"]].size()}
+        if op == "save":
+            state = {tid: t.state() for tid, t in self._tables.items()}
+            dump_obj(state, req["path"])
+            return {"ok": True}
+        if op == "load":
+            state = load_obj(req["path"])
+            for tid, st in state.items():
+                if tid in self._tables:
+                    self._tables[tid].load_state(st)
+            return {"ok": True}
+        if op == "barrier":
+            # counting barrier: nth arrival of (name, gen) releases when
+            # count reaches world; clients poll. A poll/arrival for an
+            # older generation than the stored one answers done=True (its
+            # caller could only have advanced past it), so only one entry
+            # per name ever lives on the server.
+            name, world = req["name"], req["world"]
+            gen = int(req.get("gen", 0))
+            with self._lock:
+                cur = self._barriers.get(name)
+                if cur is None or gen > cur[0]:
+                    cur = self._barriers[name] = [gen, 0]
+                if gen < cur[0]:
+                    return {"done": True}
+                if req.get("arrive"):
+                    cur[1] += 1
+                done = cur[1] >= world
+            return {"done": done}
+        if op == "stop":
+            self._stop.set()
+            # unblock the accept loop
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            return {"ok": True}
+        raise ValueError(f"unknown ps op {op!r}")

@@ -1,0 +1,200 @@
+"""Parameter-server tables.
+
+Reference analog: paddle/fluid/distributed/ps/table/ — MemorySparseTable
+(hash-sharded id→row storage with lazy init and per-row optimizer slots),
+MemoryDenseTable, and the per-row update rules the reference registers as
+"sparse optimizers" (naive/adagrad/adam — ps/table/sparse_sgd_rule.cc).
+
+TPU-native stance: giant embedding tables cannot live in HBM — they stay in
+host DRAM on PS nodes exactly like the reference; the TPU only ever sees the
+dense minibatch of pulled rows. Rows are numpy (host memory); update rules
+are vectorized numpy over the batch of touched rows.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "make_rule"]
+
+
+class _SGDRule:
+    name = "sgd"
+    slots = 0
+
+    def __init__(self, lr=0.05, **kw):
+        self.lr = lr
+
+    def update(self, rows, slots, grads):
+        rows -= self.lr * grads
+        return rows, slots
+
+
+class _AdagradRule:
+    name = "adagrad"
+    slots = 1
+
+    def __init__(self, lr=0.05, initial_g2sum=0.0, epsilon=1e-8, **kw):
+        self.lr = lr
+        self.g0 = initial_g2sum
+        self.eps = epsilon
+
+    def update(self, rows, slots, grads):
+        g2 = slots[..., 0, :] + grads * grads
+        slots[..., 0, :] = g2
+        rows -= self.lr * grads / (np.sqrt(g2 + self.g0) + self.eps)
+        return rows, slots
+
+
+class _AdamRule:
+    name = "adam"
+    slots = 3     # m, v, step (step broadcast per-row in slot 2 col 0)
+
+    def __init__(self, lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        self.lr = lr
+        self.b1 = beta1
+        self.b2 = beta2
+        self.eps = epsilon
+
+    def update(self, rows, slots, grads):
+        m = self.b1 * slots[..., 0, :] + (1 - self.b1) * grads
+        v = self.b2 * slots[..., 1, :] + (1 - self.b2) * grads * grads
+        t = slots[..., 2, 0] + 1.0
+        slots[..., 0, :] = m
+        slots[..., 1, :] = v
+        slots[..., 2, 0] = t
+        mhat = m / (1 - self.b1 ** t[..., None])
+        vhat = v / (1 - self.b2 ** t[..., None])
+        rows -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return rows, slots
+
+
+_RULES = {"sgd": _SGDRule, "naive": _SGDRule, "adagrad": _AdagradRule,
+          "adam": _AdamRule}
+
+
+def make_rule(name: str, **kw):
+    return _RULES[name.lower()](**kw)
+
+
+class SparseTable:
+    """Hash table id -> (row[dim], slots[n_slots, dim]); lazy row init.
+    Reference: MemorySparseTable (ps/table/memory_sparse_table.cc)."""
+
+    def __init__(self, dim: int, rule: str = "sgd",
+                 init_range: float = 0.01, seed: int = 0, **rule_kw):
+        self.dim = dim
+        self.rule = make_rule(rule, **rule_kw)
+        self.init_range = init_range
+        self._rng = np.random.RandomState(seed)
+        self._rows: Dict[int, np.ndarray] = {}
+        self._slots: Dict[int, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    def _init_row(self, key: int) -> np.ndarray:
+        # deterministic per-key init (stable across processes and shard
+        # layouts — Knuth multiplicative hash, not Python's salted hash())
+        seed = (int(key) * 2654435761 + 0x9E3779B9) & 0x7FFFFFFF
+        rng = np.random.RandomState(seed)
+        return rng.uniform(-self.init_range, self.init_range,
+                           self.dim).astype(np.float32)
+
+    def pull(self, keys) -> np.ndarray:
+        with self._lock:
+            out = np.empty((len(keys), self.dim), np.float32)
+            for i, k in enumerate(keys):
+                k = int(k)
+                row = self._rows.get(k)
+                if row is None:
+                    row = self._init_row(k)
+                    self._rows[k] = row
+                out[i] = row
+            return out
+
+    def push(self, keys, grads: np.ndarray):
+        """Apply the table's update rule; duplicate keys are pre-summed."""
+        keys = np.asarray(keys, np.int64)
+        grads = np.asarray(grads, np.float32)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        agg = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(agg, inv, grads)
+        ns = self.rule.slots
+        with self._lock:
+            rows = np.empty((len(uniq), self.dim), np.float32)
+            slots = np.zeros((len(uniq), max(ns, 1), self.dim), np.float32)
+            for i, k in enumerate(uniq):
+                k = int(k)
+                if k not in self._rows:
+                    self._rows[k] = self._init_row(k)
+                rows[i] = self._rows[k]
+                if ns:
+                    if k not in self._slots:
+                        self._slots[k] = np.zeros((ns, self.dim), np.float32)
+                    slots[i] = self._slots[k]
+            rows, slots = self.rule.update(rows, slots, agg)
+            for i, k in enumerate(uniq):
+                k = int(k)
+                self._rows[k] = rows[i]
+                if ns:
+                    self._slots[k] = slots[i]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def state(self):
+        with self._lock:
+            return {"dim": self.dim,
+                    "rows": {k: v.copy() for k, v in self._rows.items()},
+                    "slots": {k: v.copy() for k, v in self._slots.items()}}
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in st["rows"].items()}
+            self._slots = {int(k): np.asarray(v, np.float32)
+                           for k, v in st.get("slots", {}).items()}
+
+
+class DenseTable:
+    """One contiguous parameter block (reference MemoryDenseTable)."""
+
+    def __init__(self, shape, rule: str = "sgd", **rule_kw):
+        self.value = np.zeros(shape, np.float32)
+        self.rule = make_rule(rule, **rule_kw)
+        ns = self.rule.slots
+        self._slots = np.zeros((max(ns, 1),) + tuple(shape), np.float32)
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self.value.copy()
+
+    def set(self, value: np.ndarray):
+        with self._lock:
+            self.value = np.asarray(value, np.float32).copy()
+
+    def push(self, grad: np.ndarray):
+        with self._lock:
+            ns = self.rule.slots
+            v = self.value[None] if self.value.ndim == 1 else self.value
+            g = np.asarray(grad, np.float32)
+            g2 = g[None] if g.ndim == 1 else g
+            slots = self._slots.reshape((max(ns, 1),) + v.shape)
+            # rule operates rowwise; treat whole block as rows
+            rows, slots = self.rule.update(
+                v.copy(), np.moveaxis(slots, 0, -2).copy(), g2)
+            self.value = rows.reshape(self.value.shape)
+            self._slots = np.moveaxis(slots, -2, 0).reshape(
+                self._slots.shape)
+
+    def state(self):
+        with self._lock:
+            return {"value": self.value.copy(), "slots": self._slots.copy()}
+
+    def load_state(self, st):
+        with self._lock:
+            self.value = np.asarray(st["value"], np.float32)
+            self._slots = np.asarray(st["slots"], np.float32)

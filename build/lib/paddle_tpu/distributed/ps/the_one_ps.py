@@ -1,0 +1,208 @@
+"""TheOnePs runtime — PS-mode fleet glue.
+
+Reference analog: python/paddle/distributed/ps/the_one_ps.py — the runtime
+fleet selects when the role maker says parameter-server mode: builds table
+configs from the model's sparse lookups, starts servers on PSERVER nodes,
+creates clients + the async communicator on TRAINER nodes, and rewires the
+optimizer so sparse updates happen server-side (a_sync SGD).
+
+TPU-native flow per train step on a worker:
+  1. DistributedEmbedding.forward pulls the batch's unique rows from the
+     PS shards into one dense [n_unique, dim] host array, uploads it as a
+     leaf Tensor, and gathers per-position rows on device (TPU math only
+     ever sees dense minibatch rows).
+  2. loss.backward() accumulates the gather-scatter VJP into the leaf's
+     .grad = per-unique-id gradients.
+  3. PSOptimizer.step() hands those grads to the AsyncCommunicator, which
+     aggregates and pushes them; the server applies the table's update
+     rule (async SGD — the reference's a_sync mode). Dense params remain
+     locally optimized (hybrid, as in the reference's default a_sync).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from .client import AsyncCommunicator, PsClient
+from .server import PsServer
+
+__all__ = ["TheOnePs", "DistributedEmbedding", "PSOptimizer", "get_runtime"]
+
+
+class TheOnePs:
+    """Process-wide PS runtime (one per trainer/server process)."""
+
+    def __init__(self, role_maker):
+        self.role = role_maker
+        self.server: Optional[PsServer] = None
+        self.client: Optional[PsClient] = None
+        self.communicator: Optional[AsyncCommunicator] = None
+        self._next_table_id = 0
+        self._lock = threading.Lock()
+
+    # -- server side -------------------------------------------------------
+    def init_server(self, *args, **kwargs):
+        ep = self.role._server_endpoint()
+        host, port = ep.rsplit(":", 1)
+        # bind the advertised endpoint host, not all interfaces; NATed /
+        # port-mapped deployments where that host is not a local interface
+        # fall back to 0.0.0.0 (trusted-network assumption, logged)
+        try:
+            self.server = PsServer(host, int(port))
+        except OSError:
+            import warnings
+            warnings.warn(
+                f"PS endpoint host {host!r} is not a local interface; "
+                "binding 0.0.0.0 — ensure the network is trusted")
+            self.server = PsServer("0.0.0.0", int(port))
+        self.server.start()
+
+    def run_server(self):
+        if self.server is None:
+            self.init_server()
+        self.server.run()
+
+    def stop_server(self):
+        if self.server is not None:
+            self.server.stop()
+
+    # -- worker side -------------------------------------------------------
+    def init_worker(self):
+        self.client = PsClient(self.role._server_endpoints())
+        self.communicator = AsyncCommunicator(self.client).start()
+        for emb in _embeddings:
+            emb._bind(self)
+
+    def stop_worker(self, stop_servers: bool = False):
+        if self.communicator is not None:
+            self.communicator.stop()
+        if self.client is not None:
+            if stop_servers:
+                self.client.stop_servers()
+            self.client.close()
+
+    def barrier_worker(self, name: str = "worker"):
+        if self.client is not None:
+            self.client.barrier(name, self.role._worker_num())
+
+    def alloc_table_id(self) -> int:
+        with self._lock:
+            tid = self._next_table_id
+            self._next_table_id += 1
+            return tid
+
+    def save(self, path_prefix: str):
+        if self.client is not None:
+            self.communicator.flush()
+            self.client.save(path_prefix)
+
+    def load(self, path_prefix: str):
+        if self.client is not None:
+            self.client.load(path_prefix)
+
+
+_runtime: Optional[TheOnePs] = None
+_embeddings: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def set_runtime(rt: Optional[TheOnePs]):
+    global _runtime
+    _runtime = rt
+
+
+def get_runtime() -> Optional[TheOnePs]:
+    return _runtime
+
+
+class DistributedEmbedding(Layer):
+    """Sparse lookup backed by a PS SparseTable (reference:
+    paddle.static.nn.sparse_embedding / distributed lookup-table op).
+
+    The table never materializes on device; each forward pulls only the
+    batch's unique rows."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 rule: str = "adagrad", lr: float = 0.05,
+                 table_id: Optional[int] = None, name: str = "emb"):
+        super().__init__()
+        self.num_embeddings = num_embeddings   # advisory (hash tables grow)
+        self.embedding_dim = embedding_dim
+        self.rule = rule
+        self.lr = lr
+        self.table_id = table_id
+        self._name = name
+        self._rt: Optional[TheOnePs] = None
+        self._pulled = []         # [(leaf rows Tensor, unique keys)] per
+                                  # forward since the last flush
+        _embeddings.add(self)
+
+    def _bind(self, rt: TheOnePs):
+        self._rt = rt
+        if self.table_id is None:
+            self.table_id = rt.alloc_table_id()
+        rt.client.create_sparse_table(
+            self.table_id, self.embedding_dim, rule=self.rule, lr=self.lr)
+
+    def forward(self, ids):
+        import paddle_tpu as paddle
+        from ...ops.manipulation import gather, reshape
+
+        if self._rt is None or self._rt.client is None:
+            raise RuntimeError(
+                "DistributedEmbedding used before fleet.init_worker()")
+        from ...core.autograd import is_grad_enabled
+
+        ids_np = np.asarray(ids._value).astype(np.int64)
+        shape = ids_np.shape
+        uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
+        rows_np = self._rt.client.pull_sparse(self.table_id, uniq)
+        rows = paddle.to_tensor(rows_np)
+        if is_grad_enabled():
+            # track only when a backward can produce row grads — eval /
+            # inference forwards would otherwise pin every pulled row
+            rows.stop_gradient = False
+            self._pulled.append((rows, uniq))
+        inv_t = paddle.to_tensor(inv.astype(np.int64).reshape(-1))
+        out = gather(rows, inv_t, axis=0)
+        return reshape(out, list(shape) + [self.embedding_dim])
+
+    def flush_gradients(self):
+        """Push every pull's accumulated row grads since the last flush
+        (called by PSOptimizer.step after backward) — multiple forwards
+        per step (shared lookups, grad accumulation) all contribute."""
+        for rows, keys in self._pulled:
+            if rows.grad is None:
+                continue
+            self._rt.communicator.push_sparse(
+                self.table_id, keys, np.asarray(rows.grad._value))
+        self._pulled = []
+
+
+class PSOptimizer:
+    """Wraps a local optimizer for a_sync PS training (reference:
+    fleet.distributed_optimizer in PS mode + ParameterServerOptimizer):
+    step() first ships sparse grads to the servers, then steps the local
+    optimizer over the dense params it owns."""
+
+    def __init__(self, inner, runtime: TheOnePs):
+        self.inner = inner
+        self.rt = runtime
+
+    def step(self):
+        for emb in _embeddings:
+            if emb._rt is self.rt:
+                emb.flush_gradients()
+        if self.inner is not None:
+            self.inner.step()
+
+    def clear_grad(self):
+        if self.inner is not None:
+            self.inner.clear_grad()
+
+    def __getattr__(self, k):
+        return getattr(self.inner, k)

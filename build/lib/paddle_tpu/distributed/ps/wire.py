@@ -1,0 +1,104 @@
+"""Safe PS wire codec — JSON header + raw numpy buffers.
+
+Reference analog: the PS service's protobuf messages
+(paddle/fluid/distributed/ps/service/sendrecv.proto) — structured,
+non-executable payloads. The round-1 protocol used pickle, which lets any
+host that can reach the port execute code on the server; this codec keeps
+the same (op, table_id, payload) request shape but serializes it as a JSON
+header whose ndarray fields are replaced by {"__nd__": i} placeholders,
+with the raw array bytes appended as framed binary parts. Nothing on the
+wire can construct arbitrary Python objects.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, List, Tuple
+
+import numpy as np
+
+__all__ = ["encode_msg", "decode_msg", "dump_obj", "load_obj"]
+
+# dtypes allowed on the wire (all the PS tables use); anything else raises
+_DTYPES = {"float32", "float64", "float16", "bfloat16", "int8", "uint8",
+           "int16", "int32", "int64", "uint32", "uint64", "bool"}
+
+
+def _pack(obj: Any, bufs: List[bytes]) -> Any:
+    if isinstance(obj, np.ndarray):
+        name = str(obj.dtype)
+        if name not in _DTYPES:
+            raise TypeError(f"dtype {name} not wire-safe")
+        idx = len(bufs)
+        bufs.append(np.ascontiguousarray(obj).tobytes())
+        return {"__nd__": idx, "dtype": name, "shape": list(obj.shape)}
+    if isinstance(obj, np.generic):
+        return _pack(np.asarray(obj), bufs)
+    if isinstance(obj, dict):
+        # JSON keys must be strings; the tables key rows by int id, so
+        # encode every dict as an item list to round-trip key types
+        return {"__map__": [[_pack(k, bufs), _pack(v, bufs)]
+                            for k, v in obj.items()]}
+    if isinstance(obj, (list, tuple)):
+        return [_pack(x, bufs) for x in obj]
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        return obj
+    raise TypeError(f"type {type(obj).__name__} not wire-safe")
+
+
+def _unpack(obj: Any, bufs: List[bytes]) -> Any:
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            name = obj["dtype"]
+            if name not in _DTYPES:
+                raise TypeError(f"dtype {name} not wire-safe")
+            arr = np.frombuffer(bufs[obj["__nd__"]], dtype=np.dtype(name))
+            return arr.reshape(obj["shape"]).copy()
+        if "__map__" in obj:
+            return {_freeze(_unpack(k, bufs)): _unpack(v, bufs)
+                    for k, v in obj["__map__"]}
+        raise TypeError("unexpected wire object")
+    if isinstance(obj, list):
+        return [_unpack(x, bufs) for x in obj]
+    return obj
+
+
+def _freeze(k):
+    # dict keys decoded from the wire must be hashable
+    if isinstance(k, np.ndarray):
+        return k.tobytes()
+    return k
+
+
+def encode_msg(obj: Any) -> Tuple[bytes, ...]:
+    """obj -> (json_header, raw_buf_0, raw_buf_1, ...)."""
+    bufs: List[bytes] = []
+    header = json.dumps(_pack(obj, bufs)).encode()
+    return (header, *bufs)
+
+
+def decode_msg(parts) -> Any:
+    header, *bufs = parts
+    return _unpack(json.loads(header.decode()), list(bufs))
+
+
+def dump_obj(obj: Any, path: str):
+    """Serialize to disk with the same safe framing (replaces pickle for
+    table save/load: length-prefixed parts, no executable payload)."""
+    import struct
+    parts = encode_msg(obj)
+    with open(path, "wb") as f:
+        f.write(struct.pack("!I", len(parts)))
+        for p in parts:
+            f.write(struct.pack("!Q", len(p)))
+            f.write(p)
+
+
+def load_obj(path: str) -> Any:
+    import struct
+    with open(path, "rb") as f:
+        (n,) = struct.unpack("!I", f.read(4))
+        parts = []
+        for _ in range(n):
+            (ln,) = struct.unpack("!Q", f.read(8))
+            parts.append(f.read(ln))
+    return decode_msg(parts)

@@ -1,0 +1,155 @@
+"""paddle.distributed.rpc (reference: python/paddle/distributed/rpc/rpc.py
+over C++ brpc — paddle/fluid/distributed/rpc/).
+
+TPU-native: a compact python RPC over the same TCP socket layer as TCPStore.
+Each worker runs a request server; rpc_sync/rpc_async pickle (fn, args) to
+the target worker and return the pickled result. Worker discovery goes
+through the rendezvous store.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..store import TCPStore, _recv_msg, _send_msg
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_state: Dict[str, Any] = {"inited": False}
+
+
+class _RpcServer(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("0.0.0.0", 0))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(64)
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                (payload,) = _recv_msg(conn)
+                fn, args, kwargs = pickle.loads(payload)
+                try:
+                    result = (True, fn(*args, **kwargs))
+                except Exception as e:  # propagate remote exception
+                    result = (False, e)
+                _send_msg(conn, pickle.dumps(result, protocol=4))
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    import os
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    server = _RpcServer()
+    server.start()
+    ip = socket.gethostbyname(socket.gethostname())
+    if master_endpoint is None:
+        master_endpoint = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, _, port = master_endpoint.partition(":")
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    store.set(f"rpc/{rank}", f"{name},{ip},{server.port}")
+    workers = {}
+    for r in range(world_size):
+        nm, wip, wport = store.get(f"rpc/{r}").decode().split(",")
+        workers[nm] = WorkerInfo(nm, r, wip, int(wport))
+    _state.update(inited=True, server=server, store=store, workers=workers,
+                  name=name, rank=rank,
+                  pool=concurrent.futures.ThreadPoolExecutor(8),
+                  conns={})
+    store.barrier("rpc_init", world_size)
+
+
+def _conn_to(name: str):
+    conns = _state["conns"]
+    if name not in conns:
+        info = _state["workers"][name]
+        conns[name] = (socket.create_connection((info.ip, info.port)),
+                       threading.Lock())
+    return conns[name]
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout=None):
+    conn, lock = _conn_to(to)
+    payload = pickle.dumps((fn, args, kwargs or {}), protocol=4)
+    with lock:
+        _send_msg(conn, payload)
+        (resp,) = _recv_msg(conn)
+    ok, value = pickle.loads(resp)
+    if not ok:
+        raise value
+    return value
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout=None):
+    return _state["pool"].submit(rpc_sync, to, fn, args, kwargs)
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    if name is None:
+        name = _state["name"]
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return list(_state["workers"].values())
+
+
+def shutdown():
+    if not _state.get("inited"):
+        return
+    try:
+        _state["store"].barrier("rpc_shutdown",
+                                len(_state["workers"]))
+    except Exception:
+        pass
+    for conn, _ in _state.get("conns", {}).values():
+        try:
+            conn.close()
+        except OSError:
+            pass
+    _state["server"].stop()
+    _state["pool"].shutdown(wait=False)
+    _state["store"].close()
+    _state["inited"] = False

@@ -1,0 +1,213 @@
+"""TCPStore — rendezvous key-value store.
+
+Reference analog: paddle/phi/core/distributed/store/tcp_store.h:121 +
+tcp_utils.cc (C++ socket KV store used to exchange NCCL unique ids and
+barrier). On TPU the JAX coordination service covers in-job rendezvous, but
+the LAUNCHER still needs a store before any jax process exists — this is
+that store: a length-prefixed TCP protocol with set/get/wait/add/barrier,
+host process on rank-0.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["TCPStore"]
+
+_OP_SET = 0
+_OP_GET = 1
+_OP_ADD = 2
+_OP_WAIT = 3
+_OP_DEL = 4
+
+
+def _send_msg(sock, *parts: bytes):
+    payload = b"".join(struct.pack("!I", len(p)) + p for p in parts)
+    sock.sendall(struct.pack("!I", len(parts)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n_parts,) = struct.unpack("!I", _recv_exact(sock, 4))
+    parts = []
+    for _ in range(n_parts):
+        (ln,) = struct.unpack("!I", _recv_exact(sock, 4))
+        parts.append(_recv_exact(sock, ln))
+    return parts
+
+
+class _StoreServer(threading.Thread):
+    def __init__(self, host, port):
+        super().__init__(daemon=True)
+        self.data: Dict[bytes, bytes] = {}
+        self.cond = threading.Condition()
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.port = self.sock.getsockname()[1]
+        self.sock.listen(128)
+        self._stop = False
+
+    def run(self):
+        while not self._stop:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                parts = _recv_msg(conn)
+                op = parts[0][0]
+                if op == _OP_SET:
+                    with self.cond:
+                        self.data[parts[1]] = parts[2]
+                        self.cond.notify_all()
+                    _send_msg(conn, b"ok")
+                elif op == _OP_GET:
+                    with self.cond:
+                        val = self.data.get(parts[1])
+                    _send_msg(conn, val if val is not None else b"",
+                              b"1" if val is not None else b"0")
+                elif op == _OP_ADD:
+                    delta = int(parts[2].decode())
+                    with self.cond:
+                        cur = int(self.data.get(parts[1], b"0").decode())
+                        cur += delta
+                        self.data[parts[1]] = str(cur).encode()
+                        self.cond.notify_all()
+                    _send_msg(conn, str(cur).encode())
+                elif op == _OP_WAIT:
+                    timeout = float(parts[2].decode())
+                    deadline = time.time() + timeout
+                    with self.cond:
+                        while parts[1] not in self.data:
+                            remaining = deadline - time.time()
+                            if remaining <= 0:
+                                break
+                            self.cond.wait(min(remaining, 1.0))
+                        ok = parts[1] in self.data
+                    _send_msg(conn, b"1" if ok else b"0")
+                elif op == _OP_DEL:
+                    with self.cond:
+                        self.data.pop(parts[1], None)
+                    _send_msg(conn, b"ok")
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """API parity with the reference TCPStore: set/get/add/wait."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self.timeout = timeout
+        self._server: Optional[_StoreServer] = None
+        if is_master:
+            self._server = _StoreServer(
+                "0.0.0.0" if host not in ("127.0.0.1", "localhost")
+                else host, port)
+            self._server.start()
+            port = self._server.port
+        self.host, self.port = host, port
+        deadline = time.time() + timeout
+        last_err = None
+        while time.time() < deadline:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError as e:
+                last_err = e
+                time.sleep(0.2)
+        else:
+            raise ConnectionError(f"cannot reach store {host}:{port}: "
+                                  f"{last_err}")
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            _send_msg(self._sock, bytes([_OP_SET]), key.encode(), value)
+            _recv_msg(self._sock)
+
+    def get(self, key: str) -> bytes:
+        deadline = time.time() + self.timeout
+        while time.time() < deadline:
+            with self._lock:
+                _send_msg(self._sock, bytes([_OP_GET]), key.encode())
+                val, found = _recv_msg(self._sock)
+            if found == b"1":
+                return val
+            time.sleep(0.1)
+        raise TimeoutError(f"store key {key!r} not set within timeout")
+
+    def get_nowait(self, key: str) -> bytes:
+        with self._lock:
+            _send_msg(self._sock, bytes([_OP_GET]), key.encode())
+            val, found = _recv_msg(self._sock)
+        if found != b"1":
+            raise KeyError(key)
+        return val
+
+    def add(self, key: str, delta: int = 1) -> int:
+        with self._lock:
+            _send_msg(self._sock, bytes([_OP_ADD]), key.encode(),
+                      str(delta).encode())
+            (val,) = _recv_msg(self._sock)
+        return int(val.decode())
+
+    def wait(self, keys, timeout: Optional[float] = None):
+        t = timeout if timeout is not None else self.timeout
+        if isinstance(keys, str):
+            keys = [keys]
+        for key in keys:
+            with self._lock:
+                _send_msg(self._sock, bytes([_OP_WAIT]), key.encode(),
+                          str(t).encode())
+                (ok,) = _recv_msg(self._sock)
+            if ok != b"1":
+                raise TimeoutError(f"wait on {key!r} timed out")
+
+    def delete_key(self, key: str):
+        with self._lock:
+            _send_msg(self._sock, bytes([_OP_DEL]), key.encode())
+            _recv_msg(self._sock)
+
+    def barrier(self, name: str, world_size: int,
+                timeout: Optional[float] = None):
+        n = self.add(f"__barrier__/{name}", 1)
+        if n >= world_size:
+            self.set(f"__barrier__/{name}/done", b"1")
+        self.wait([f"__barrier__/{name}/done"], timeout)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._server is not None:
+            self._server.stop()

@@ -1,0 +1,414 @@
+"""Eager cross-process tensor transport.
+
+Reference analog: the CPU ProcessGroupGloo
+(/root/reference/paddle/fluid/distributed/collective/process_group_gloo.h:34)
+and the NCCL ProcessGroup's send/recv surface
+(process_group.h:118-178) — the paths the reference uses when a collective
+runs on *eager* (non-captured) tensors.
+
+TPU-native stance: the hot path stays in-graph (XLA collectives over the
+mesh, see collective.py). This module is the correctness-bearing eager/
+control-plane path for multi-process jobs: a full peer-to-peer TCP mesh
+between ranks carrying raw tensor bytes with a JSON header (never pickle —
+see ADVICE.md on the PS wire protocol), rendezvoused through the TCPStore.
+
+Topology per collective (eager path = small tensors, correctness first):
+  - send/recv: direct peer socket, tag-sequenced per (src, dst, group).
+  - broadcast: root fans out.
+  - reduce / all_reduce: star onto the root, reduce on host, fan out
+    (all_reduce) or keep at dst (reduce).
+  - all_gather / gather: everyone -> root, root concatenates, fans out
+    (all_gather) or keeps (gather).
+  - scatter: src sends piece i to rank i.
+  - all_to_all: pairwise exchange, deterministic peer order.
+  - barrier: generation-counted store barrier.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .store import TCPStore, _recv_exact
+
+__all__ = ["TensorTransport", "init_transport", "get_transport",
+           "shutdown_transport"]
+
+
+def _dtype_to_name(dt) -> str:
+    return np.dtype(dt).name
+
+
+def _name_to_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _to_numpy(arr) -> np.ndarray:
+    out = np.asarray(arr)
+    return np.ascontiguousarray(out)
+
+
+def _send_frame(sock, header: dict, payload: bytes):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack("!II", len(h), len(payload)) + h + payload)
+
+
+def _recv_frame(sock) -> Tuple[dict, bytes]:
+    hlen, plen = struct.unpack("!II", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen).decode())
+    payload = _recv_exact(sock, plen) if plen else b""
+    return header, payload
+
+
+class _Mailbox:
+    """Tag-addressed inbox the receiver thread fills and recv() drains."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._msgs: Dict[str, List[np.ndarray]] = {}
+
+    def put(self, tag: str, arr: np.ndarray):
+        with self._cond:
+            self._msgs.setdefault(tag, []).append(arr)
+            self._cond.notify_all()
+
+    def take(self, tag: str, timeout: float) -> np.ndarray:
+        deadline = time.time() + timeout
+        with self._cond:
+            while not self._msgs.get(tag):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"transport recv timed out waiting for {tag!r}")
+                self._cond.wait(min(remaining, 1.0))
+            arr = self._msgs[tag].pop(0)
+            if not self._msgs[tag]:
+                del self._msgs[tag]
+            return arr
+
+
+class TensorTransport:
+    """One per process. Listens on an advertised address, lazily dials
+    peers, frames tensors as JSON header + raw bytes."""
+
+    def __init__(self, rank: int, world_size: int, store: TCPStore,
+                 bind_host: Optional[str] = None, timeout: float = 300.0):
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout = timeout
+        self._store = store
+        self._mailbox = _Mailbox()
+        self._peers: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._seq: Dict[str, int] = {}
+        self._seq_lock = threading.Lock()
+        self._closed = False
+
+        # Bind to the advertised interface, not 0.0.0.0 (ADVICE.md).
+        host = bind_host or os.environ.get("POD_IP") \
+            or (os.environ.get("PADDLE_CURRENT_ENDPOINT", "").split(":")[0]
+                or "127.0.0.1")
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, 0))
+        self._server.listen(world_size * 4)
+        port = self._server.getsockname()[1]
+        self.address = f"{host}:{port}"
+        # namespace by job id so a shared/long-lived launcher store never
+        # serves another job's (or a previous incarnation's) addresses
+        self._job = os.environ.get("PADDLE_JOB_ID", "default")
+        store.set(self._peer_key(rank), self.address)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    # -- wiring ------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._recv_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_loop(self, conn):
+        try:
+            while True:
+                header, payload = _recv_frame(conn)
+                arr = np.frombuffer(
+                    payload, dtype=_name_to_dtype(header["dtype"])
+                ).reshape(header["shape"]).copy()
+                self._mailbox.put(header["tag"], arr)
+        except (ConnectionError, OSError, struct.error):
+            pass
+
+    def _peer_key(self, rank: int) -> str:
+        return f"__transport__/{getattr(self, '_job', 'default')}/{rank}"
+
+    def _dial(self, dst: int) -> socket.socket:
+        sock = self._peers.get(dst)
+        if sock is not None:
+            return sock
+        deadline = time.time() + self.timeout
+        last = None
+        addr = None
+        while time.time() < deadline:
+            # re-read each attempt: an elastically-restarted peer
+            # re-registers under a new address
+            addr = self._store.get(self._peer_key(dst)).decode()
+            host, port = addr.rsplit(":", 1)
+            try:
+                sock = socket.create_connection((host, int(port)),
+                                                timeout=self.timeout)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        else:
+            raise ConnectionError(f"cannot reach rank {dst} at {addr}: "
+                                  f"{last}")
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._peers[dst] = sock
+        self._peer_locks[dst] = threading.Lock()
+        return sock
+
+    def _next_seq(self, key: str) -> int:
+        with self._seq_lock:
+            n = self._seq.get(key, 0)
+            self._seq[key] = n + 1
+            return n
+
+    # -- p2p ---------------------------------------------------------------
+    def send(self, arr, dst: int, channel: str = "p2p"):
+        arr = _to_numpy(arr)
+        seq = self._next_seq(f"tx:{channel}:{dst}")
+        tag = f"{channel}:{self.rank}->{dst}:{seq}"
+        sock = self._dial(dst)
+        with self._peer_locks[dst]:
+            _send_frame(sock, {"tag": tag,
+                               "dtype": _dtype_to_name(arr.dtype),
+                               "shape": list(arr.shape)}, arr.tobytes())
+
+    def recv(self, src: int, channel: str = "p2p") -> np.ndarray:
+        return self._mailbox.take(self.reserve_recv(src, channel),
+                                  self.timeout)
+
+    def reserve_recv(self, src: int, channel: str = "p2p") -> str:
+        """Claim the next sequence tag for a receive without blocking —
+        the async irecv posting half; redeem with take()."""
+        seq = self._next_seq(f"rx:{channel}:{src}")
+        return f"{channel}:{src}->{self.rank}:{seq}"
+
+    def take(self, tag: str) -> np.ndarray:
+        return self._mailbox.take(tag, self.timeout)
+
+    # -- collectives over subsets of ranks ---------------------------------
+    def _chan(self, op: str, gid: int) -> str:
+        return f"c:{op}:{gid}"
+
+    @staticmethod
+    def _reduce_fn(op: str):
+        return {"sum": np.add, "max": np.maximum, "min": np.minimum,
+                "prod": np.multiply, "avg": np.add}[op]
+
+    def _host_reduce(self, parts: List[np.ndarray], op: str) -> np.ndarray:
+        fn = self._reduce_fn(op)
+        dt = parts[0].dtype
+        # bf16/fp16 (ml_dtypes registers as kind 'V') accumulate in fp32
+        widen = dt.itemsize < 4 and dt.kind in "fV"
+        wide = [p.astype(np.float32) if widen else p for p in parts]
+        acc = wide[0]
+        for p in wide[1:]:
+            acc = fn(acc, p)
+        if op == "avg":
+            acc = acc / len(parts)
+        return acc.astype(parts[0].dtype)
+
+    def all_reduce(self, arr, op: str, ranks: List[int],
+                   gid: int) -> np.ndarray:
+        arr = _to_numpy(arr)
+        root = ranks[0]
+        ch = self._chan(f"ar_{op}", gid)
+        if self.rank == root:
+            parts = [arr] + [self.recv(r, ch) for r in ranks
+                             if r != root]
+            out = self._host_reduce(parts, op)
+            for r in ranks:
+                if r != root:
+                    self.send(out, r, ch + ":out")
+            return out
+        self.send(arr, root, ch)
+        return self.recv(root, ch + ":out")
+
+    def reduce(self, arr, op: str, dst: int, ranks: List[int],
+               gid: int) -> np.ndarray:
+        arr = _to_numpy(arr)
+        ch = self._chan(f"red_{op}", gid)
+        if self.rank == dst:
+            parts = [arr] + [self.recv(r, ch) for r in ranks if r != dst]
+            return self._host_reduce(parts, op)
+        self.send(arr, dst, ch)
+        return arr
+
+    def broadcast(self, arr, src: int, ranks: List[int],
+                  gid: int) -> np.ndarray:
+        ch = self._chan("bc", gid)
+        if self.rank == src:
+            arr = _to_numpy(arr)
+            for r in ranks:
+                if r != src:
+                    self.send(arr, r, ch)
+            return arr
+        return self.recv(src, ch)
+
+    def all_gather(self, arr, ranks: List[int], gid: int) -> List[np.ndarray]:
+        arr = _to_numpy(arr)
+        root = ranks[0]
+        ch = self._chan("ag", gid)
+        if self.rank == root:
+            parts = {root: arr}
+            for r in ranks:
+                if r != root:
+                    parts[r] = self.recv(r, ch)
+            ordered = [parts[r] for r in ranks]
+            stacked = np.stack(ordered, axis=0)
+            for r in ranks:
+                if r != root:
+                    self.send(stacked, r, ch + ":out")
+            return ordered
+        self.send(arr, root, ch)
+        stacked = self.recv(root, ch + ":out")
+        return [stacked[i] for i in range(stacked.shape[0])]
+
+    def gather(self, arr, dst: int, ranks: List[int],
+               gid: int) -> Optional[List[np.ndarray]]:
+        arr = _to_numpy(arr)
+        ch = self._chan("ga", gid)
+        if self.rank == dst:
+            parts = {dst: arr}
+            for r in ranks:
+                if r != dst:
+                    parts[r] = self.recv(r, ch)
+            return [parts[r] for r in ranks]
+        self.send(arr, dst, ch)
+        return None
+
+    def scatter(self, parts: Optional[List[np.ndarray]], src: int,
+                ranks: List[int], gid: int) -> np.ndarray:
+        ch = self._chan("sc", gid)
+        if self.rank == src:
+            assert parts is not None and len(parts) == len(ranks)
+            mine = None
+            for r, piece in zip(ranks, parts):
+                piece = _to_numpy(piece)
+                if r == src:
+                    mine = piece
+                else:
+                    self.send(piece, r, ch)
+            return mine
+        return self.recv(src, ch)
+
+    def all_to_all(self, parts: List[np.ndarray], ranks: List[int],
+                   gid: int) -> List[np.ndarray]:
+        assert len(parts) == len(ranks)
+        ch = self._chan("a2a", gid)
+        out: Dict[int, np.ndarray] = {}
+        for r, piece in zip(ranks, parts):
+            if r == self.rank:
+                out[r] = _to_numpy(piece)
+            else:
+                self.send(_to_numpy(piece), r, ch)
+        for r in ranks:
+            if r != self.rank:
+                out[r] = self.recv(r, ch)
+        return [out[r] for r in ranks]
+
+    def barrier(self, name: str, ranks: List[int]):
+        seq = self._next_seq(f"barrier:{name}")
+        self._store.barrier(f"{name}#{seq}", len(ranks),
+                            timeout=self.timeout)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for s in self._peers.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peers.clear()
+
+
+_transport: Optional[TensorTransport] = None
+
+
+def _master_endpoint() -> Tuple[str, int]:
+    master = os.environ.get("PADDLE_MASTER")
+    if master:
+        host, port = master.rsplit(":", 1)
+        return host, int(port)
+    eps = [e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                     "").split(",") if e]
+    if eps:
+        host, port = eps[0].rsplit(":", 1)
+        return host, int(port) + 1
+    return "127.0.0.1", 0
+
+
+def init_transport(rank: Optional[int] = None,
+                   world_size: Optional[int] = None,
+                   timeout: float = 300.0) -> Optional[TensorTransport]:
+    """Bring up the eager tensor transport for this process. No-op (returns
+    None) for single-process jobs."""
+    global _transport
+    if _transport is not None:
+        return _transport
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if world_size <= 1:
+        return None
+    host, port = _master_endpoint()
+    if rank == 0:
+        # Host the store unless the launcher already serves this address —
+        # bind fails instantly (EADDRINUSE) in that case, so try hosting
+        # first and join as a client on failure.
+        try:
+            store = TCPStore(host, port, is_master=True,
+                             world_size=world_size, timeout=timeout)
+        except OSError:
+            store = TCPStore(host, port, is_master=False,
+                             world_size=world_size, timeout=timeout)
+    else:
+        store = TCPStore(host, port, is_master=False,
+                         world_size=world_size, timeout=timeout)
+    _transport = TensorTransport(rank, world_size, store, timeout=timeout)
+    return _transport
+
+
+def get_transport() -> Optional[TensorTransport]:
+    return _transport
+
+
+def shutdown_transport():
+    global _transport
+    if _transport is not None:
+        _transport.close()
+        _transport = None

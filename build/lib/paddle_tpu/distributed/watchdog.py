@@ -1,0 +1,238 @@
+"""Collective desync watchdog.
+
+Reference analog: CommTaskManager + CommTask
+(/root/reference/paddle/phi/core/distributed/comm_task_manager.h,
+/root/reference/paddle/phi/core/distributed/nccl_comm_task.cc) — an async
+watchdog thread that tracks every in-flight collective, and when one stalls
+past a timeout dumps per-rank diagnostics (op, group, sequence number,
+elapsed) so hangs caused by ranks issuing mismatched collective sequences
+can be localised.
+
+TPU-native design: XLA schedules collectives, so there is no NCCL ring to
+poll — instead every collective issued through
+``paddle_tpu.distributed.collective`` registers a ``CommTask`` carrying the
+group's monotonically increasing **sequence number** and a weak reference
+to the produced array. The watchdog loop polls readiness non-blockingly
+(``jax.Array.is_ready``) — a ready (or garbage-collected) output marks the
+task done, exactly as the reference polls CUDA events. A task that is still
+unready past the timeout triggers a structured dump to stderr and
+(optionally) a file, including the per-group sequence counters — comparing
+these across ranks' dumps is exactly how the reference's "found async_op
+desync" report works.
+
+Enable with ``enable_comm_watchdog(timeout_s)`` or env
+``FLAGS_comm_watchdog_timeout`` (seconds; 0 disables — the default, as in
+the reference where FLAGS_enable_async_trace defaults off).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "CommTask", "CommTaskManager", "enable_comm_watchdog",
+    "disable_comm_watchdog", "comm_task_manager",
+]
+
+
+class CommTask:
+    """One in-flight collective (reference: phi::distributed::CommTask)."""
+
+    __slots__ = ("op_name", "group_id", "group_ranks", "seq", "rank",
+                 "start_time", "done", "dumped", "shape", "dtype", "_arr")
+
+    def __init__(self, op_name: str, group_id: int, group_ranks: List[int],
+                 seq: int, rank: int, shape=None, dtype=None):
+        self.op_name = op_name
+        self.group_id = group_id
+        self.group_ranks = group_ranks
+        self.seq = seq
+        self.rank = rank
+        self.start_time = time.monotonic()
+        self.done = False
+        self.dumped = False
+        self.shape = shape
+        self.dtype = dtype
+        self._arr = None           # weakref to the produced jax.Array
+
+    def attach(self, value):
+        """Bind the collective's output array; readiness of this array is
+        the completion signal (the reference's CUDA-event poll)."""
+        import weakref
+        try:
+            self._arr = weakref.ref(value)
+        except TypeError:
+            self._arr = None
+
+    def poll(self) -> bool:
+        """Non-blocking completion check; updates and returns ``done``."""
+        if self.done:
+            return True
+        if self._arr is None:
+            # attach() not (yet) called — stays pending; start_task marks
+            # it done when a later collective is issued on the same group
+            # (per-group dispatch order), so an attach() that failed or was
+            # skipped cannot dump forever on an active group
+            return False
+        arr = self._arr()
+        if arr is None:
+            # output released by the program -> it was dispatched and
+            # consumed; nothing left to watch
+            self.done = True
+        else:
+            try:
+                if arr.is_ready():
+                    self.done = True
+            except Exception:
+                pass
+        return self.done
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start_time
+
+    def mark_done(self):
+        self.done = True
+
+    def to_dict(self):
+        return {
+            "op": self.op_name,
+            "group_id": self.group_id,
+            "group_ranks": self.group_ranks,
+            "seq": self.seq,
+            "rank": self.rank,
+            "elapsed_s": round(self.elapsed(), 3),
+            "shape": list(self.shape) if self.shape is not None else None,
+            "dtype": str(self.dtype) if self.dtype is not None else None,
+        }
+
+
+class CommTaskManager:
+    """Tracks in-flight collectives; a daemon thread dumps stalled ones.
+
+    Reference: CommTaskManager::CommTaskLoop / CommTaskClearLoop
+    (comm_task_manager.cc) — here one loop does both, since completion is
+    host-observable via array readiness rather than CUDA events.
+    """
+
+    _POLL_S = 1.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: List[CommTask] = []
+        self._seq: Dict[int, int] = {}          # group_id -> last seq issued
+        self._timeout_s = float(os.environ.get(
+            "FLAGS_comm_watchdog_timeout", "0") or 0)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.dump_path = os.environ.get("FLAGS_comm_watchdog_dump_path", "")
+
+    # -- configuration ----------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._timeout_s > 0
+
+    def enable(self, timeout_s: float):
+        self._timeout_s = float(timeout_s)
+        if self._timeout_s > 0 and (self._thread is None
+                                    or not self._thread.is_alive()):
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="comm_watchdog", daemon=True)
+            self._thread.start()
+
+    def disable(self):
+        self._timeout_s = 0.0
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        with self._lock:
+            self._tasks.clear()
+        self.dump_path = os.environ.get("FLAGS_comm_watchdog_dump_path", "")
+
+    # -- task tracking -----------------------------------------------------
+    def next_seq(self, group_id: int) -> int:
+        with self._lock:
+            self._seq[group_id] = self._seq.get(group_id, 0) + 1
+            return self._seq[group_id]
+
+    def start_task(self, op_name: str, group_id: int, group_ranks: List[int],
+                   rank: int, shape=None, dtype=None) -> Optional[CommTask]:
+        if not self.enabled:
+            return None
+        seq = self.next_seq(group_id)
+        task = CommTask(op_name, group_id, group_ranks, seq, rank,
+                        shape=shape, dtype=dtype)
+        with self._lock:
+            # dispatch on a group is ordered: starting a new task proves
+            # every earlier un-attached dispatch on the same group returned
+            # (its attach() failed or was skipped) — retire those instead
+            # of letting them dump a guaranteed-false timeout
+            for t in self._tasks:
+                if t.group_id == group_id and t._arr is None:
+                    t.mark_done()
+            self._tasks.append(task)
+        return task
+
+    def seq_counters(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._seq)
+
+    def pending(self) -> List[CommTask]:
+        with self._lock:
+            return [t for t in self._tasks if not t.poll()]
+
+    # -- watchdog loop -----------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self._POLL_S):
+            if not self.enabled:
+                continue
+            now_stalled = []
+            with self._lock:
+                self._tasks = [t for t in self._tasks if not t.poll()]
+                for t in self._tasks:
+                    if t.elapsed() > self._timeout_s and not t.dumped:
+                        t.dumped = True
+                        now_stalled.append(t)
+            for t in now_stalled:
+                self._dump(t)
+
+    def _dump(self, task: CommTask):
+        report = {
+            "event": "comm_task_timeout",
+            "timeout_s": self._timeout_s,
+            "stalled": task.to_dict(),
+            "group_seq_counters": self.seq_counters(),
+            "hint": "compare group_seq_counters across ranks' dumps; a "
+                    "rank whose counter trails issued fewer collectives "
+                    "on that group (desync)",
+        }
+        line = json.dumps(report)
+        print(f"[comm_watchdog] {line}", file=sys.stderr, flush=True)
+        if self.dump_path:
+            try:
+                with open(self.dump_path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:
+                pass
+
+
+comm_task_manager = CommTaskManager()
+if comm_task_manager._timeout_s > 0:       # env-enabled at import
+    comm_task_manager.enable(comm_task_manager._timeout_s)
+
+
+def enable_comm_watchdog(timeout_s: float = 600.0, dump_path: str = ""):
+    """Turn on the collective watchdog (reference:
+    FLAGS_enable_async_trace + comm task timeout)."""
+    if dump_path:
+        comm_task_manager.dump_path = dump_path
+    comm_task_manager.enable(timeout_s)
+
+
+def disable_comm_watchdog():
+    comm_task_manager.disable()

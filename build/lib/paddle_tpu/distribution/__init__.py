@@ -1,0 +1,515 @@
+"""Probability distributions (reference: python/paddle/distribution/).
+
+Tensor-native API over jax.random sampling + jax.scipy log-probs; the
+kl_divergence dispatch registry mirrors the reference's."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Gamma", "Dirichlet", "Multinomial", "Laplace",
+           "LogNormal", "Gumbel", "Exponential", "Geometric", "Cauchy",
+           "StudentT", "Poisson", "Binomial", "ExponentialFamily",
+           "TransformedDistribution", "kl_divergence", "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _shape(sample_shape, base):
+    return tuple(int(s) for s in sample_shape) + tuple(np.shape(base))
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(jnp.exp, self.log_prob(value), op_name="exp")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(jnp.square(self.scale),
+                                       self._batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        z = jax.random.normal(next_key(), out_shape)
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            return (-jnp.square(v - self.loc) / (2 * jnp.square(self.scale))
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        return apply(fn, value, op_name="normal_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self._batch_shape))
+
+    def cdf(self, value):
+        return apply(
+            lambda v: 0.5 * (1 + jax.scipy.special.erf(
+                (v - self.loc) / (self.scale * math.sqrt(2)))),
+            value, op_name="normal_cdf")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(np.broadcast_shapes(np.shape(self.low),
+                                             np.shape(self.high)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), out_shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        def fn(v):
+            inside = (v >= self.low) & (v < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low),
+                             -jnp.inf)
+        return apply(fn, value, op_name="uniform_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None:
+            p = _arr(probs)
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+        else:
+            logits = _arr(logits)
+        self.logits = jax.nn.log_softmax(logits, axis=-1)
+        super().__init__(np.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(next_key(), self.logits,
+                                     shape=tuple(shape) + self._batch_shape)
+        return Tensor(out.astype(jnp.int32))
+
+    def log_prob(self, value):
+        def fn(v):
+            logits = jnp.broadcast_to(
+                self.logits, tuple(v.shape) + self.logits.shape[-1:])
+            return jnp.take_along_axis(
+                logits, v[..., None].astype(jnp.int32), -1)[..., 0]
+        return apply(fn, value, op_name="categorical_log_prob")
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return Tensor(-jnp.sum(p * self.logits, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs_ = _arr(probs)
+        else:
+            self.probs_ = jax.nn.sigmoid(_arr(logits))
+        super().__init__(np.shape(self.probs_))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            next_key(), self.probs_, out_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return apply(fn, value, op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(np.broadcast_shapes(np.shape(self.alpha),
+                                             np.shape(self.beta)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(next_key(), self.alpha, self.beta,
+                                      out_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            lbeta = (jax.scipy.special.gammaln(self.alpha)
+                     + jax.scipy.special.gammaln(self.beta)
+                     - jax.scipy.special.gammaln(self.alpha + self.beta))
+            return ((self.alpha - 1) * jnp.log(v)
+                    + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+        return apply(fn, value, op_name="beta_log_prob")
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.concentration), np.shape(self.rate)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        g = jax.random.gamma(next_key(), self.concentration, out_shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        def fn(v):
+            a, b = self.concentration, self.rate
+            return (a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                    - jax.scipy.special.gammaln(a))
+        return apply(fn, value, op_name="gamma_log_prob")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(np.shape(self.concentration)[:-1],
+                         np.shape(self.concentration)[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            next_key(), self.concentration,
+            tuple(shape) + self._batch_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            a = self.concentration
+            lnorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                     - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+            return jnp.sum((a - 1) * jnp.log(v), -1) - lnorm
+        return apply(fn, value, op_name="dirichlet_log_prob")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _arr(probs)
+        super().__init__(np.shape(self.probs_)[:-1],
+                         np.shape(self.probs_)[-1:])
+
+    def sample(self, shape=()):
+        n = self.total_count
+        logits = jnp.log(jnp.maximum(self.probs_, 1e-30))
+        draws = jax.random.categorical(
+            next_key(), logits, shape=(n,) + tuple(shape)
+            + self._batch_shape)
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        def fn(v):
+            logp = jnp.log(jnp.maximum(self.probs_, 1e-30))
+            return (jax.scipy.special.gammaln(self.total_count + 1.0)
+                    - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+                    + jnp.sum(v * logp, -1))
+        return apply(fn, value, op_name="multinomial_log_prob")
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            next_key(), out_shape))
+
+    def log_prob(self, value):
+        return apply(
+            lambda v: -jnp.abs(v - self.loc) / self.scale
+            - jnp.log(2 * self.scale), value, op_name="laplace_log_prob")
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal._batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._normal.sample(shape)._value))
+
+    def log_prob(self, value):
+        def fn(v):
+            logv = jnp.log(v)
+            n = self._normal
+            return (-jnp.square(logv - n.loc) / (2 * jnp.square(n.scale))
+                    - jnp.log(n.scale) - 0.5 * math.log(2 * math.pi) - logv)
+        return apply(fn, value, op_name="lognormal_log_prob")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(
+            next_key(), out_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+        return apply(fn, value, op_name="gumbel_log_prob")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(next_key(), out_shape)
+                      / self.rate)
+
+    def log_prob(self, value):
+        return apply(lambda v: jnp.log(self.rate) - self.rate * v, value,
+                     op_name="exponential_log_prob")
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _arr(probs)
+        super().__init__(np.shape(self.probs_))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(next_key(), out_shape, minval=1e-7)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        return apply(
+            lambda v: v * jnp.log1p(-self.probs_) + jnp.log(self.probs_),
+            value, op_name="geometric_log_prob")
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(np.shape(self.loc),
+                                             np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(
+            next_key(), out_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            z = (v - self.loc) / self.scale
+            return -jnp.log(math.pi * self.scale * (1 + jnp.square(z)))
+        return apply(fn, value, op_name="cauchy_log_prob")
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.df), np.shape(self.loc), np.shape(self.scale)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.t(
+            next_key(), self.df, out_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            d = self.df
+            z = (v - self.loc) / self.scale
+            return (jax.scipy.special.gammaln((d + 1) / 2)
+                    - jax.scipy.special.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(jnp.square(z) / d))
+        return apply(fn, value, op_name="studentt_log_prob")
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(np.shape(self.rate))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(next_key(), self.rate, out_shape)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        return apply(
+            lambda v: v * jnp.log(self.rate) - self.rate
+            - jax.scipy.special.gammaln(v + 1), value,
+            op_name="poisson_log_prob")
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _arr(total_count)
+        self.probs_ = _arr(probs)
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.total_count), np.shape(self.probs_)))
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.binomial(
+            next_key(), self.total_count, self.probs_, out_shape))
+
+    def log_prob(self, value):
+        def fn(v):
+            n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+            return (jax.scipy.special.gammaln(n + 1)
+                    - jax.scipy.special.gammaln(v + 1)
+                    - jax.scipy.special.gammaln(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return apply(fn, value, op_name="binomial_log_prob")
+
+
+ExponentialFamily = Distribution
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) \
+            else [transforms]
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+
+# -- KL registry ------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (cp, cq), f in _KL_REGISTRY.items():
+            if isinstance(p, cp) and isinstance(q, cq):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jnp.exp(p.logits)
+    return Tensor(jnp.sum(pp * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return Tensor(a * jnp.log(a / b) + (1 - a) * jnp.log((1 - a) / (1 - b)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))

@@ -1,0 +1,77 @@
+"""paddle_tpu.fft (reference: python/paddle/fft.py) — jnp.fft backed."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
+           "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _mk(name, fn, has_n=True):
+    if has_n:
+        def op(x, n=None, axis=-1, norm="backward", name=None):
+            return apply(lambda a: fn(a, n=n, axis=int(axis), norm=norm), x,
+                         op_name=name)
+    else:
+        def op(x, s=None, axes=None, norm="backward", name=None):
+            return apply(lambda a: fn(a, s=s, axes=axes, norm=norm), x,
+                         op_name=name)
+    op.__name__ = name
+    return op
+
+
+fft = _mk("fft", jnp.fft.fft)
+ifft = _mk("ifft", jnp.fft.ifft)
+rfft = _mk("rfft", jnp.fft.rfft)
+irfft = _mk("irfft", jnp.fft.irfft)
+hfft = _mk("hfft", jnp.fft.hfft)
+ihfft = _mk("ihfft", jnp.fft.ihfft)
+fftn = _mk("fftn", jnp.fft.fftn, has_n=False)
+ifftn = _mk("ifftn", jnp.fft.ifftn, has_n=False)
+rfftn = _mk("rfftn", jnp.fft.rfftn, has_n=False)
+irfftn = _mk("irfftn", jnp.fft.irfftn, has_n=False)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x,
+                 op_name="fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x,
+                 op_name="ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x,
+                 op_name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x,
+                 op_name="irfft2")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.fftfreq(int(n), d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+
+    return Tensor(jnp.fft.rfftfreq(int(n), d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                 op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 op_name="ifftshift")

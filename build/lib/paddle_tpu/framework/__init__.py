@@ -1,0 +1,2 @@
+from . import random
+from .random import seed, get_rng_state, set_rng_state

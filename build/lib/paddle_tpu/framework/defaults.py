@@ -1,0 +1,18 @@
+"""Default dtype registry (reference: paddle.set_default_dtype)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+_default_dtype = np.dtype(np.float32)
+
+
+def get_default_dtype():
+    return _default_dtype.name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+    return _default_dtype.name

@@ -1,0 +1,51 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py:743,985
+— pickle-based nested state dicts)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTO = 4
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj.numpy()))
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_storable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return obj[1] if return_numpy else Tensor(obj[1])
+    if isinstance(obj, dict):
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_storable(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_storable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTO, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy=return_numpy)

@@ -1,0 +1,124 @@
+"""Global RNG state.
+
+Reference analog: paddle.seed + per-device generators
+(python/paddle/framework/random.py) and the TP-determinism RNG tracker
+(fleet/meta_parallel/parallel_layers/random.py). JAX randomness is functional
+(explicit keys), so the framework keeps a key-splitting generator for eager
+mode and a *traceable* key context for compiled steps: inside
+`rng_guard(key)` every draw folds a fresh counter into the provided (possibly
+traced) key — deterministic, replayable, and jit-safe.
+
+The named-state tracker (`RNGStatesTracker`) reproduces the reference's
+model-parallel seed discipline: "global" states agree across TP ranks
+(e.g. residual dropout), "local" states differ per rank (e.g. attention
+dropout inside a sharded region)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "rng_guard",
+           "RNGStatesTracker", "get_rng_tracker", "default_seed"]
+
+_DEFAULT_SEED = 34342423252
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.key(_DEFAULT_SEED)
+        self.counter = 0
+        # when set, draws fold counters into this (possibly traced) key
+        self.guard_key = None
+        self.guard_counter = 0
+
+
+_state = _RNGState()
+
+
+def default_seed():
+    return _DEFAULT_SEED
+
+
+def seed(s: int):
+    _state.key = jax.random.key(int(s))
+    _state.counter = 0
+    return s
+
+
+def get_rng_state():
+    return (_state.key, _state.counter)
+
+
+def set_rng_state(state):
+    _state.key, _state.counter = state
+
+
+def next_key():
+    """Return a fresh PRNG key. Inside rng_guard, derives from the guard key
+    (trace-safe); otherwise advances the global eager state."""
+    if _state.guard_key is not None:
+        _state.guard_counter += 1
+        return jax.random.fold_in(_state.guard_key, _state.guard_counter)
+    _state.counter += 1
+    return jax.random.fold_in(_state.key, _state.counter)
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Route all framework randomness through `key` (a jax PRNG key or int
+    seed, may be traced). Used by the compiled train step so dropout etc. get
+    fresh per-step randomness as a function input, not baked constants."""
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    elif hasattr(key, "dtype") and not jax.dtypes.issubdtype(
+        key.dtype, jax.dtypes.prng_key
+    ):
+        # a raw scalar (e.g. per-step seed passed into a jitted step)
+        key = jax.random.key(key.astype(jnp.uint32))
+    prev = (_state.guard_key, _state.guard_counter)
+    _state.guard_key = key
+    _state.guard_counter = 0
+    try:
+        yield
+    finally:
+        _state.guard_key, _state.guard_counter = prev
+
+
+class RNGStatesTracker:
+    """Named RNG streams for TP determinism (reference:
+    fleet/meta_parallel/parallel_layers/random.py RNGStatesTracker)."""
+
+    def __init__(self):
+        self.states = {}
+
+    def reset(self):
+        self.states = {}
+
+    def add(self, name, seed_):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = (jax.random.key(int(seed_)), 0)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model-parallel-rng"):
+        if name not in self.states:
+            self.add(name, _DEFAULT_SEED + hash(name) % 10007)
+        key, counter = self.states[name]
+        prev = (_state.guard_key, _state.guard_counter)
+        _state.guard_key = key
+        _state.guard_counter = counter
+        try:
+            yield
+        finally:
+            self.states[name] = (key, _state.guard_counter)
+            _state.guard_key, _state.guard_counter = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker():
+    return _tracker

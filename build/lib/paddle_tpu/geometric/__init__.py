@@ -1,0 +1,105 @@
+"""paddle.geometric (reference: python/paddle/geometric/ — message passing
++ segment ops). Segment ops map to jax.ops.segment_* (XLA scatter-reduce)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _nseg(segment_ids):
+    import numpy as np
+
+    ids = segment_ids.numpy() if isinstance(segment_ids, Tensor) else \
+        np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return apply(lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                 data, segment_ids, op_name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+
+    def fn(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones(d.shape[:1]), i, num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (d.ndim - 1))
+    return apply(fn, data, segment_ids, op_name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return apply(lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
+                 data, segment_ids, op_name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _nseg(segment_ids)
+    return apply(lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
+                 data, segment_ids, op_name="segment_min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src], scatter-reduce to dst (reference message passing)."""
+    import numpy as np
+
+    n = out_size or (int(dst_index.numpy().max()) + 1
+                     if isinstance(dst_index, Tensor)
+                     else int(np.asarray(dst_index).max()) + 1)
+
+    def fn(xa, s, d):
+        msgs = jnp.take(xa, s, axis=0)
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, d, num_segments=n)
+        if reduce_op == "mean":
+            tot = jax.ops.segment_sum(msgs, d, num_segments=n)
+            cnt = jax.ops.segment_sum(jnp.ones(msgs.shape[:1]), d,
+                                      num_segments=n)
+            return tot / jnp.maximum(cnt, 1.0).reshape(
+                (-1,) + (1,) * (msgs.ndim - 1))
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, d, num_segments=n)
+        if reduce_op == "min":
+            return jax.ops.segment_min(msgs, d, num_segments=n)
+        raise ValueError(reduce_op)
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    import numpy as np
+
+    n = out_size or (int(dst_index.numpy().max()) + 1
+                     if isinstance(dst_index, Tensor)
+                     else int(np.asarray(dst_index).max()) + 1)
+
+    def fn(xa, ya, s, d):
+        msgs = jnp.take(xa, s, axis=0)
+        if message_op == "add":
+            msgs = msgs + ya
+        elif message_op == "mul":
+            msgs = msgs * ya
+        if reduce_op == "sum":
+            return jax.ops.segment_sum(msgs, d, num_segments=n)
+        if reduce_op == "max":
+            return jax.ops.segment_max(msgs, d, num_segments=n)
+        raise ValueError(reduce_op)
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    def fn(xa, ya, s, d):
+        a = jnp.take(xa, s, axis=0)
+        b = jnp.take(ya, d, axis=0)
+        return a + b if message_op == "add" else a * b
+    return apply(fn, x, y, src_index, dst_index, op_name="send_uv")

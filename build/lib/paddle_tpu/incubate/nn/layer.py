@@ -1,0 +1,39 @@
+"""Fused layers (reference: python/paddle/incubate/nn/layer/)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+class FusedMultiHeadAttention(nn.MultiHeadAttention):
+    """On TPU the standard MultiHeadAttention already routes to the fused
+    Pallas kernel; this alias keeps the incubate API."""
+
+
+class FusedFeedForward(nn.Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, **kwargs):
+        super().__init__()
+        self.linear1 = nn.Linear(d_model, dim_feedforward)
+        self.linear2 = nn.Linear(dim_feedforward, d_model)
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.act_dropout = nn.Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, src):
+        from .. import nn as _  # noqa
+
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        from ...nn import functional as F
+
+        src = self.linear2(self.act_dropout(
+            getattr(F, self.activation)(self.linear1(src))))
+        src = residual + self.dropout(src)
+        if not self.normalize_before:
+            src = self.norm(src)
+        return src

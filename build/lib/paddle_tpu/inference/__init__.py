@@ -1,0 +1,347 @@
+"""Inference/serving engine.
+
+Reference analog: Paddle Inference (paddle/fluid/inference/api/ —
+`AnalysisConfig` at paddle_analysis_config.h, `CreatePaddlePredictor`,
+zero-copy tensors at paddle_api.h, analysis passes + TensorRT subgraph
+engines) and its Python surface paddle.inference.Config/create_predictor.
+
+TPU-native redesign:
+- The deploy artifact is a **serialized StableHLO module** (jax.export) +
+  a params archive + a JSON signature — portable across jax versions and
+  chips, compiled by XLA at load for whatever device serves it (the role
+  TensorRT/analysis passes play on GPU belongs to XLA here).
+- "Analysis passes" that change numerics run at save/compile time:
+  precision conversion (bf16/fp16 weight cast + compute autocast) — XLA
+  owns fusion/layout/memory planning (the reference's ir_optim +
+  memory_optim switches).
+- Zero-copy handles: input/output tensors are device arrays; copy_from_cpu
+  stages host→HBM once, copy_to_cpu is the only D2H transfer.
+
+Reference pointers for parity checks: Config switches
+(paddle_analysis_config.h), PaddlePredictor::Run (paddle_api.h),
+save/load_inference_model (python/paddle/static/io.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PrecisionType", "create_predictor",
+           "save_inference_model", "load_inference_model", "Tensor"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"      # accepted; quantization handled by paddle_tpu.quantization
+
+
+class Config:
+    """reference: paddle.inference.Config (AnalysisConfig)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._profile = False
+        self._threads = 1
+
+    # -- model location ---------------------------------------------------
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        self._model_path = model_path
+        self._params_path = params_path
+
+    def model_path(self):
+        return self._model_path
+
+    # -- device selection (reference enable_use_gpu/disable_gpu) ----------
+    def enable_use_tpu(self, device_id: int = 0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    # GPU-API compatibility alias: selects the accelerator (TPU here)
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 0,
+                       device_id: int = 0, precision=None):
+        self.enable_use_tpu(device_id)
+        if precision is not None:
+            self._precision = precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self):
+        return self._device != "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = n
+
+    # -- optimization switches --------------------------------------------
+    def switch_ir_optim(self, on: bool = True):
+        self._ir_optim = on
+
+    def enable_memory_optim(self, on: bool = True):
+        self._memory_optim = on
+
+    def enable_profile(self):
+        self._profile = True
+
+    def set_precision(self, precision: str):
+        self._precision = precision
+
+    # TensorRT-era API accepted for script compatibility; XLA is the
+    # subgraph compiler on TPU so this only records the precision request.
+    def enable_tensorrt_engine(self, workspace_size=1 << 30,
+                               max_batch_size=1, min_subgraph_size=3,
+                               precision_mode=None, use_static=False,
+                               use_calib_mode=False):
+        if precision_mode is not None:
+            self._precision = precision_mode
+
+    def summary(self):
+        return json.dumps({
+            "model": self._model_path, "device": self._device,
+            "precision": self._precision, "ir_optim": self._ir_optim,
+            "memory_optim": self._memory_optim}, indent=2)
+
+
+class Tensor:
+    """Named zero-copy handle (reference: ZeroCopyTensor, paddle_api.h).
+    Holds a device array; copy_from_cpu stages to device, copy_to_cpu
+    fetches."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        import jax
+
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output handle")
+        dev = self._pred._device
+        val = jax.device_put(np.asarray(arr), dev)
+        self._pred._inputs[self.name] = val
+
+    def reshape(self, shape):      # reference API; shapes come from data
+        pass
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            return np.asarray(self._pred._inputs[self.name])
+        return np.asarray(self._pred._outputs[self.name])
+
+    def shape(self):
+        store = self._pred._inputs if self._is_input \
+            else self._pred._outputs
+        return list(store[self.name].shape)
+
+
+def save_inference_model(path_prefix: str, layer, input_spec,
+                         precision: str = PrecisionType.Float32,
+                         input_names: Optional[Sequence[str]] = None,
+                         output_names: Optional[Sequence[str]] = None):
+    """Serialize `layer` for serving (reference:
+    paddle.static.save_inference_model / jit.save deploy path).
+
+    Writes:
+      <prefix>.pdmodel    — serialized StableHLO artifact (jax.export)
+      <prefix>.pdiparams  — params archive (npz; cast when precision!=fp32)
+      <prefix>.pdconfig   — JSON signature (names, shapes, dtypes, precision)
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from ..jit import functional as FB
+
+    params = FB.current_params(layer)
+    buffers = FB.current_buffers(layer)
+    lowp = precision in (PrecisionType.Bfloat16, PrecisionType.Half)
+    cast = jnp.bfloat16 if precision == PrecisionType.Bfloat16 \
+        else jnp.float16
+    if lowp:
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(cast)
+            if np.issubdtype(np.asarray(a).dtype, np.floating) else a,
+            params)
+    # export over FLAT param/buffer lists so load never needs the treedef
+    flat_p, tree_p = jax.tree_util.tree_flatten(params)
+    flat_b, tree_b = jax.tree_util.tree_flatten(buffers)
+
+    def pure(flat_p, flat_b, *ins):
+        ps = jax.tree_util.tree_unflatten(tree_p, flat_p)
+        bs = jax.tree_util.tree_unflatten(tree_b, flat_b)
+        if lowp:
+            ins = tuple(x.astype(cast)
+                        if jnp.issubdtype(x.dtype, jnp.floating) else x
+                        for x in ins)
+        out, _ = FB.call_functional(layer, ps, bs, ins, train=False)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(o.astype(jnp.float32)
+                     if jnp.issubdtype(o.dtype, jnp.floating) else o
+                     for o in outs)
+
+    # InputSpec dims of None export as symbolic dims (dynamic batch — the
+    # reference's save_inference_model default); static specs export as
+    # concrete zeros
+    if any(d is None for s in input_spec for d in tuple(s.shape)):
+        # None dims at the same axis position share one symbol (d0, d1, …)
+        # so inputs with a common dynamic batch dim stay shape-compatible
+        # under export — the reference's dynamic-batch convention
+        scope = jexport.SymbolicScope()
+        args = []
+        for s in input_spec:
+            spec = ",".join(f"d{j}" if d is None else str(d)
+                            for j, d in enumerate(tuple(s.shape)))
+            shp = jexport.symbolic_shape(spec, scope=scope)
+            args.append(jax.ShapeDtypeStruct(shp, s.dtype))
+    else:
+        args = [jnp.zeros(tuple(s.shape), s.dtype) for s in input_spec]
+    # Export for both chip families so the artifact deploys anywhere (the
+    # portability the reference gets from shipping ProgramDesc + re-running
+    # analysis passes on the target device).
+    exported = jexport.export(jax.jit(pure),
+                              platforms=("cpu", "tpu"))(
+        flat_p, flat_b, *args)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+
+    # bf16/fp8 (ml_dtypes, numpy kind 'V') don't round-trip through npz —
+    # store those as flat uint8 with dtype/shape recorded in the signature
+    arrays, meta = {}, {}
+    for key, a in [(f"p{i}", a) for i, a in enumerate(flat_p)] + \
+                  [(f"b{i}", a) for i, a in enumerate(flat_b)]:
+        a = np.asarray(a)
+        if a.dtype.kind == "V":
+            arrays[key] = np.frombuffer(a.tobytes(), np.uint8)
+            meta[key] = {"dtype": a.dtype.name, "shape": list(a.shape)}
+        else:
+            arrays[key] = a
+    np.savez(path_prefix + ".pdiparams", **arrays)
+
+    in_names = list(input_names or
+                    [getattr(s, "name", None) or f"x{i}"
+                     for i, s in enumerate(input_spec)])
+    sig = {
+        "inputs": [{"name": n, "shape": list(s.shape),
+                    "dtype": str(s.dtype)}
+                   for n, s in zip(in_names, input_spec)],
+        "output_names": list(output_names or []),
+        "precision": precision,
+        "n_params": len(flat_p), "n_buffers": len(flat_b),
+        "array_meta": meta,
+    }
+    with open(path_prefix + ".pdconfig", "w") as f:
+        json.dump(sig, f)
+    return path_prefix
+
+
+def load_inference_model(path_prefix: str):
+    """Load the serving artifact; returns (exported, params, buffers, sig)."""
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path_prefix + ".pdconfig") as f:
+        sig = json.load(f)
+    data = np.load(path_prefix + ".pdiparams.npz")
+    meta = sig.get("array_meta", {})
+
+    def unpack(key):
+        a = data[key]
+        m = meta.get(key)
+        if m is not None:
+            import ml_dtypes
+
+            dt = np.dtype(getattr(ml_dtypes, m["dtype"]))
+            a = np.frombuffer(a.tobytes(), dt).reshape(m["shape"])
+        return a
+
+    params = [unpack(f"p{i}") for i in range(sig["n_params"])]
+    buffers = [unpack(f"b{i}") for i in range(sig["n_buffers"])]
+    return exported, params, buffers, sig
+
+
+class Predictor:
+    """reference: paddle.inference.Predictor (AnalysisPredictor). Runs the
+    exported module under jit on the configured device with a persistent
+    compile cache (first run compiles, steady-state replays)."""
+
+    def __init__(self, config: Config):
+        import jax
+
+        self.config = config
+        plat = "cpu" if config._device == "cpu" else None
+        devs = jax.devices(plat) if plat else jax.devices()
+        self._device = devs[min(config._device_id, len(devs) - 1)]
+        ex, params, buffers, sig = load_inference_model(config._model_path)
+        self._exported = ex
+        self._params = [jax.device_put(p, self._device) for p in params]
+        self._buffers = [jax.device_put(b, self._device) for b in buffers]
+        self._sig = sig
+        self._in_names = [i["name"] for i in sig["inputs"]]
+        self._out_names: List[str] = list(sig["output_names"])
+        self._inputs: Dict[str, object] = {}
+        self._outputs: Dict[str, object] = {}
+        self._compiled = {}
+
+    # -- handle API (reference get_input_handle / zero-copy) -------------
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_output_names(self):
+        if not self._out_names:
+            return [f"out{i}" for i in range(len(self._outputs))] \
+                if self._outputs else ["out0"]
+        return list(self._out_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=True)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, arrays):
+        import jax
+
+        key = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = jax.jit(lambda p, b, *ins: self._exported.call(p, b, *ins))
+            self._compiled[key] = fn
+        out = fn(self._params, self._buffers, *arrays)
+        return out if isinstance(out, (list, tuple)) else (out,)
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Modern API: run(list_of_arrays) -> list of np arrays.
+        Handle API: stage via copy_from_cpu then run()."""
+        import jax
+
+        if inputs is not None:
+            arrays = [jax.device_put(np.asarray(a), self._device)
+                      for a in inputs]
+        else:
+            arrays = [self._inputs[n] for n in self._in_names]
+        outs = self._execute(arrays)
+        names = self._out_names or [f"out{i}" for i in range(len(outs))]
+        self._out_names = names
+        self._outputs = dict(zip(names, outs))
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle.inference.create_predictor."""
+    return Predictor(config)

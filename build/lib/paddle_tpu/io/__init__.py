@@ -1,0 +1,452 @@
+"""paddle_tpu.io — Dataset/Sampler/DataLoader.
+
+Reference analog: python/paddle/io/ (reader.py:216 DataLoader with
+multiprocess workers). TPU-first host pipeline: workers produce numpy
+batches; the loader keeps a small prefetch queue and (optionally) stages
+batches to device asynchronously so HBM feeds never block the step loop.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework.random import default_seed
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "Subset", "random_split", "Sampler",
+           "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+           "BatchSampler", "DistributedBatchSampler", "DataLoader",
+           "get_worker_info"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, tuple) else (item,))
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if all(isinstance(l, float) for l in lengths):
+        n = len(dataset)
+        lengths = [int(math.floor(n * f)) for f in lengths]
+        lengths[-1] += n - sum(lengths)
+    total = sum(lengths)
+    perm = np.random.RandomState(default_seed() % (2 ** 31)).permutation(
+        total)
+    out = []
+    offset = 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l].tolist()))
+        offset += l
+    return out
+
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self._epoch = 0
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        rng = np.random.RandomState()
+        if self.replacement:
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(
+            weights.numpy() if isinstance(weights, Tensor) else weights,
+            np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(self.weights), self.num_samples,
+                               replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Shards indices across data-parallel ranks (reference:
+    python/paddle/io/dataloader/batch_sampler.py DistributedBatchSampler)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        from ..distributed import get_rank, get_world_size
+
+        self.nranks = num_replicas if num_replicas is not None \
+            else get_world_size()
+        self.local_rank = rank if rank is not None else get_rank()
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        indices = np.concatenate(
+            [indices, indices[: self.total_size - n]])
+        indices = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in indices.tolist():
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+class _WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+import os
+
+
+def jax_tree_to_numpy(obj):
+    """Tensors -> numpy for cross-process transport."""
+    if isinstance(obj, Tensor):
+        return ("__t__", np.asarray(obj.numpy()))
+    if isinstance(obj, (list, tuple)):
+        t = [jax_tree_to_numpy(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    if isinstance(obj, dict):
+        return {k: jax_tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def numpy_tree_to_tensor(obj):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__t__":
+        return Tensor(obj[1])
+    if isinstance(obj, list):
+        return [numpy_tree_to_tensor(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(numpy_tree_to_tensor(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: numpy_tree_to_tensor(v) for k, v in obj.items()}
+    return obj
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return tuple(default_collate_fn(list(col)) for col in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class DataLoader:
+    """Host data pipeline. num_workers>0 uses a thread pool (numpy decoding
+    releases the GIL for the common image/tokenize cases); batches are
+    prefetched into a bounded queue ahead of the consuming step loop."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+            self.batch_size = batch_size
+
+    def __len__(self):
+        if self._iterable:
+            raise RuntimeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._iterable:
+            yield from self._iter_iterable()
+            return
+        if self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+            return
+        if self.use_shared_memory:
+            from ..utils import native
+
+            if native.available():
+                yield from self._iter_shm_workers()
+                return
+        yield from self._iter_workers()
+
+    def _iter_iterable(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield self.collate_fn(batch)
+
+    def _iter_shm_workers(self):
+        """Multiprocess workers hand batches through native shared-memory
+        rings (reference: io/dataloader/worker.py + shared-mem transport;
+        native side csrc/pt_runtime.cpp). Batch i is produced by worker
+        i % W and rings are drained round-robin, preserving order."""
+        import multiprocessing as mp
+        import pickle
+
+        from ..utils.native import ShmRing
+
+        all_batches = list(self.batch_sampler)
+        w = min(self.num_workers, max(len(all_batches), 1))
+        ring_bytes = 64 << 20
+        base = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+        rings = [ShmRing(f"{base}_{i}", ring_bytes, create=True)
+                 for i in range(w)]
+
+        dataset = self.dataset
+        collate = self.collate_fn
+        init_fn = self.worker_init_fn
+
+        def worker(widx, ring_name):
+            ring = ShmRing(ring_name, ring_bytes, create=False)
+            try:
+                global _worker_info
+                import paddle_tpu.io as _io
+
+                _io._worker_info = _WorkerInfo(widx, w, dataset)
+                if init_fn is not None:
+                    init_fn(widx)
+                for bi in range(widx, len(all_batches), w):
+                    batch = collate([dataset[j] for j in all_batches[bi]])
+                    payload = pickle.dumps(
+                        jax_tree_to_numpy(batch), protocol=4)
+                    ring.write(payload)
+            finally:
+                ring.mark_closed()
+                ring.close(unlink=False)
+
+        ctx = mp.get_context("fork")
+        procs = [ctx.Process(target=worker, args=(i, f"{base}_{i}"),
+                             daemon=True) for i in range(w)]
+        for p in procs:
+            p.start()
+        try:
+            import pickle
+
+            for bi in range(len(all_batches)):
+                data = rings[bi % w].read(
+                    timeout_ms=int((self.timeout or 300) * 1000))
+                if data is None:
+                    return
+                yield numpy_tree_to_tensor(pickle.loads(data))
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for r in rings:
+                r.close(unlink=True)
+
+    def _iter_workers(self):
+        import concurrent.futures
+
+        max_in_flight = self.num_workers * self.prefetch_factor
+        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as ex:
+            pending = {}
+            it = iter(self.batch_sampler)
+            next_submit = 0
+            next_yield = 0
+            exhausted = False
+            while True:
+                while not exhausted and len(pending) < max_in_flight:
+                    try:
+                        indices = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending[next_submit] = ex.submit(self._fetch, indices)
+                    next_submit += 1
+                if next_yield not in pending:
+                    if exhausted:
+                        return
+                    continue
+                fut = pending.pop(next_yield)
+                next_yield += 1
+                yield fut.result(timeout=self.timeout or None)

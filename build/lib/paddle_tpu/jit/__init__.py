@@ -1,0 +1,4 @@
+from . import api
+from . import functional
+from .api import (InputSpec, StaticFunction, TrainStep, enable_to_static,
+                  ignore_module, load, not_to_static, save, to_static)

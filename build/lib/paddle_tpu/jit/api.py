@@ -1,0 +1,387 @@
+"""@to_static and the compiled TrainStep.
+
+Reference analog: paddle.jit.to_static (python/paddle/jit/api.py:173) +
+SOT/dy2static (33.6 kLoC of AST/bytecode machinery) + the static
+PirInterpreter. On TPU none of that machinery is needed: the functional
+bridge (jit/functional.py) re-traces the SAME eager model as a pure function
+and jax.jit compiles it — trace-and-compile IS the graph capture. TrainStep
+is the whole-graph compiled training step (forward+backward+optimizer in one
+XLA executable with donated buffers), the single most important performance
+primitive on TPU (SURVEY.md §7 "hard parts" (a)).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from ..framework.random import next_key, rng_guard
+from . import functional as FB
+
+__all__ = ["to_static", "TrainStep", "in_to_static_tracing", "save", "load",
+           "ignore_module", "not_to_static", "enable_to_static"]
+
+
+def _trace_break_errors():
+    """Exceptions that mean 'this Python cannot be traced' — the
+    graph-break condition. Reference: SOT (python/paddle/jit/sot/) exists
+    to eval-frame-capture exactly these cases; the TPU-native 80/20 is to
+    fall back to eager for the offending callable with a warning."""
+    import jax.errors as jerr
+
+    return (jerr.TracerBoolConversionError,
+            jerr.TracerArrayConversionError,
+            jerr.TracerIntegerConversionError,
+            jerr.ConcretizationTypeError)
+
+
+def _warn_graph_break(name: str, exc: Exception):
+    import warnings
+
+    warnings.warn(
+        f"to_static: '{name}' contains Python that cannot be traced "
+        f"({type(exc).__name__}: {str(exc).splitlines()[0][:120]}). "
+        f"Falling back to EAGER execution for this callable (graph break). "
+        f"Use jax-compatible control flow (lax.cond/where) to recover "
+        f"whole-graph compilation.", RuntimeWarning, stacklevel=3)
+
+_tracing = threading.local()
+
+
+def in_to_static_tracing():
+    return getattr(_tracing, "active", False)
+
+
+class _TracingGuard:
+    def __enter__(self):
+        self.prev = getattr(_tracing, "active", False)
+        _tracing.active = True
+        return self
+
+    def __exit__(self, *exc):
+        _tracing.active = self.prev
+        return False
+
+
+class InputSpec:
+    """reference: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+class StaticFunction:
+    """A compiled callable over a Layer or plain function."""
+
+    def __init__(self, fn_or_layer, input_spec=None, train=None):
+        from ..nn.layer.layers import Layer
+
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._train = train
+        self._compiled = None
+        self._n_calls = 0
+
+    def _build_layer_fn(self):
+        layer = self._target
+
+        def pure(params, buffers, seed, *in_arrays):
+            with _TracingGuard(), rng_guard(seed):
+                out, new_buf = FB.call_functional(
+                    layer, params, buffers, in_arrays,
+                    train=layer.training if self._train is None
+                    else self._train)
+            return out, new_buf
+
+        return jax.jit(pure)
+
+    def _build_fn(self):
+        fn = self._target
+
+        def pure(seed, *in_arrays, **kw):
+            with _TracingGuard(), rng_guard(seed), no_grad():
+                ins = [Tensor(a, stop_gradient=True) for a in in_arrays]
+                out = fn(*ins, **kw)
+            return jax.tree.map(
+                lambda x: x._value if isinstance(x, Tensor) else x, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if getattr(self, "_fallback", False):
+            return self._eager_call(*args, **kwargs)
+        in_arrays = [a._value if isinstance(a, Tensor) else a for a in args]
+        seed = next_key()
+        try:
+            if self._is_layer:
+                if self._compiled is None:
+                    self._compiled = self._build_layer_fn()
+                params = FB.current_params(self._target)
+                buffers = FB.current_buffers(self._target)
+                out, new_buf = self._compiled(params, buffers, seed,
+                                              *in_arrays)
+                FB.write_back(self._target, {}, new_buf)
+            else:
+                if self._compiled is None:
+                    self._compiled = self._build_fn()
+                out = self._compiled(seed, *in_arrays, **kwargs)
+        except _trace_break_errors() as e:
+            _warn_graph_break(getattr(self._target, "__name__",
+                                      type(self._target).__name__), e)
+            self._fallback = True
+            return self._eager_call(*args, **kwargs)
+        return jax.tree.map(lambda x: Tensor(x), out)
+
+    def _eager_call(self, *args, **kwargs):
+        # mirror the compiled path's semantics: plain functions traced
+        # under no_grad with stop_gradient inputs stay that way eagerly.
+        # Only array-like args become Tensors — None/str/flags pass
+        # through untouched, as they did through the traced pytree.
+        def wrap(a, stop_grad):
+            if isinstance(a, Tensor) or a is None \
+                    or isinstance(a, (str, bool)):
+                return a
+            if hasattr(a, "__array__") or isinstance(
+                    a, (int, float, complex, list, tuple)):
+                try:
+                    return Tensor(a, stop_gradient=stop_grad)
+                except (TypeError, ValueError):
+                    return a
+            return a
+
+        if self._is_layer:
+            ins = [wrap(a, False) for a in args]
+            return self._target(*ins, **kwargs)
+        ins = [wrap(a, True) for a in args]
+        with no_grad():
+            return self._target(*ins, **kwargs)
+
+    # compat surface
+    def concrete_program(self):
+        return None
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper: compile a Layer or function with XLA."""
+    def deco(fn):
+        from ..nn.layer.layers import Layer
+
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn, input_spec)
+            fn.forward_static = sf
+            # replace forward path: calling layer goes through compiled fn
+            orig_forward = fn.forward
+            fn._static_function = sf
+            return fn
+        if callable(fn):
+            return StaticFunction(fn, input_spec)
+        raise TypeError("to_static expects a Layer or callable")
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def enable_to_static(flag: bool):
+    return None
+
+
+def build_train_step(model, loss_fn, optimizer, train=True, amp_dtype=None):
+    """Build the fused forward+backward+update step function and jit it
+    with donated param/opt-state/buffer pytrees.
+
+    Shared by TrainStep (eager-facing) and the auto-parallel static Engine.
+    Non-trainable params (stop_gradient / trainable=False) and params
+    outside the optimizer's parameter list pass through untouched —
+    matching eager Optimizer.step's filter.
+    """
+    opt = optimizer
+    update = opt._update
+    grad_clip = opt._grad_clip
+    idx_of = {id(p): i for i, p in enumerate(opt._parameter_list)}
+    lr_wd_by_name = {}
+    trainable = set()
+    for name, p in model.named_parameters():
+        lr_wd_by_name[name] = opt._param_lr_wd(p, idx_of.get(id(p), 0))
+        if id(p) in idx_of and getattr(p, "trainable", True) \
+                and not p.stop_gradient:
+            trainable.add(name)
+
+    def step(params, opt_states, buffers, lr, step_i, seed, *batch):
+        frozen = {k: v for k, v in params.items() if k not in trainable}
+
+        def compute_loss(p_train):
+            p = dict(frozen)
+            p.update(p_train)
+            if amp_dtype is not None:
+                p = jax.tree.map(
+                    lambda a: a.astype(amp_dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+            with _TracingGuard(), rng_guard(seed):
+                out, new_buf = FB.call_functional(
+                    model, p, buffers, batch[:-1] if loss_fn else batch,
+                    train=train)
+                if loss_fn is not None:
+                    with no_grad():
+                        out_t = jax.tree.map(lambda x: Tensor(x), out)
+                        label = Tensor(batch[-1])
+                        loss_t = loss_fn(out_t, label)
+                    loss = loss_t._value
+                else:
+                    loss = out
+            return loss.astype(jnp.float32), new_buf
+
+        p_train = {k: v for k, v in params.items() if k in trainable}
+        (loss, new_buf), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(p_train)
+        names = list(p_train.keys())
+        gs = [grads[k] for k in names]
+        if grad_clip is not None:
+            gs = grad_clip.apply(gs)
+        new_params = dict(frozen)
+        new_states = {}
+        for k, g in zip(names, gs):
+            st = dict(opt_states.get(k) or {})
+            st["_step"] = step_i
+            lr_mult, wd = lr_wd_by_name.get(k, (1.0, 0.0))
+            p_new, st_new = update(params[k], g.astype(params[k].dtype),
+                                   st, lr * lr_mult, wd)
+            st_new.pop("_step", None)
+            new_params[k] = p_new
+            new_states[k] = st_new
+        # untouched states pass through (donated buffers must be returned)
+        for k, st in opt_states.items():
+            if k not in new_states:
+                new_states[k] = st
+        return new_params, new_states, new_buf, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+class TrainStep:
+    """One fused XLA executable: forward + backward + optimizer update.
+
+    Usage:
+        step = TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)          # params updated in place
+
+    The pytree of parameters and optimizer state is donated each call, so
+    XLA updates weights in place in HBM (no copy), and dropout randomness
+    comes in through a per-step key — fresh every call, deterministic under
+    paddle_tpu.seed().
+    """
+
+    def __init__(self, model, loss_fn, optimizer, train=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.train = train
+        self._compiled = None
+        self._param_names = None
+
+    def _build(self):
+        return build_train_step(self.model, self.loss_fn, self.optimizer,
+                                train=self.train)
+
+    def _opt_states(self, params: Dict) -> Dict:
+        opt = self.optimizer
+        states = {}
+        name_by_id = {id(p): k for k, p in
+                      self.model.named_parameters()}
+        for p in opt._parameter_list:
+            k = name_by_id.get(id(p))
+            if k is None:
+                continue
+            states[k] = opt._get_state(p)
+        return states
+
+    def __call__(self, *batch):
+        if getattr(self, "_fallback", False):
+            return self._eager_step(*batch)
+        if self._compiled is None:
+            self._compiled = self._build()
+        params = FB.current_params(self.model)
+        buffers = FB.current_buffers(self.model)
+        opt_states = self._opt_states(params)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self.optimizer._step_count += 1
+        step_i = jnp.asarray(self.optimizer._step_count, jnp.float32)
+        seed = next_key()
+        arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        try:
+            new_params, new_states, new_buf, loss = self._compiled(
+                params, opt_states, buffers, lr, step_i, seed, *arrays)
+        except _trace_break_errors() as e:
+            _warn_graph_break(type(self.model).__name__, e)
+            self._fallback = True
+            self.optimizer._step_count -= 1   # eager step re-counts
+            return self._eager_step(*batch)
+        FB.write_back(self.model, new_params, new_buf)
+        name_to_param = dict(self.model.named_parameters())
+        for k, st in new_states.items():
+            p = name_to_param.get(k)
+            if p is not None:
+                self.optimizer._accumulators[id(p)] = st
+        return Tensor(loss)
+
+    def _eager_step(self, *batch):
+        """Graph-break path: plain eager forward/backward/update — the
+        numerics of the compiled step without whole-graph compilation."""
+        ins = [b if isinstance(b, Tensor) else Tensor(b) for b in batch]
+        was_training = self.model.training
+        if was_training != self.train:
+            self.model.train() if self.train else self.model.eval()
+        try:
+            if self.loss_fn is not None:
+                out = self.model(*ins[:-1])
+                loss = self.loss_fn(out, ins[-1])
+            else:
+                loss = self.model(*ins)
+            loss.backward()
+            self.optimizer.step()
+            self.optimizer.clear_grad()
+        finally:
+            if was_training != self.train:
+                self.model.train() if was_training else self.model.eval()
+        return loss.detach()
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists state dict + structure note. On TPU the
+    deploy format is the orbax/safetensors-style state dict; recompilation
+    happens at load (XLA compiles per target chip anyway)."""
+    from ..framework.io import save as fsave
+
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    fsave({"state_dict": state,
+           "class": type(layer).__name__}, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+
+    return fload(path + ".pdparams")

@@ -1,0 +1,101 @@
+"""Layer -> pure-function bridge.
+
+This is the load-bearing TPU-first mechanism (SURVEY.md §7 step 8): the same
+nn.Layer that runs define-by-run eagerly can be traced into a pure
+jax function of (params, buffers, inputs) by temporarily swapping each
+Parameter/buffer's underlying array for a traced value. jax.jit/pjit then
+compiles the WHOLE step into one XLA executable — the analog of the
+reference's dy2static + PirInterpreter static path, with XLA doing what
+CINN + the stream-scheduling executor do there.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+_trace_lock = threading.RLock()
+
+
+def layer_state(layer) -> Tuple[Dict[str, Tensor], Dict[str, Tensor]]:
+    """Stable-ordered (params, buffers) name->Tensor maps."""
+    params = dict(layer.named_parameters())
+    buffers = dict(layer.named_buffers())
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _substituted(handles: List[Tensor], arrays: List[Any]):
+    with _trace_lock:
+        originals = [h._value for h in handles]
+        grad_meta = [(h._grad_node, h._out_index) for h in handles]
+        try:
+            for h, a in zip(handles, arrays):
+                h._value = a
+                h._grad_node = None
+            yield handles
+        finally:
+            for h, orig, (gn, oi) in zip(handles, originals, grad_meta):
+                h._value = orig
+                h._grad_node = gn
+                h._out_index = oi
+
+
+def call_functional(layer, param_arrays: Dict[str, Any],
+                    buffer_arrays: Dict[str, Any], args, kwargs=None,
+                    train: bool = True):
+    """Run layer.forward as a pure function.
+
+    Returns (outputs_as_arrays, new_buffer_arrays). Buffer mutation during
+    forward (BN running stats) is captured by reading the handles back after
+    the call — the functional answer to in-place buffer updates.
+    """
+    kwargs = kwargs or {}
+    params, buffers = layer_state(layer)
+    handles = list(params.values()) + list(buffers.values())
+    arrays = [param_arrays[k] for k in params] + \
+             [buffer_arrays[k] for k in buffers]
+    was_training = layer.training
+    if train != was_training:
+        layer.train() if train else layer.eval()
+    try:
+        with _substituted(handles, arrays):
+            with no_grad():
+                ins = [Tensor(a, stop_gradient=True)
+                       if isinstance(a, jax.Array) or hasattr(a, "shape")
+                       and not isinstance(a, Tensor) else a for a in args]
+                ins = [a if not isinstance(a, Tensor) else a for a in ins]
+                out = layer(*ins, **kwargs)
+            new_buffers = {k: b._value for k, b in buffers.items()}
+        out_arrays = jax.tree.map(
+            lambda x: x._value if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return out_arrays, new_buffers
+    finally:
+        if train != was_training:
+            layer.train() if was_training else layer.eval()
+
+
+def current_params(layer) -> Dict[str, Any]:
+    return {k: p._value for k, p in layer.named_parameters()}
+
+
+def current_buffers(layer) -> Dict[str, Any]:
+    return {k: b._value for k, b in layer.named_buffers()}
+
+
+def write_back(layer, param_arrays: Dict[str, Any],
+               buffer_arrays: Dict[str, Any] = None):
+    params, buffers = layer_state(layer)
+    for k, p in params.items():
+        if k in param_arrays:
+            p._value = param_arrays[k]
+    if buffer_arrays:
+        for k, b in buffers.items():
+            if k in buffer_arrays:
+                b._value = buffer_arrays[k]

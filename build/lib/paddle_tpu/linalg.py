@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports)."""
+from .ops.linalg import (cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det,
+                         eig, eigh, eigvals, eigvalsh, householder_product,
+                         inv, lstsq, lu, lu_unpack, matmul, matrix_power,
+                         matrix_rank, multi_dot, norm, pca_lowrank, pinv, qr,
+                         matrix_exp, matrix_norm, ormqr, slogdet, solve,
+                         svd, svd_lowrank, triangular_solve, vander,
+                         vector_norm)
+from .ops.math import cross, dot

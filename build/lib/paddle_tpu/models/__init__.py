@@ -1,0 +1,4 @@
+from . import bert, gpt, llama
+from .bert import BERT_PRESETS, BertConfig, BertForPretraining, BertModel
+from .gpt import GPT_PRESETS, GPTConfig, GPTForCausalLM
+from .llama import LLAMA_PRESETS, LlamaConfig, LlamaForCausalLM, LlamaModel

@@ -1,0 +1,57 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from __future__ import annotations
+
+
+class ParamAttr:
+    """reference: python/paddle/base/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+
+from . import functional
+from . import initializer
+from .layer.layers import (Layer, LayerDict, LayerList, ParameterList,
+                           Sequential)
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                           CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                           Embedding, Flatten, Fold, Identity, Linear, Pad1D,
+                           Pad2D, Pad3D, PixelShuffle, PixelUnshuffle,
+                           Unfold, Upsample, UpsamplingBilinear2D,
+                           UpsamplingNearest2D, ZeroPad2D)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                         Conv3D, Conv3DTranspose)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         RMSNorm, SpectralNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
+                               ReLU, ReLU6, RReLU, Sigmoid, SiLU, Softmax,
+                               Softplus, Softshrink, Softsign, Swish, Tanh,
+                               Tanhshrink, ThresholdedReLU)
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+                         KLDivLoss, L1Loss, MarginRankingLoss, MSELoss,
+                         MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss,
+                         SmoothL1Loss, TripletMarginLoss)
+from .layer.transformer import (MultiHeadAttention, Transformer,
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
+                        SimpleRNNCell)
+from . import utils

@@ -1,0 +1,1362 @@
+"""nn.functional (reference: python/paddle/nn/functional/).
+
+Convs and matmuls pass straight to lax.conv_general_dilated / jnp.matmul so
+XLA tiles them onto the MXU; everything elementwise around them is left for
+XLA fusion. Flash attention routes to the Pallas kernel when available
+(paddle_tpu/ops/pallas/flash_attention.py)."""
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+from ...framework.random import next_key
+
+__all__ = [
+    # activations
+    "relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softmax", "log_softmax", "softplus", "softsign", "softshrink",
+    "hardshrink", "hardsigmoid", "hardswish", "hardtanh", "leaky_relu",
+    "elu", "selu", "celu", "prelu", "rrelu", "mish", "tanhshrink",
+    "thresholded_relu", "maxout", "glu", "gumbel_softmax", "log_sigmoid",
+    # linear/conv/pool
+    "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "max_pool1d", "max_pool2d",
+    "max_pool3d", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool2d", "adaptive_max_pool3d",
+    "unfold", "fold",
+    # norm
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "normalize",
+    "local_response_norm", "rms_norm",
+    # dropout & co
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout", "feature_alpha_dropout",
+    # embedding
+    "embedding", "one_hot",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
+    "cosine_embedding_loss", "ctc_loss", "hinge_embedding_loss", "poisson_nll_loss",
+    "triplet_margin_loss", "multi_label_soft_margin_loss", "square_error_cost",
+    "sigmoid_focal_loss", "label_smooth", "log_loss",
+    # attention & misc
+    "scaled_dot_product_attention", "pad", "interpolate", "upsample",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "affine_grid",
+    "grid_sample", "flatten", "sequence_mask", "temporal_shift",
+]
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _act(op_name, fn):
+    def op(x, name=None):
+        return apply(fn, x, op_name=op_name)
+    op.__name__ = op_name
+    return op
+
+
+relu = _act("relu", jax.nn.relu)
+relu6 = _act("relu6", jax.nn.relu6)
+silu = _act("silu", jax.nn.silu)
+sigmoid = _act("sigmoid", jax.nn.sigmoid)
+tanh = _act("tanh", jnp.tanh)
+softsign = _act("softsign", jax.nn.soft_sign)
+mish = _act("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+tanhshrink = _act("tanhshrink", lambda a: a - jnp.tanh(a))
+log_sigmoid = _act("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._grad_node = out._value, out._grad_node
+    x._out_index, x.stop_gradient = out._out_index, out.stop_gradient
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=bool(approximate)), x,
+                 op_name="gelu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    def fn(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.softmax(a, axis=int(axis))
+    return apply(fn, x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    def fn(a):
+        if d is not None:
+            a = a.astype(d)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return apply(fn, x, op_name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def fn(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a,
+                         jax.nn.softplus(scaled) / beta)
+    return apply(fn, x, op_name="softplus")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, op_name="softshrink")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+        op_name="hardshrink")
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x,
+                 op_name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x,
+                 op_name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x,
+                 op_name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x,
+        op_name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = -1
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply(fn, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    if training:
+        def fn(a):
+            slope = jax.random.uniform(next_key(), a.shape, jnp.float32,
+                                       lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply(fn, x, op_name="rrelu")
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), x,
+                 op_name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply(fn, x, op_name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=int(axis)), x, op_name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def fn(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(
+            next_key(), a.shape, jnp.float32, 1e-20, 1.0))).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx,
+                                        jnp.ones_like(idx, y.dtype),
+                                        axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply(fn, x, op_name="gumbel_softmax")
+
+
+# ---------------------------------------------------------------------------
+# linear / conv / pool
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None, name=None):
+    """x @ W (+ b). Weight layout [in, out] like the reference
+    (python/paddle/nn/functional/common.py linear)."""
+    if bias is None:
+        return apply(lambda a, w: jnp.matmul(a, w), x, weight,
+                     op_name="linear")
+    return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                 op_name="linear")
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+             data_format, n_spatial, op_name):
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    if isinstance(padding, str):
+        pad = padding.upper()  # "SAME"/"VALID"
+    else:
+        p = padding
+        if isinstance(p, (int, np.integer)):
+            pad = [(int(p), int(p))] * n_spatial
+        else:
+            p = [int(i) for i in np.asarray(p).reshape(-1)]
+            if len(p) == n_spatial:
+                pad = [(i, i) for i in p]
+            elif len(p) == 2 * n_spatial:
+                pad = [(p[2 * i], p[2 * i + 1]) for i in range(n_spatial)]
+            else:
+                pad = [(i, i) for i in p[:n_spatial]]
+    sp = "DHW"[3 - n_spatial:]
+    if channel_last:
+        lhs_spec = "N" + sp + "C"
+    else:
+        lhs_spec = "NC" + sp
+    rhs_spec = "OI" + sp
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+
+    def fn(a, w, *bs):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dilations, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if a.dtype == jnp.bfloat16 else None,
+        )
+        if a.dtype == jnp.bfloat16:
+            out = out.astype(a.dtype)
+        if bs:
+            b = bs[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 3, "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, data_format, n_spatial, op_name):
+    strides = _norm_tuple(stride, n_spatial)
+    dilations = _norm_tuple(dilation, n_spatial)
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    p = padding
+    if isinstance(p, (int, np.integer)):
+        pads = [(int(p), int(p))] * n_spatial
+    else:
+        p = [int(i) for i in np.asarray(p).reshape(-1)]
+        pads = [(p[i], p[i]) for i in range(n_spatial)] \
+            if len(p) == n_spatial else \
+            [(p[2 * i], p[2 * i + 1]) for i in range(n_spatial)]
+    opad = _norm_tuple(output_padding, n_spatial)
+    sp = "DHW"[3 - n_spatial:]
+    lhs_spec = ("N" + sp + "C") if channel_last else ("NC" + sp)
+    rhs_spec = "IO" + sp  # transpose conv weight: [in, out/groups, *k]
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, lhs_spec))
+
+    def fn(a, w, *bs):
+        k = [(w.shape[2 + i] - 1) * dilations[i] + 1 for i in range(n_spatial)]
+        trans_pads = [
+            (k[i] - 1 - pads[i][0], k[i] - 1 - pads[i][1] + opad[i])
+            for i in range(n_spatial)
+        ]
+        # transpose conv = dilated conv with spatially-flipped kernel
+        # (rhs spec "IO*" already swaps in/out channel roles)
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n_spatial)))
+        if groups > 1:
+            # grouped transpose conv: split along input channels
+            outs = []
+            a_groups = jnp.split(a, groups, axis=1 if not channel_last else -1)
+            w_groups = jnp.split(w, groups, axis=0)
+            for ag, wg in zip(a_groups, w_groups):
+                outs.append(jax.lax.conv_general_dilated(
+                    ag, wg, window_strides=(1,) * n_spatial,
+                    padding=trans_pads, lhs_dilation=strides,
+                    rhs_dilation=dilations,
+                    dimension_numbers=jax.lax.conv_dimension_numbers(
+                        tuple(ag.shape), tuple(wg.shape),
+                        (lhs_spec, rhs_spec, lhs_spec))))
+            out = jnp.concatenate(outs, axis=1 if not channel_last else -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=(1,) * n_spatial, padding=trans_pads,
+                lhs_dilation=strides, rhs_dilation=dilations,
+                dimension_numbers=dn)
+        if bs:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = -1
+            out = out + bs[0].reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args, op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              1, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              2, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, data_format,
+                              3, "conv3d_transpose")
+
+
+def _pool_nd(x, kernel_size, stride, padding, n_spatial, reducer, init,
+             ceil_mode, count_include_pad, data_format, op_name,
+             divide_by_window=False, divisor_override=None):
+    ks = _norm_tuple(kernel_size, n_spatial)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n_spatial)
+    channel_last = data_format.endswith("C") and len(data_format) > 2
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        p = padding
+        if isinstance(p, (int, np.integer)):
+            pads = [(int(p), int(p))] * n_spatial
+        else:
+            p = [int(i) for i in np.asarray(p).reshape(-1)]
+            pads = [(p[i], p[i]) for i in range(n_spatial)] \
+                if len(p) == n_spatial else \
+                [(p[2 * i], p[2 * i + 1]) for i in range(n_spatial)]
+
+    def fn(a):
+        nd = a.ndim
+        spatial_off = 1 if channel_last else 2
+        eff_pads = pads
+        if not isinstance(pads, str) and ceil_mode:
+            # extend high padding so partial windows at the border produce an
+            # extra output (reference ceil_mode semantics)
+            eff_pads = []
+            for i in range(n_spatial):
+                size = a.shape[spatial_off + i]
+                lo, hi = pads[i]
+                span = size + lo + hi - ks[i]
+                out_floor = span // st[i] + 1
+                out_ceil = -(-span // st[i]) + 1
+                extra = (out_ceil - 1) * st[i] + ks[i] - (size + lo + hi)
+                eff_pads.append((lo, hi + max(extra, 0)))
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            if not isinstance(pads, str):
+                pad_full = [(0, 0)] + list(eff_pads) + [(0, 0)]
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            if not isinstance(pads, str):
+                pad_full = [(0, 0), (0, 0)] + list(eff_pads)
+        if isinstance(pads, str):
+            pad_full = pads
+        out = jax.lax.reduce_window(a, init(a.dtype), reducer, window,
+                                    strides, pad_full)
+        if divide_by_window:
+            if divisor_override is not None:
+                out = out / float(divisor_override)
+            elif count_include_pad and not ceil_mode and \
+                    not isinstance(pads, str):
+                out = out / float(np.prod(ks))
+            else:
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0 if a.dtype != jnp.bfloat16 else
+                    jnp.bfloat16(0), jax.lax.add, window, strides, pad_full)
+                out = out / counts
+        return out
+
+    return apply(fn, x, op_name=op_name)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                   lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating)
+                   else jnp.iinfo(d).min,
+                   ceil_mode, True, data_format, "max_pool2d")
+    if return_mask:
+        return out, None
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                   lambda d: -jnp.inf, ceil_mode, True, data_format,
+                   "max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                   lambda d: -jnp.inf, ceil_mode, True, data_format,
+                   "max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.add,
+                    lambda d: jnp.zeros((), d), ceil_mode, not exclusive,
+                    data_format, "avg_pool1d", divide_by_window=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.add,
+                    lambda d: jnp.zeros((), d), ceil_mode, not exclusive,
+                    data_format, "avg_pool2d", divide_by_window=True,
+                    divisor_override=divisor_override)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add,
+                    lambda d: jnp.zeros((), d), ceil_mode, not exclusive,
+                    data_format, "avg_pool3d", divide_by_window=True,
+                    divisor_override=divisor_override)
+
+
+def _adaptive_pool(x, output_size, n_spatial, mode, op_name):
+    def fn(a):
+        spatial = a.shape[-n_spatial:]
+        osize = _norm_tuple(output_size, n_spatial)
+        out = a
+        for i in range(n_spatial):
+            axis = a.ndim - n_spatial + i
+            in_s, out_s = spatial[i], osize[i]
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                new_shape = (out.shape[:axis] + (out_s, k)
+                             + out.shape[axis + 1:])
+                r = out.reshape(new_shape)
+                out = (jnp.max(r, axis=axis + 1) if mode == "max"
+                       else jnp.mean(r, axis=axis + 1))
+            else:
+                # general adaptive windows
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                pieces = []
+                for s, e in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s), int(e), axis=axis)
+                    pieces.append(
+                        jnp.max(seg, axis=axis, keepdims=True) if mode == "max"
+                        else jnp.mean(seg, axis=axis, keepdims=True))
+                out = jnp.concatenate(pieces, axis=axis)
+        return out
+    return apply(fn, x, op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "max", "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "max", "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "max", "adaptive_max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    dl = _norm_tuple(dilations, 2)
+    pd = _norm_tuple(paddings, 2) if not isinstance(paddings, (list, tuple)) \
+        or len(paddings) <= 2 else tuple(paddings)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        if len(pd) == 2:
+            pads = ((pd[0], pd[0]), (pd[1], pd[1]))
+        else:
+            pads = ((pd[0], pd[2]), (pd[1], pd[3]))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, ks, st, list(pads), rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # [N, C*kh*kw, out_h, out_w] -> [N, C*kh*kw, L]
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply(fn, x, op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os = _norm_tuple(output_sizes, 2)
+    ks = _norm_tuple(kernel_sizes, 2)
+    st = _norm_tuple(strides, 2)
+    dl = _norm_tuple(dilations, 2)
+    pd = _norm_tuple(paddings, 2)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os[0] + 2 * pd[0], os[1] + 2 * pd[1]), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wj = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0],
+                             wj:wj + ow * st[1]:st[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[0],
+                   pd[1]:out.shape[3] - pd[1]]
+    return apply(fn, x, op_name="fold")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (int, np.integer)):
+        normalized_shape = (int(normalized_shape),)
+    n_axes = len(tuple(normalized_shape))
+
+    def fn(a, *wb):
+        axes = tuple(range(a.ndim - n_axes, a.ndim))
+        dtype = a.dtype
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=axes, keepdims=True)
+        var = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32); i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32); i += 1
+        return out.astype(dtype)
+
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply(fn, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm — routed to the Pallas kernel on TPU for fused HBM-bound
+    execution (reference fused op: paddle/phi/kernels/fusion/gpu rms_norm,
+    python surface incubate.nn.functional.fused_rms_norm)."""
+    from ...ops.pallas import rms_norm as pallas_rms
+
+    def fn(a, *w):
+        return pallas_rms.rms_norm(a, w[0] if w else None, epsilon)
+
+    args = [x] + ([weight] if weight is not None else [])
+    return apply(fn, *args, op_name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if (data_format[1] == "C" or x.ndim <= 2) else x.ndim - 1
+    if x.ndim <= 2:
+        ch_axis = x.ndim - 1
+
+    use_batch_stats = training and not use_global_stats
+
+    def fn(a, rm, rv, *wb):
+        axes = tuple(i for i in range(a.ndim) if i != ch_axis)
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        if use_batch_stats:
+            mean = jnp.mean(a.astype(jnp.float32), axis=axes)
+            var = jnp.var(a.astype(jnp.float32), axis=axes)
+        else:
+            mean, var = rm, rv
+        out = (a.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape).astype(jnp.float32) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32); i += 1
+        return out.astype(a.dtype)
+
+    args = [x, running_mean, running_var] + [
+        t for t in (weight, bias) if t is not None
+    ]
+    out = apply(fn, *args, op_name="batch_norm")
+
+    if use_batch_stats:
+        # update running stats (mutates buffer handles, reference semantics)
+        import jax as _jax
+
+        axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+        with _no_grad():
+            xf = x._value.astype(jnp.float32)
+            m = jnp.mean(xf, axis=axes)
+            v = jnp.var(xf, axis=axes)
+            n = float(np.prod([x.shape[i] for i in axes]))
+            unbiased = v * (n / max(n - 1, 1.0))
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * m.astype(
+                                       running_mean._value.dtype))
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * unbiased.astype(
+                                      running_var._value.dtype))
+    return out
+
+
+def _no_grad():
+    from ...core.autograd import no_grad
+
+    return no_grad()
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def fn(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * a.ndim
+        shape[1] = -1
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape); i += 1
+        return out
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply(fn, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def fn(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = int(num_groups)
+        rest = a.shape[2:]
+        r = a.reshape((n, g, c // g) + rest)
+        axes = tuple(range(2, r.ndim))
+        mean = jnp.mean(r.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(r.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((r.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+               ).reshape(a.shape)
+        shape = [1] * a.ndim
+        shape[1] = -1
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32); i += 1
+        return out.astype(a.dtype)
+    args = [x] + [t for t in (weight, bias) if t is not None]
+    return apply(fn, *args, op_name="group_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                              keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply(fn, x, op_name="normalize")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=1)
+        return a / jnp.power(k + alpha * acc / size, beta)
+    return apply(fn, x, op_name="local_response_norm")
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1
+                     for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+
+    return apply(fn, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(next_key(), 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        coef_b = -coef_a * alpha_p * (1 - q)
+        return coef_a * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) \
+            + coef_b
+    return apply(fn, x, op_name="alpha_dropout")
+
+
+feature_alpha_dropout = alpha_dropout
+
+
+# ---------------------------------------------------------------------------
+# embedding
+# ---------------------------------------------------------------------------
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply(fn, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...ops.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce_loss(loss_fn_out, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss_fn_out)
+    if reduction == "sum":
+        return jnp.sum(loss_fn_out)
+    return loss_fn_out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy.
+    Computed in fp32 regardless of input dtype (bf16-safe)."""
+
+    def fn(logits, lab, *w):
+        lf = logits.astype(jnp.float32)
+        ax = int(axis) % lf.ndim
+        if use_softmax:
+            logp = jax.nn.log_softmax(lf, axis=ax)
+        else:
+            logp = jnp.log(jnp.maximum(lf, 1e-30))
+        n_classes = lf.shape[ax]
+        if soft_label:
+            labf = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                labf = labf * (1 - label_smoothing) \
+                    + label_smoothing / n_classes
+            per = -jnp.sum(labf * logp, axis=ax)
+        else:
+            li = lab
+            if li.ndim == lf.ndim and li.shape[ax] == 1:
+                li = jnp.squeeze(li, axis=ax)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            li_safe = jnp.where(valid, li, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(li_safe, ax), axis=ax)
+            per = -jnp.squeeze(picked, axis=ax)
+            if label_smoothing > 0.0:
+                smooth = -jnp.mean(logp, axis=ax)
+                per = (1 - label_smoothing) * per + label_smoothing * smooth
+            per = jnp.where(valid, per, 0.0)
+            if w:
+                wt = jnp.take(w[0].astype(jnp.float32), li_safe)
+                wt = jnp.where(valid, wt, 0.0)
+                per = per * wt
+                if reduction == "mean":
+                    return jnp.sum(per) / jnp.maximum(jnp.sum(wt), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+                return jnp.sum(per) / denom
+        return _reduce_loss(per, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from ...ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                 input, label, op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                 input, label, op_name="l1_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label,
+                 op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        lambda a, b: -b * jnp.log(a + epsilon)
+        - (1 - b) * jnp.log(1 - a + epsilon),
+        input, label, op_name="log_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(logp, lab, *w):
+        li = lab.astype(jnp.int32)
+        valid = li != ignore_index
+        li_safe = jnp.where(valid, li, 0)
+        picked = jnp.take_along_axis(logp, li_safe[:, None], axis=1)
+        per = -jnp.squeeze(picked, axis=1)
+        wt = jnp.ones_like(per)
+        if w:
+            wt = jnp.take(w[0], li_safe)
+        per = jnp.where(valid, per * wt, 0.0)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(
+                jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        return _reduce_loss(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(fn, *args, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def fn(a, b, *w):
+        per = -(b * jnp.log(jnp.maximum(a, 1e-12))
+                + (1 - b) * jnp.log(jnp.maximum(1 - a, 1e-12)))
+        if w:
+            per = per * w[0]
+        return _reduce_loss(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(fn, *args, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def fn(a, b, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]; i += 1
+        if pos_weight is not None:
+            pw = extra[i]; i += 1
+        max_val = jnp.maximum(-a, 0.0)
+        if pw is not None:
+            log_w = (pw - 1.0) * b + 1.0
+            per = (1.0 - b) * a + log_w * (
+                jnp.log(jnp.exp(-max_val) + jnp.exp(-a - max_val)) + max_val)
+        else:
+            per = (1.0 - b) * a + max_val \
+                + jnp.log(jnp.exp(-max_val) + jnp.exp(-a - max_val))
+        if w is not None:
+            per = per * w
+        return _reduce_loss(per, reduction)
+    args = [logit, label] + [t for t in (weight, pos_weight) if t is not None]
+    return apply(fn, *args, op_name="bce_with_logits")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        per = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, label, op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(a, b):
+        if log_target:
+            per = jnp.exp(b) * (b - a)
+        else:
+            per = b * (jnp.log(jnp.maximum(b, 1e-12)) - a)
+        if reduction == "batchmean":
+            return jnp.sum(per) / a.shape[0]
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, label, op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, l):
+        per = jnp.maximum(-l * (a - b) + margin, 0.0)
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, other, label, op_name="margin_ranking_loss")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(fn, x1, x2, op_name="cosine_similarity")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(l == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce_loss(per, reduction)
+    return apply(fn, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, l):
+        per = jnp.where(l == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, label, op_name="hinge_embedding_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, b):
+        if log_input:
+            per = jnp.exp(a) - b * a
+        else:
+            per = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(b + epsilon) - b \
+                + 0.5 * jnp.log(2 * _math.pi * (b + epsilon))
+            per = per + jnp.where(b > 1, stirling, 0.0)
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, label, op_name="poisson_nll_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p),
+                               axis=-1), 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p),
+                               axis=-1), 1 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(
+                jnp.power(jnp.abs(pos - neg) + epsilon, p), axis=-1), 1 / p)
+            dn = jnp.minimum(dn, dpn)
+        per = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce_loss(per, reduction)
+    return apply(fn, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    def fn(a, b, *w):
+        per = -(b * jax.nn.log_sigmoid(a)
+                + (1 - b) * jax.nn.log_sigmoid(-a))
+        per = jnp.mean(per, axis=-1)
+        if w:
+            per = per * w[0]
+        return _reduce_loss(per, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply(fn, *args, op_name="multi_label_soft_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(a, b, *n):
+        p = jax.nn.sigmoid(a)
+        ce = (1.0 - b) * a + jnp.maximum(-a, 0.0) \
+            + jnp.log(jnp.exp(-jnp.abs(a)) + 1)
+        p_t = p * b + (1 - p) * (1 - b)
+        a_t = alpha * b + (1 - alpha) * (1 - b)
+        per = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            per = per / n[0]
+        return _reduce_loss(per, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply(fn, *args, op_name="sigmoid_focal_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    # log_probs: [T, B, C] (reference layout)
+    def fn(lp, lab, il, ll):
+        lp_btc = jnp.transpose(lp, (1, 0, 2))
+        B, T, C = lp_btc.shape
+        logprob_pad = jnp.ones((B, T)) * 0.0
+        import optax
+
+        per = optax.ctc_loss(
+            lp_btc,
+            jnp.arange(T)[None, :] >= il[:, None],
+            lab.astype(jnp.int32),
+            jnp.arange(lab.shape[1])[None, :] >= ll[:, None],
+            blank_id=blank,
+        )
+        return _reduce_loss(per, reduction)
+    return apply(fn, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *pd):
+        n = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / n
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply(fn, *args, op_name="label_smooth")
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[B, S, H, D] layout like the reference
+    (python/paddle/nn/functional/flash_attention.py:147). Routes to the
+    Pallas flash-attention kernel on TPU; falls back to an XLA-fused
+    reference implementation elsewhere."""
+    from ...ops.pallas import flash_attention as fa
+
+    def fn(q, k, v, *m):
+        return fa.flash_attention_bshd(
+            q, k, v, m[0] if m else None, is_causal=is_causal,
+            dropout_p=dropout_p if training else 0.0)
+
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+    return apply(fn, *args, op_name="scaled_dot_product_attention")
+
+
+# ---------------------------------------------------------------------------
+# vision utility ops
+# ---------------------------------------------------------------------------
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def fn(a):
+        n_spatial = a.ndim - 2
+        if data_format.endswith("C") and len(data_format) > 2:
+            spatial = a.shape[1:-1]
+            ch_last = True
+        else:
+            spatial = a.shape[2:]
+            ch_last = False
+        if size is not None:
+            out_size = _norm_tuple(size if not isinstance(size, Tensor)
+                                   else size.numpy().tolist(), n_spatial)
+        else:
+            sf = scale_factor
+            if isinstance(sf, (int, float)):
+                sf = [sf] * n_spatial
+            out_size = tuple(int(s * f) for s, f in zip(spatial, sf))
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "trilinear": "linear", "linear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        if ch_last:
+            new_shape = (a.shape[0],) + out_size + (a.shape[-1],)
+            scale_axes = tuple(range(1, 1 + n_spatial))
+        else:
+            new_shape = a.shape[:2] + out_size
+            scale_axes = tuple(range(2, 2 + n_spatial))
+        if mode == "nearest":
+            # index-based nearest (matches reference floor behavior)
+            idx = [jnp.floor(jnp.arange(o) * (s / o)).astype(jnp.int32)
+                   for o, s in zip(out_size, spatial)]
+            out = a
+            for ax, i in zip(scale_axes, idx):
+                out = jnp.take(out, i, axis=ax)
+            return out
+        return jax.image.resize(a, new_shape, method=method)
+    return apply(fn, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, oc, r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, oc, h * r, w * r)
+    return apply(fn, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+    return apply(fn, x, op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, groups, c // groups, h, w)
+        out = jnp.transpose(out, (0, 2, 1, 3, 4))
+        return out.reshape(n, c, h, w)
+    return apply(fn, x, op_name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shape = [int(s) for s in (out_shape.numpy() if isinstance(
+        out_shape, Tensor) else out_shape)]
+
+    def fn(th):
+        n, _, h, w = shape[0], shape[1], shape[2], shape[3]
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+            xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            return img[:, :, yy, xx] if False else jax.vmap(
+                lambda im, y_, x_: im[:, y_, x_]
+            )(img, yy.astype(jnp.int32), xx.astype(jnp.int32))
+
+        x0 = jnp.floor(fx)
+        y0 = jnp.floor(fy)
+        if mode == "nearest":
+            # sample() already returns [N, C, Hg, Wg]
+            return sample(a, jnp.round(fy).astype(jnp.int32),
+                          jnp.round(fx).astype(jnp.int32))
+        wx = fx - x0
+        wy = fy - y0
+        vals = 0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy = (y0 + dy).astype(jnp.int32)
+                xx = (x0 + dx).astype(jnp.int32)
+                inb = ((yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+                       ).astype(a.dtype)
+                v = sample(a, jnp.clip(yy, 0, h - 1), jnp.clip(xx, 0, w - 1))
+                wgt = ((wx if dx else 1 - wx) * (wy if dy else 1 - wy))
+                vals = vals + v * (wgt * inb)[:, None, :, :]
+        return vals
+    return apply(fn, x, grid, op_name="grid_sample")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    from ...ops.manipulation import flatten as _fl
+
+    return _fl(x, start_axis, stop_axis)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def fn(lengths):
+        m = maxlen if maxlen is not None else int(jax.device_get(
+            lengths).max())
+        rng = jnp.arange(m)
+        return (rng[None, :] < lengths[:, None]).astype(convert_dtype(dtype))
+    return apply(fn, x, op_name="sequence_mask", differentiable=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+             v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+    return apply(fn, x, op_name="temporal_shift")

@@ -1,0 +1,87 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "GELU", "SiLU", "Swish", "Sigmoid", "Tanh",
+           "Softmax", "LogSoftmax", "Softplus", "Softsign", "Softshrink",
+           "Hardshrink", "Hardsigmoid", "Hardswish", "Hardtanh", "LeakyReLU",
+           "ELU", "SELU", "CELU", "PReLU", "RReLU", "Mish", "Tanhshrink",
+           "ThresholdedReLU", "Maxout", "GLU", "LogSigmoid"]
+
+
+def _act_layer(name, fname, **defaults):
+    def make(cls_name):
+        class _Act(Layer):
+            def __init__(self, *args, **kwargs):
+                super().__init__()
+                self._args = args
+                self._kwargs = {**defaults, **kwargs}
+
+            def forward(self, x):
+                return getattr(F, fname)(x, *self._args, **self._kwargs)
+
+        _Act.__name__ = cls_name
+        _Act.__qualname__ = cls_name
+        return _Act
+    return make(name)
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+GELU = _act_layer("GELU", "gelu")
+SiLU = _act_layer("SiLU", "silu")
+Swish = _act_layer("Swish", "swish")
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Softmax = _act_layer("Softmax", "softmax")
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax")
+Softplus = _act_layer("Softplus", "softplus")
+Softsign = _act_layer("Softsign", "softsign")
+Softshrink = _act_layer("Softshrink", "softshrink")
+Hardshrink = _act_layer("Hardshrink", "hardshrink")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardtanh = _act_layer("Hardtanh", "hardtanh")
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu")
+ELU = _act_layer("ELU", "elu")
+SELU = _act_layer("SELU", "selu")
+CELU = _act_layer("CELU", "celu")
+Mish = _act_layer("Mish", "mish")
+Tanhshrink = _act_layer("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _act_layer("ThresholdedReLU", "thresholded_relu")
+GLU = _act_layer("GLU", "glu")
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

@@ -1,0 +1,500 @@
+"""nn.Layer — the module system.
+
+Reference analog: python/paddle/nn/layer/layers.py (class Layer). Parameters
+are Parameter tensors (mutable handles over jax.Arrays), so a Layer is both
+an eager module AND a pytree-extractable parameter container: the jit path
+(paddle_tpu.jit.functional_call) swaps parameter values for traced arrays and
+re-runs forward as a pure function — the functional bridge that lets the same
+model class serve define-by-run eager AND whole-graph pjit compilation.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...core.dtype import convert_dtype, is_floating_point
+from ...core.place import Place
+from ...core.tensor import Parameter, Tensor
+
+__all__ = ["Layer", "Sequential", "LayerList", "ParameterList", "LayerDict",
+           "disable_dynamic"]
+
+
+def disable_dynamic(*a, **k):  # compat no-op: this framework is always eager
+    return None
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ first")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ first")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                del params[name]
+                object.__setattr__(self, name, None)
+            else:
+                params[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        elif layers is not None and name in layers:
+            if value is None:
+                del layers[name]
+                object.__setattr__(self, name, None)
+            else:
+                layers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        from ..initializer import Constant, XavierUniform, _apply_initializer
+
+        dtype = convert_dtype(dtype) or self._dtype
+        init = default_initializer
+        name = None
+        learning_rate = 1.0
+        if attr is not None and attr is not False:
+            from .. import ParamAttr
+
+            if isinstance(attr, ParamAttr):
+                init = attr.initializer or init
+                name = attr.name
+                learning_rate = attr.learning_rate
+            elif isinstance(attr, str):
+                name = attr
+        if init is None:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        data = _apply_initializer(init, shape, dtype)
+        p = Parameter(data, dtype=dtype, name=name)
+        p.optimize_attr["learning_rate"] = learning_rate
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.zeros((), convert_dtype(dtype) or self._dtype),
+                      name=name)
+
+    # -- iteration ----------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = prefix + ("." if prefix else "") + name
+            if id(sub) not in layers_set:
+                layers_set.add(id(sub))
+                yield p, sub
+                yield from sub.named_sublayers(prefix=p, include_self=False,
+                                               layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return (l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return ((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self._traverse(prefix, include_sublayers):
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, p)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_prefix, layer in self._traverse(prefix, include_sublayers):
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (layer_prefix + ("." if layer_prefix else "") + name, b)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def _traverse(self, prefix, include_sublayers):
+        yield prefix, self
+        if include_sublayers:
+            yield from self.named_sublayers(prefix=prefix)
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            if short not in self._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.numpy() if isinstance(value, Tensor) else \
+                    np.asarray(value)
+                target.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype/place migration ---------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+
+        from ...core.place import to_jax_device
+
+        def convert(t):
+            if t is None:
+                return None
+            arr = t._value
+            if dtype is not None and is_floating_point(arr.dtype):
+                arr = arr.astype(convert_dtype(dtype))
+            if device is not None:
+                place = device if isinstance(device, Place) else None
+                if isinstance(device, str):
+                    name, _, idx = device.partition(":")
+                    place = Place("cpu" if name == "cpu" else "tpu",
+                                  int(idx) if idx else 0)
+                arr = jax.device_put(arr, to_jax_device(place))
+            t._value = arr
+            return t
+
+        for layer in self.sublayers(include_self=True):
+            for p in layer._parameters.values():
+                convert(p)
+            for b in layer._buffers.values():
+                convert(b)
+        if dtype is not None:
+            self._dtype = convert_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def full_name(self):
+        return self._name_scope
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"({name}): {sub_repr}")
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""
+
+
+class Sequential(Layer):
+    """reference: python/paddle/nn/layer/container.py Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            for name, layer in layers[0]:
+                self.add_sublayer(name, layer)
+        elif len(layers) and isinstance(layers[0], tuple) and \
+                isinstance(layers[0][0], str):
+            for name, layer in layers:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers.keys())
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, input):
+        for layer in self._sub_layers.values():
+            input = layer(input)
+        return input
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(self._abs_idx(idx))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(self._abs_idx(idx))] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def _abs_idx(self, idx):
+        n = len(self._sub_layers)
+        return idx % n if n else idx
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        if hasattr(sublayers, "items"):
+            for k, v in sublayers.items():
+                self[k] = v
+        else:
+            for k, v in sublayers:
+                self[k] = v
+        return self
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers.pop(key)
+        return layer

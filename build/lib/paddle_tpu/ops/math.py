@@ -1,0 +1,460 @@
+"""Elementwise + reduction math ops (reference: python/paddle/tensor/math.py,
+ops.yaml entries lower straight to XLA HLO element-wise/reduce ops which XLA
+fuses into surrounding computations — the TPU answer to the reference's
+hand-fused CUDA elementwise kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from . import registry
+
+__all__ = [
+    # binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "logaddexp", "heaviside", "copysign", "nextafter", "ldexp", "gcd", "lcm",
+    "hypot", "inner", "outer", "kron", "lerp", "multiply_no_grad",
+    # unary
+    "neg", "abs", "sign", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "rsqrt", "square", "reciprocal", "sin", "cos", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "floor", "ceil", "round", "trunc", "frac", "erf", "erfinv", "sigmoid",
+    "logit", "digamma", "lgamma", "polygamma", "angle", "conj", "real",
+    "imag", "rad2deg", "deg2rad", "i0", "i0e", "i1", "i1e",
+    # clip & scale
+    "clip", "scale", "increment", "nan_to_num",
+    # checks
+    "isnan", "isinf", "isfinite", "isneginf", "isposinf", "isreal",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "std", "var",
+    "logsumexp", "all", "any", "count_nonzero", "nansum", "nanmean",
+    "median", "nanmedian", "quantile", "nanquantile",
+    # scans
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    # combinations
+    "add_n", "addmm", "trace", "diff", "diagonal", "cross", "dot", "mm",
+    "multiplex", "stanh", "rot90",
+]
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.numpy().tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _binary(op_name, fn):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return apply(fn, x, y, op_name=op_name)
+    op.__name__ = op_name
+    return op
+
+
+def _unary(op_name, fn, differentiable=True):
+    def op(x, name=None):
+        return apply(fn, x, op_name=op_name, differentiable=differentiable)
+    op.__name__ = op_name
+    return op
+
+
+# promote ints to the other operand's float dtype the way the reference does
+def _promoting(fn):
+    def g(a, b):
+        if hasattr(a, "dtype") and hasattr(b, "dtype"):
+            if jnp.issubdtype(a.dtype, jnp.integer) and jnp.issubdtype(
+                b.dtype, jnp.inexact
+            ):
+                a = a.astype(b.dtype)
+            elif jnp.issubdtype(b.dtype, jnp.integer) and jnp.issubdtype(
+                a.dtype, jnp.inexact
+            ):
+                b = b.astype(a.dtype)
+        return fn(a, b)
+    return g
+
+
+add = _binary("add", _promoting(jnp.add))
+subtract = _binary("subtract", _promoting(jnp.subtract))
+multiply = _binary("multiply", _promoting(jnp.multiply))
+divide = _binary("divide", _promoting(jnp.true_divide))
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+maximum = _binary("maximum", _promoting(jnp.maximum))
+minimum = _binary("minimum", _promoting(jnp.minimum))
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+hypot = _binary("hypot", jnp.hypot)
+inner = _binary("inner", jnp.inner)
+dot = _binary("dot", lambda a, b: jnp.sum(a * b, axis=-1) if a.ndim > 1
+              else jnp.dot(a, b))
+mm = _binary("mm", jnp.matmul)
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: a * (2.0 ** b.astype(jnp.float32)), x, y,
+                 op_name="ldexp")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, op_name="outer")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y, op_name="kron")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight,
+                 op_name="lerp")
+
+
+def multiply_no_grad(x, y):
+    return apply(jnp.multiply, x, y, op_name="multiply_no_grad",
+                 differentiable=False)
+
+
+def pow(x, y, name=None):
+    def fn(a, b):
+        if isinstance(b, (int,)) or (
+            hasattr(b, "dtype") and jnp.issubdtype(b.dtype, jnp.integer)
+        ):
+            return jnp.power(a, b)
+        return jnp.power(a, b)
+    return apply(_promoting(fn), x, y, op_name="pow")
+
+
+neg = _unary("neg", jnp.negative)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign, differentiable=False)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor, differentiable=False)
+ceil = _unary("ceil", jnp.ceil, differentiable=False)
+round = _unary("round", jnp.round, differentiable=False)
+trunc = _unary("trunc", jnp.trunc, differentiable=False)
+frac = _unary("frac", lambda a: a - jnp.trunc(a))
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = _unary("logit", jax.scipy.special.logit)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda a: jax.scipy.special.polygamma(int(n), a), x,
+                 op_name="polygamma")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x,
+                 op_name="stanh")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, lo, hi), x, op_name="clip")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    def fn(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = apply(fn, x, op_name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda a: a + value, x, op_name="increment")
+    x.set_value(out.detach())
+    return x
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf), x,
+                 op_name="nan_to_num")
+
+
+isnan = _unary("isnan", jnp.isnan, differentiable=False)
+isinf = _unary("isinf", jnp.isinf, differentiable=False)
+isfinite = _unary("isfinite", jnp.isfinite, differentiable=False)
+isneginf = _unary("isneginf", jnp.isneginf, differentiable=False)
+isposinf = _unary("isposinf", jnp.isposinf, differentiable=False)
+isreal = _unary("isreal", jnp.isreal, differentiable=False)
+
+
+def _reduce(op_name, fn, differentiable=True):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        def run(a):
+            kw = {}
+            if dtype is not None:
+                kw["dtype"] = convert_dtype(dtype)
+            return fn(a, axis=ax, keepdims=keepdim, **kw)
+        return apply(run, x, op_name=op_name, differentiable=differentiable)
+    op.__name__ = op_name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x,
+                 op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x,
+                 op_name="min")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), x, op_name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), x, op_name="var")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis),
+                                              keepdims=keepdim),
+        x, op_name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x,
+                 op_name="all", differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x,
+                 op_name="any", differentiable=False)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim),
+        x, op_name="count_nonzero", differentiable=False)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim),
+                 x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), x,
+        op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        lambda a: jnp.quantile(a, qv, axis=_axis(axis), keepdims=keepdim,
+                               method=interpolation),
+        x, op_name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(
+        lambda a: jnp.nanquantile(a, qv, axis=_axis(axis), keepdims=keepdim),
+        x, op_name="nanquantile")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=convert_dtype(dtype))
+        return jnp.cumsum(a, axis=int(axis), dtype=convert_dtype(dtype))
+    return apply(fn, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(
+        lambda a: jnp.cumprod(a, axis=int(dim) if dim is not None else None,
+                              dtype=convert_dtype(dtype)),
+        x, op_name="cumprod")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            b = a.reshape(-1)
+            ax = 0
+        else:
+            b, ax = a, int(axis)
+        m = jax.lax.cummax(b, axis=ax)
+        return jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax)) + m
+    return apply(fn, x, op_name="logcumsumexp")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else int(axis)
+    xa = x._value.reshape(-1) if axis is None else x._value
+    vals = apply(lambda a: jax.lax.cummax(
+        a.reshape(-1) if axis is None else a, axis=ax), x, op_name="cummax")
+    # indices of the running max (non-differentiable companion output)
+    eq = jnp.equal(
+        xa, jax.lax.cummax(xa, axis=ax)
+    )
+    idx = jnp.arange(xa.shape[ax], dtype=convert_dtype(dtype))
+    shape = [1] * xa.ndim
+    shape[ax] = -1
+    inds = jax.lax.cummax(jnp.where(eq, idx.reshape(shape), 0), axis=ax)
+    return vals, Tensor(inds)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else int(axis)
+    xa = x._value.reshape(-1) if axis is None else x._value
+    vals = apply(lambda a: jax.lax.cummin(
+        a.reshape(-1) if axis is None else a, axis=ax), x, op_name="cummin")
+    eq = jnp.equal(xa, jax.lax.cummin(xa, axis=ax))
+    idx = jnp.arange(xa.shape[ax], dtype=convert_dtype(dtype))
+    shape = [1] * xa.ndim
+    shape[ax] = -1
+    inds = jax.lax.cummax(jnp.where(eq, idx.reshape(shape), 0), axis=ax)
+    return vals, Tensor(inds)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *xs: jnp.sum(jnp.stack(xs), axis=0), *inputs,
+                 op_name="add_n")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y,
+                 op_name="addmm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=int(offset), axis1=int(axis1),
+                                     axis2=int(axis2)), x, op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(
+        lambda a: jnp.diagonal(a, offset=int(offset), axis1=int(axis1),
+                               axis2=int(axis2)), x, op_name="diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [x]
+    def fn(a, *extra):
+        kw = {}
+        i = 0
+        if prepend is not None:
+            kw["prepend"] = extra[i]; i += 1
+        if append is not None:
+            kw["append"] = extra[i]; i += 1
+        return jnp.diff(a, n=int(n), axis=int(axis), **kw)
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+    return apply(fn, *args, op_name="diff")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = None if axis == 9 else int(axis)
+    def fn(a, b):
+        if ax is None:
+            # first axis with dim 3 (reference semantics)
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    return jnp.cross(a, b, axis=i)
+            raise ValueError("no axis of size 3 for cross")
+        return jnp.cross(a, b, axis=ax)
+    return apply(fn, x, y, op_name="cross")
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        sel = idx.reshape(-1).astype(jnp.int32)
+        rows = jnp.arange(sel.shape[0])
+        return stacked[sel, rows]
+    return apply(fn, index, *inputs, op_name="multiplex")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=int(k), axes=tuple(axes)), x,
+                 op_name="rot90")
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("math",))

@@ -1,0 +1,725 @@
+"""nn op surface under the reference ops.yaml names.
+
+Reference analog: the nn entries of /root/reference/paddle/phi/ops/yaml/
+ops.yaml (relu, conv2d, layer_norm, bilinear_interp, ...). Each entry here
+registers a pure-array kernel: where `paddle_tpu.nn.functional` already
+implements the math, the kernel is that same code path (functional accepts
+raw arrays; outputs are unwrapped), so there is exactly one implementation
+per op; genuinely missing ops (spectral_norm, hsigmoid_loss,
+margin_cross_entropy, huber_loss, pooling-with-index, fractional pooling,
+unpool, pad3d, ...) are implemented here directly.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .registry import register
+
+__all__ = []
+
+
+def _uw(out):
+    """Unwrap Tensors (functional wraps outputs) back to raw arrays."""
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _F():
+    from ..nn import functional as F
+    return F
+
+
+def _adapter(fname, **fixed):
+    """Kernel = the nn.functional implementation itself, on raw arrays."""
+    def kernel(*args, **kw):
+        f = getattr(_F(), fname)
+        return _uw(f(*args, **{**fixed, **kw}))
+    kernel.__name__ = fname
+    return kernel
+
+
+def _reg(name, fn, differentiable=True, tags=("nn",)):
+    register(name, fn, differentiable=differentiable, tags=tags)
+    __all__.append(name)
+
+
+# ---------------------------------------------------------------------------
+# activations — the functional implementation is the kernel
+# ---------------------------------------------------------------------------
+for _n, _fname in [
+    ("relu", "relu"), ("relu6", "relu6"), ("silu", "silu"),
+    ("swish", "swish"), ("gelu", "gelu"), ("elu", "elu"), ("celu", "celu"),
+    ("selu", "selu"), ("leaky_relu", "leaky_relu"),
+    ("hardshrink", "hardshrink"), ("hardsigmoid", "hardsigmoid"),
+    ("hardtanh", "hardtanh"), ("logsigmoid", "log_sigmoid"),
+    ("mish", "mish"), ("softplus", "softplus"),
+    ("softshrink", "softshrink"), ("softsign", "softsign"),
+    ("tanh_shrink", "tanhshrink"), ("thresholded_relu", "thresholded_relu"),
+    ("prelu", "prelu"), ("maxout", "maxout"),
+    ("log_softmax", "log_softmax"), ("gumbel_softmax", "gumbel_softmax"),
+    ("label_smooth", "label_smooth"),
+]:
+    _reg(_n, _adapter(_fname))
+
+_reg("rrelu", _adapter("rrelu", training=False))
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", seed=0):
+    """Pure dropout (reference dropout op, fixed_seed path): the eager
+    functional.dropout draws from the framework RNG; this kernel takes the
+    seed explicitly so it is a pure function."""
+    if not training or p == 0.0:
+        return x, jnp.ones_like(x, dtype=jnp.uint8)
+    key = jax.random.PRNGKey(seed)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    scale = 1.0 / (1.0 - p) if mode == "upscale_in_train" else 1.0
+    out = jnp.where(keep, x * scale, 0.0).astype(x.dtype)
+    return out, keep.astype(jnp.uint8)
+
+
+_reg("dropout", dropout)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool
+# ---------------------------------------------------------------------------
+_reg("conv2d", _adapter("conv2d"))
+_reg("conv3d", _adapter("conv3d"))
+_reg("conv2d_transpose", _adapter("conv2d_transpose"))
+_reg("conv3d_transpose", _adapter("conv3d_transpose"))
+_reg("conv2d_transpose_bias", _adapter("conv2d_transpose"))
+
+
+def depthwise_conv2d(x, weight, stride=1, padding=0, dilation=1,
+                     data_format="NCHW"):
+    groups = x.shape[-1] if data_format.endswith("C") and \
+        len(data_format) > 2 else x.shape[1]
+    return _uw(_F().conv2d(x, weight, None, stride, padding, dilation,
+                           int(groups), data_format))
+
+
+def depthwise_conv2d_transpose(x, weight, stride=1, padding=0, dilation=1,
+                               data_format="NCHW"):
+    groups = x.shape[-1] if data_format.endswith("C") and \
+        len(data_format) > 2 else x.shape[1]
+    return _uw(_F().conv2d_transpose(x, weight, None, stride, padding,
+                                     output_padding=0, groups=int(groups),
+                                     dilation=dilation,
+                                     data_format=data_format))
+
+
+_reg("depthwise_conv2d", depthwise_conv2d)
+_reg("depthwise_conv2d_transpose", depthwise_conv2d_transpose)
+
+
+def pool2d(x, kernel_size, strides=None, paddings=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT"):
+    F = _F()
+    if global_pooling:
+        kernel_size = x.shape[2:4] if data_format == "NCHW" else x.shape[1:3]
+        paddings = 0
+    if adaptive:
+        f = F.adaptive_max_pool2d if pooling_type == "max" \
+            else F.adaptive_avg_pool2d
+        return _uw(f(x, kernel_size))
+    if pooling_type == "max":
+        return _uw(F.max_pool2d(x, kernel_size, strides, paddings,
+                                ceil_mode, False, data_format))
+    return _uw(F.avg_pool2d(x, kernel_size, strides, paddings, ceil_mode,
+                            not exclusive, None, data_format))
+
+
+def pool3d(x, kernel_size, strides=None, paddings=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max",
+           global_pooling=False, adaptive=False,
+           padding_algorithm="EXPLICIT"):
+    F = _F()
+    if global_pooling:
+        kernel_size = x.shape[2:5] if data_format == "NCDHW" \
+            else x.shape[1:4]
+        paddings = 0
+    if adaptive:
+        f = F.adaptive_max_pool3d if pooling_type == "max" \
+            else F.adaptive_avg_pool3d
+        return _uw(f(x, kernel_size))
+    if pooling_type == "max":
+        return _uw(F.max_pool3d(x, kernel_size, strides, paddings,
+                                ceil_mode, False, data_format))
+    return _uw(F.avg_pool3d(x, kernel_size, strides, paddings, ceil_mode,
+                            not exclusive, None, data_format))
+
+
+_reg("pool2d", pool2d)
+_reg("pool3d", pool3d)
+
+
+def _tup(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(i) for i in np.asarray(v).reshape(-1))[:n]
+
+
+def _neg_fill(dtype):
+    d = np.dtype(dtype)
+    if np.issubdtype(d, np.floating):
+        return float(np.finfo(np.float32).min) if d == jnp.bfloat16 \
+            else float(np.finfo(d).min)
+    return int(np.iinfo(d).min)
+
+
+def _max_pool_with_index(x, kernel_size, stride, padding, n,
+                         ceil_mode=False):
+    """Windowed argmax via patch extraction: conv_general_dilated_patches
+    lays every window out along a channel axis; argmax over it gives the
+    in-window offset, converted to a flat spatial index (reference
+    max_pool2d_with_index op).
+
+    Padding is applied explicitly with the dtype's lowest value so pad
+    positions can never win the max (lax patch extraction pads with 0,
+    which is wrong for all-negative windows); ceil_mode extends the right
+    pad so partial windows are kept."""
+    ks, st = _tup(kernel_size, n), _tup(stride or kernel_size, n)
+    pd = _tup(padding, n)
+    B, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:2 + n]
+    pads = [[0, 0], [0, 0]]
+    for d in range(n):
+        hi = pd[d]
+        if ceil_mode:
+            span = spatial[d] + 2 * pd[d] - ks[d]
+            out_d = -(-span // st[d]) + 1
+            hi = max(hi, (out_d - 1) * st[d] + ks[d] - spatial[d] - pd[d])
+        pads.append([pd[d], hi])
+    xp = jnp.pad(x, pads, constant_values=_neg_fill(x.dtype))
+    psp = xp.shape[2:]
+    out_sp = tuple((psp[d] - ks[d]) // st[d] + 1 for d in range(n))
+    # one strided slice per in-window offset (row-major over the kernel),
+    # stacked on a K axis: [B, C, K, *out_sp]. Avoids the conv-patches
+    # route, whose accumulation overflows on the -inf-like fill values.
+    import itertools
+
+    K = int(np.prod(ks))
+    slabs = []
+    for off in itertools.product(*[range(k) for k in ks]):
+        idx = (slice(None), slice(None)) + tuple(
+            slice(off[d], off[d] + (out_sp[d] - 1) * st[d] + 1, st[d])
+            for d in range(n))
+        slabs.append(xp[idx])
+    patches = jnp.stack(slabs, axis=2)
+    vals = jnp.max(patches, axis=2)
+    arg = jnp.argmax(patches, axis=2)           # offset within the window
+    # flat index into the (unpadded) input spatial grid
+    idx = jnp.zeros_like(arg)
+    rem = arg
+    grid = jnp.meshgrid(*[jnp.arange(s) for s in out_sp], indexing="ij")
+    for d in range(n):
+        inner = int(np.prod(ks[d + 1:]))
+        off_d = rem // inner
+        rem = rem % inner
+        pos_d = grid[d].reshape((1, 1) + out_sp) * st[d] - pd[d] + off_d
+        pos_d = jnp.clip(pos_d, 0, spatial[d] - 1)
+        idx = idx * spatial[d] + pos_d
+    return vals, idx.astype(jnp.int32)
+
+
+def _adaptive_max_pool_with_index(x, output_size, n):
+    """Adaptive windowed argmax: cell d spans [floor(i*S/O), ceil((i+1)*S/O))
+    — same binning as the reference's adaptive pooling. Output sizes are
+    static and small, so a per-cell slice loop unrolls fine under jit."""
+    import itertools
+
+    spatial = x.shape[2:2 + n]
+    outs = _tup(output_size, n)
+    cells_v, cells_i = {}, {}
+    for cell in itertools.product(*[range(o) for o in outs]):
+        lo = [(cell[d] * spatial[d]) // outs[d] for d in range(n)]
+        hi = [-(-((cell[d] + 1) * spatial[d]) // outs[d]) for d in range(n)]
+        region = x
+        for d in range(n):
+            region = jax.lax.slice_in_dim(region, lo[d], hi[d], axis=2 + d)
+        rs = region.shape[2:]
+        flat = region.reshape(region.shape[:2] + (-1,))
+        a = jnp.argmax(flat, axis=-1)
+        v = jnp.max(flat, axis=-1)
+        pos, rem = None, a
+        for d in range(n):
+            inner = int(np.prod(rs[d + 1:]))
+            p_d = rem // inner + lo[d]
+            rem = rem % inner
+            pos = p_d if pos is None else pos * spatial[d] + p_d
+        cells_v[cell], cells_i[cell] = v, pos
+    shape = x.shape[:2] + outs
+    vals = jnp.stack([cells_v[c] for c in sorted(cells_v)], axis=-1)
+    idx = jnp.stack([cells_i[c] for c in sorted(cells_i)], axis=-1)
+    return vals.reshape(shape), idx.reshape(shape).astype(jnp.int32)
+
+
+def max_pool2d_with_index(x, kernel_size, strides=None, paddings=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    if adaptive:
+        return _adaptive_max_pool_with_index(x, kernel_size, 2)
+    if global_pooling:
+        kernel_size, strides, paddings = x.shape[2:4], None, 0
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 2,
+                                ceil_mode)
+
+
+def max_pool3d_with_index(x, kernel_size, strides=None, paddings=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    if adaptive:
+        return _adaptive_max_pool_with_index(x, kernel_size, 3)
+    if global_pooling:
+        kernel_size, strides, paddings = x.shape[2:5], None, 0
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 3,
+                                ceil_mode)
+
+
+_reg("max_pool2d_with_index", max_pool2d_with_index)
+_reg("max_pool3d_with_index", max_pool3d_with_index)
+
+
+def unpool(x, indices, kernel_size, stride=None, padding=0,
+           output_size=None, data_format="NCHW"):
+    """Inverse of max_pool2d_with_index: scatter pooled values back to
+    their argmax positions (reference unpool op)."""
+    B, C, H, W = x.shape
+    if output_size is None:
+        ks, st = _tup(kernel_size, 2), _tup(stride or kernel_size, 2)
+        pd = _tup(padding, 2)
+        output_size = ((H - 1) * st[0] - 2 * pd[0] + ks[0],
+                       (W - 1) * st[1] - 2 * pd[1] + ks[1])
+    oh, ow = int(output_size[-2]), int(output_size[-1])
+    flat = jnp.zeros((B, C, oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda f, v, i: f.at[i.reshape(-1)].add(v.reshape(-1))))(
+            flat, x, indices)
+    return out.reshape(B, C, oh, ow)
+
+
+def unpool3d(x, indices, kernel_size, stride=None, padding=0,
+             output_size=None, data_format="NCDHW"):
+    B, C, D, H, W = x.shape
+    if output_size is None:
+        ks, st = _tup(kernel_size, 3), _tup(stride or kernel_size, 3)
+        pd = _tup(padding, 3)
+        output_size = ((D - 1) * st[0] - 2 * pd[0] + ks[0],
+                       (H - 1) * st[1] - 2 * pd[1] + ks[1],
+                       (W - 1) * st[2] - 2 * pd[2] + ks[2])
+    od, oh, ow = (int(s) for s in output_size[-3:])
+    flat = jnp.zeros((B, C, od * oh * ow), x.dtype)
+    out = jax.vmap(jax.vmap(
+        lambda f, v, i: f.at[i.reshape(-1)].add(v.reshape(-1))))(
+            flat, x, indices)
+    return out.reshape(B, C, od, oh, ow)
+
+
+_reg("unpool", unpool)
+_reg("unpool3d", unpool3d)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    """(sum |x|^p)^(1/p) over windows (reference lp_pool2d)."""
+    p = float(norm_type)
+    ks, st = _tup(kernel_size, 2), _tup(stride or kernel_size, 2)
+    pd = [(i, i) for i in _tup(padding, 2)]
+    powed = jnp.abs(x.astype(jnp.float32)) ** p
+    if data_format == "NHWC":
+        window = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        pads = [(0, 0)] + pd + [(0, 0)]
+    else:
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        pads = [(0, 0), (0, 0)] + pd
+    s = jax.lax.reduce_window(powed, 0.0, jax.lax.add, window, strides,
+                              pads)
+    return (s ** (1.0 / p)).astype(x.dtype)
+
+
+_reg("lp_pool2d", lp_pool2d)
+
+
+def _fractional_pool(x, output_size, random_u, n):
+    """Fractional max pooling (reference fractional_max_pool2d/3d,
+    Graham 2014): pseudo-random region boundaries
+    a_i = ceil(alpha*(i+u)) - ceil(alpha*u)."""
+    spatial = x.shape[2:2 + n]
+    outs = _tup(output_size, n)
+    u = float(random_u) if random_u else 0.5
+
+    def bounds(in_s, out_s):
+        alpha = in_s / out_s
+        i = np.arange(out_s + 1)
+        b = np.ceil(alpha * (i + u)) - math.ceil(alpha * u)
+        b = np.clip(b.astype(np.int64), 0, in_s)
+        b[-1] = in_s
+        return b
+
+    bs = [bounds(spatial[d], outs[d]) for d in range(n)]
+    # per-cell slice + argmax (region boundaries are static and the output
+    # grid small, so the loop unrolls under jit); the argmax gives the true
+    # flat input index the unpool op scatters by.
+    import itertools
+
+    cells_v, cells_i = {}, {}
+    for cell in itertools.product(*[range(o) for o in outs]):
+        lo = [int(bs[d][cell[d]]) for d in range(n)]
+        hi = [int(max(bs[d][cell[d] + 1], bs[d][cell[d]] + 1))
+              for d in range(n)]
+        region = x
+        for d in range(n):
+            region = jax.lax.slice_in_dim(region, lo[d], hi[d], axis=2 + d)
+        rs = region.shape[2:]
+        flat = region.reshape(region.shape[:2] + (-1,))
+        cells_v[cell] = jnp.max(flat, axis=-1)
+        a = jnp.argmax(flat, axis=-1)
+        pos, rem = None, a
+        for d in range(n):
+            inner = int(np.prod(rs[d + 1:]))
+            p_d = rem // inner + lo[d]
+            rem = rem % inner
+            pos = p_d if pos is None else pos * spatial[d] + p_d
+        cells_i[cell] = pos
+    shape = x.shape[:2] + outs
+    out = jnp.stack([cells_v[c] for c in sorted(cells_v)], axis=-1)
+    flat_idx = jnp.stack([cells_i[c] for c in sorted(cells_i)], axis=-1)
+    return out.reshape(shape), flat_idx.reshape(shape).astype(jnp.int32)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=0.0,
+                          return_mask=False):
+    return _fractional_pool(x, output_size, random_u, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=0.0,
+                          return_mask=False):
+    return _fractional_pool(x, output_size, random_u, 3)
+
+
+_reg("fractional_max_pool2d", fractional_max_pool2d)
+_reg("fractional_max_pool3d", fractional_max_pool3d)
+
+_reg("fold", _adapter("fold"))
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+def layer_norm(x, weight=None, bias=None, epsilon=1e-5,
+               begin_norm_axis=1):
+    shape = x.shape[begin_norm_axis:]
+    return _uw(_F().layer_norm(x, shape, weight, bias, epsilon))
+
+
+_reg("layer_norm", layer_norm)
+_reg("rms_norm", _adapter("rms_norm"))
+_reg("group_norm", _adapter("group_norm"))
+
+
+def instance_norm(x, scale=None, bias=None, epsilon=1e-5):
+    return _uw(_F().instance_norm(x, None, None, scale, bias,
+                                  eps=epsilon))
+
+
+_reg("instance_norm", instance_norm)
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """reference spectral_norm op: normalize weight by its largest
+    singular value, estimated by power iteration on (u, v)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    w_mat = w.reshape(w.shape[0], -1)
+    for _ in range(max(int(power_iters), 0)):
+        v = w_mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = w_mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ w_mat @ v
+    out = w_mat / sigma
+    return jnp.moveaxis(out.reshape(w.shape), 0, dim)
+
+
+_reg("spectral_norm", spectral_norm)
+
+
+def fused_batch_norm_act(x, scale, bias, mean, variance, momentum=0.9,
+                         epsilon=1e-5, act_type="relu"):
+    F = _F()
+    y = _uw(F.batch_norm(x, mean, variance, scale, bias, training=False,
+                         momentum=momentum, epsilon=epsilon))
+    act = getattr(F, act_type, F.relu)
+    return _uw(act(y))
+
+
+def fused_bn_add_activation(x, z, scale, bias, mean, variance,
+                            momentum=0.9, epsilon=1e-5, act_type="relu"):
+    F = _F()
+    y = _uw(F.batch_norm(x, mean, variance, scale, bias, training=False,
+                         momentum=momentum, epsilon=epsilon))
+    return _uw(getattr(F, act_type, F.relu)(y + z))
+
+
+_reg("fused_batch_norm_act", fused_batch_norm_act)
+_reg("fused_bn_add_activation", fused_bn_add_activation)
+
+
+def sync_batch_norm_(x, mean, variance, scale, bias, is_test=False,
+                     momentum=0.9, epsilon=1e-5, data_format="NCHW",
+                     use_global_stats=False, trainable_statistics=False,
+                     axis_name=None):
+    """reference sync_batch_norm_: batch norm with cross-replica batch
+    statistics. Inside shard_map/pmap pass axis_name to reduce moments
+    over the data axis; outside it's plain batch norm."""
+    red = tuple(i for i in range(x.ndim)
+                if i != (1 if data_format == "NCHW" else x.ndim - 1))
+    if is_test or use_global_stats:
+        m, v = mean, variance
+    else:
+        m = jnp.mean(x, axis=red)
+        msq = jnp.mean(x * x, axis=red)
+        if axis_name is not None:
+            m = jax.lax.pmean(m, axis_name)
+            msq = jax.lax.pmean(msq, axis_name)
+        v = msq - m * m
+    shape = [1] * x.ndim
+    shape[1 if data_format == "NCHW" else -1] = -1
+    xn = (x - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+    out = xn * scale.reshape(shape) + bias.reshape(shape)
+    new_mean = momentum * mean + (1 - momentum) * m
+    new_var = momentum * variance + (1 - momentum) * v
+    saved_inv = jax.lax.rsqrt(v + epsilon)
+    return out, new_mean, new_var, m, saved_inv, None
+
+
+_reg("sync_batch_norm_", sync_batch_norm_)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+_reg("bce_loss", _adapter("binary_cross_entropy", reduction="none"))
+_reg("kldiv_loss", _adapter("kl_div"))
+_reg("nll_loss", _adapter("nll_loss"))
+_reg("log_loss", _adapter("log_loss"))
+_reg("warpctc", _adapter("ctc_loss"))
+
+
+def huber_loss(input, label, delta=1.0):
+    """reference huber_loss op (returns per-element loss + residual)."""
+    r = input - label
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return loss, r
+
+
+_reg("huber_loss", huber_loss)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, pos_weight=None,
+                                      normalize=False, ignore_index=-100):
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if pos_weight is not None:
+        log_weight = (pos_weight - 1.0) * label + 1.0
+        loss = loss * log_weight
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    return loss
+
+
+_reg("sigmoid_cross_entropy_with_logits", sigmoid_cross_entropy_with_logits)
+
+
+def cross_entropy_with_softmax(logits, label, soft_label=False,
+                               use_softmax=True, numeric_stable_mode=True,
+                               ignore_index=-100, axis=-1):
+    logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax \
+        else jnp.log(jnp.clip(logits, 1e-30))
+    softmax = jnp.exp(logp)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == logp.ndim:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab, 0), axis), axis=axis)
+        loss = -jnp.where(
+            jnp.expand_dims(lab, axis) == ignore_index, 0.0, picked)
+    return softmax, loss
+
+
+_reg("cross_entropy_with_softmax", cross_entropy_with_softmax)
+
+
+def identity_loss(x, reduction=1):
+    """reference identity_loss: 0=sum, 1=mean, 2=none."""
+    if reduction in (0, "sum"):
+        return jnp.sum(x)
+    if reduction in (1, "mean"):
+        return jnp.mean(x)
+    return x
+
+
+_reg("identity_loss", identity_loss)
+
+
+def hsigmoid_loss(x, label, w, bias=None, num_classes=2, path_table=None,
+                  path_code=None, is_sparse=False):
+    """Hierarchical sigmoid loss (reference hsigmoid_loss op). Default
+    tree: complete binary tree over num_classes leaves; codes are the
+    bits of (label + num_classes) walked from the root."""
+    B = x.shape[0]
+    depth = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+    if path_table is None:
+        # node ids along the path for each label (complete-tree layout)
+        lab = label.astype(jnp.int32).reshape(B)
+        node = lab + num_classes          # leaf id in heap order
+        tables, codes = [], []
+        for _ in range(depth):
+            codes.append((node % 2).astype(jnp.float32))
+            node = node // 2
+            tables.append(node)
+        path_table = jnp.stack(tables[::-1], axis=1) - 1   # row in w
+        path_code = jnp.stack(codes[::-1], axis=1)
+    pt = jnp.clip(path_table.astype(jnp.int32), 0, w.shape[0] - 1)
+    pc = path_code.astype(x.dtype)
+    w_rows = w[pt]                        # [B, depth, feat]
+    logits = jnp.einsum("bdf,bf->bd", w_rows, x)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[pt]
+    # label bit 1 -> sigmoid(logit), 0 -> 1-sigmoid
+    loss = jnp.maximum(logits, 0.0) - logits * pc \
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+_reg("hsigmoid_loss", hsigmoid_loss)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         ring_id=0, rank=0, nranks=1):
+    """ArcFace-style margin softmax (reference margin_cross_entropy op):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE."""
+    lab = label.astype(jnp.int32).reshape(-1)
+    onehot = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+    cos_t = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos_t)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, cos_t) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return jnp.exp(logp), loss
+
+
+_reg("margin_cross_entropy", margin_cross_entropy)
+
+
+# ---------------------------------------------------------------------------
+# interpolation (reference *_interp ops -> one interpolate kernel)
+# ---------------------------------------------------------------------------
+def _interp(mode):
+    def kernel(x, size=None, scale_factor=None, align_corners=False,
+               data_format=None):
+        n = x.ndim - 2
+        if data_format is None:
+            data_format = {1: "NCW", 2: "NCHW", 3: "NCDHW"}[n]
+        return _uw(_F().interpolate(x, size=size,
+                                    scale_factor=scale_factor, mode=mode,
+                                    align_corners=align_corners,
+                                    data_format=data_format))
+    kernel.__name__ = mode + "_interp"
+    return kernel
+
+
+_reg("nearest_interp", _interp("nearest"))
+_reg("bilinear_interp", _interp("bilinear"))
+_reg("bicubic_interp", _interp("bicubic"))
+_reg("linear_interp", _interp("linear"))
+_reg("trilinear_interp", _interp("trilinear"))
+
+
+# ---------------------------------------------------------------------------
+# misc nn
+# ---------------------------------------------------------------------------
+_reg("affine_grid", _adapter("affine_grid"))
+_reg("grid_sample", _adapter("grid_sample"))
+_reg("pixel_shuffle", _adapter("pixel_shuffle"))
+_reg("pixel_unshuffle", _adapter("pixel_unshuffle"))
+_reg("channel_shuffle", _adapter("channel_shuffle"))
+_reg("temporal_shift", _adapter("temporal_shift"))
+_reg("sequence_mask", _adapter("sequence_mask"), differentiable=False)
+
+
+def shuffle_channel(x, group=1):
+    return _uw(_F().channel_shuffle(x, group))
+
+
+_reg("shuffle_channel", shuffle_channel)
+
+
+def pad3d(x, paddings, mode="constant", pad_value=0.0,
+          data_format="NCDHW"):
+    """reference pad3d op: paddings = [l, r, t, b, front, back] on the
+    spatial dims of a 5-D tensor."""
+    p = [int(i) for i in np.asarray(paddings).reshape(-1)]
+    if data_format == "NCDHW":
+        full = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    else:
+        full = [(0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, full, mode=jmode, constant_values=pad_value)
+    return jnp.pad(x, full, mode=jmode)
+
+
+_reg("pad3d", pad3d)
+
+
+def bilinear(x, y, weight, bias=None):
+    """reference bilinear op: out[b, k] = x[b]^T W[k] y[b] + bias."""
+    out = jnp.einsum("bi,kij,bj->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+_reg("bilinear", bilinear)
+
+
+def swiglu(x, y=None):
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+_reg("swiglu", swiglu)
+
+
+def fused_softmax_mask(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    S = x.shape[-1]
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    masked = jnp.where(causal, x, jnp.finfo(x.dtype).min)
+    return jax.nn.softmax(masked, axis=-1)
+
+
+_reg("fused_softmax_mask", fused_softmax_mask)
+_reg("fused_softmax_mask_upper_triangle", fused_softmax_mask_upper_triangle)

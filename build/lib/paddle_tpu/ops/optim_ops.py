@@ -1,0 +1,374 @@
+"""Functional optimizer-update kernels (the ops.yaml optimizer surface).
+
+Reference analog: the optimizer ops in /root/reference/paddle/phi/ops/yaml/
+ops.yaml (sgd_, momentum_, adam_, adamw_, lamb_, ... — kernels under
+paddle/phi/kernels/*adam*). There each is an in-place CUDA kernel; here each
+is a pure jax function state -> new state (XLA donates the buffers when
+called under jit, recovering the in-place behavior), registered under the
+reference op name. The high-level `paddle_tpu.optimizer` classes express the
+same math at the Tensor layer; these kernels are the raw per-op surface used
+by the fleet/auto-tuner paths and the OpTest suite.
+
+All take arrays, return tuples of arrays ordered as the yaml `output` lists.
+`master_param` is the fp32 shadow for multi-precision training: when passed,
+the update runs on it and `param` is produced by casting back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _split_master(param, master_param):
+    """Return (compute_param, had_master)."""
+    if master_param is not None:
+        return master_param, True
+    return param, False
+
+
+def _join_master(new_w, param_dtype, had_master):
+    if had_master:
+        return new_w.astype(param_dtype), new_w
+    return new_w, None
+
+
+def sgd_(param, learning_rate, grad, master_param=None,
+         multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    new_w = w - learning_rate.astype(w.dtype) * grad.astype(w.dtype)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, m
+
+
+def momentum_(param, grad, velocity, learning_rate, master_param=None,
+              mu=0.9, use_nesterov=False, regularization_method="",
+              regularization_coeff=0.0, multi_precision=False,
+              rescale_grad=1.0):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype) * rescale_grad
+    if regularization_method == "l2_decay":
+        g = g + regularization_coeff * w
+    v = mu * velocity + g
+    lr = learning_rate.astype(w.dtype)
+    if use_nesterov:
+        new_w = w - (g + mu * v) * lr
+    else:
+        new_w = w - lr * v
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, v, m
+
+
+def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, master_param=None, skip_update=None, beta1=0.9,
+          beta2=0.999, epsilon=1e-8, lazy_mode=False,
+          min_row_size_to_use_multithread=1000, multi_precision=False,
+          use_global_beta_pow=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    m1 = beta1 * moment1 + (1 - beta1) * g
+    m2 = beta2 * moment2 + (1 - beta2) * g * g
+    # input pows are beta^t at step t (reference AdamKernel uses them as-is
+    # and emits pow*beta for the next step)
+    lr = learning_rate.astype(w.dtype) * jnp.sqrt(1 - beta2_pow) \
+        / (1 - beta1_pow)
+    new_w = w - lr * m1 / (jnp.sqrt(m2) + epsilon)
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    if skip_update is not None:
+        skip = jnp.asarray(skip_update).astype(bool).reshape(())
+        new_w = jnp.where(skip, w, new_w)
+        m1 = jnp.where(skip, moment1, m1)
+        m2 = jnp.where(skip, moment2, m2)
+        b1p = jnp.where(skip, beta1_pow, b1p)
+        b2p = jnp.where(skip, beta2_pow, b2p)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, m1, m2, b1p, b2p, m
+
+
+def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+           beta2_pow, master_param=None, skip_update=None, beta1=0.9,
+           beta2=0.999, epsilon=1e-8, lr_ratio=1.0, coeff=0.01,
+           with_decay=True, lazy_mode=False,
+           min_row_size_to_use_multithread=1000, multi_precision=False,
+           use_global_beta_pow=False):
+    w, has_m = _split_master(param, master_param)
+    lr = learning_rate.astype(w.dtype) * lr_ratio
+    if with_decay:
+        w = w * (1 - lr * coeff)       # decoupled decay before the step
+    g = grad.astype(w.dtype)
+    m1 = beta1 * moment1 + (1 - beta1) * g
+    m2 = beta2 * moment2 + (1 - beta2) * g * g
+    step_lr = lr * jnp.sqrt(1 - beta2_pow) / (1 - beta1_pow)
+    new_w = w - step_lr * m1 / (jnp.sqrt(m2) + epsilon)
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, m1, m2, b1p, b2p, m
+
+
+def adagrad_(param, grad, moment, learning_rate, master_param=None,
+             epsilon=1e-6, multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    mom = moment + g * g
+    new_w = w - learning_rate.astype(w.dtype) * g / (jnp.sqrt(mom) + epsilon)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, mom, m
+
+
+def decayed_adagrad(param, grad, moment, learning_rate, decay=0.95,
+                    epsilon=1e-6):
+    mom = decay * moment + (1 - decay) * grad * grad
+    new_w = param - learning_rate.astype(param.dtype) * grad \
+        / (jnp.sqrt(mom) + epsilon)
+    return new_w, mom
+
+
+def adadelta_(param, grad, avg_squared_grad, avg_squared_update,
+              learning_rate, master_param=None, rho=0.95, epsilon=1e-6,
+              multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    asg = rho * avg_squared_grad + (1 - rho) * g * g
+    update = -jnp.sqrt((avg_squared_update + epsilon) / (asg + epsilon)) * g
+    asu = rho * avg_squared_update + (1 - rho) * update * update
+    new_w = w + learning_rate.astype(w.dtype) * update
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, asg, asu, m
+
+
+def adamax_(param, grad, learning_rate, moment, inf_norm, beta1_pow,
+            master_param=None, beta1=0.9, beta2=0.999, epsilon=1e-8,
+            multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    mom = beta1 * moment + (1 - beta1) * g
+    inf = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    lr = learning_rate.astype(w.dtype) / (1 - beta1_pow)
+    new_w = w - lr * mom / (inf + epsilon)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, mom, inf, m
+
+
+def asgd_(param, grad, learning_rate, d, y, n, master_param=None,
+          multi_precision=False):
+    """Averaged SGD (reference phi AsgdKernel): d += g - y_old; y = g;
+    param -= lr/n * d."""
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    d_new = d + g - y
+    new_w = w - learning_rate.astype(w.dtype) * d_new / n
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, d_new, g, m
+
+
+def rmsprop_(param, mean_square, grad, moment, learning_rate,
+             mean_grad=None, master_param=None, epsilon=1e-10, decay=0.9,
+             momentum=0.0, centered=False, multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    ms = decay * mean_square + (1 - decay) * g * g
+    lr = learning_rate.astype(w.dtype)
+    if centered:
+        if mean_grad is None:
+            raise ValueError(
+                "rmsprop_ with centered=True requires a mean_grad "
+                "accumulator (reference: rmsprop op MeanGrad input)"
+            )
+        mg = decay * mean_grad + (1 - decay) * g
+        denom = jnp.sqrt(ms - mg * mg + epsilon)
+    else:
+        mg = mean_grad
+        denom = jnp.sqrt(ms + epsilon)
+    mom = momentum * moment + lr * g / denom
+    new_w = w - mom
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, mom, ms, mg, m
+
+
+def rprop_(param, grad, prev, learning_rate, master_param=None,
+           learning_rate_range=(1e-6, 50.0), etas=(0.5, 1.2),
+           multi_precision=False):
+    """Resilient backprop (reference RpropKernel): per-element lr grows by
+    eta_plus when the gradient keeps sign, shrinks by eta_minus on a sign
+    flip (and the step is skipped)."""
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    lr_min, lr_max = learning_rate_range
+    eta_neg, eta_pos = etas
+    sign = jnp.sign(g * prev)
+    factor = jnp.where(sign > 0, eta_pos, jnp.where(sign < 0, eta_neg, 1.0))
+    lr = jnp.clip(learning_rate * factor, lr_min, lr_max)
+    g_eff = jnp.where(sign < 0, jnp.zeros_like(g), g)
+    new_w = w - lr.astype(w.dtype) * jnp.sign(g_eff)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, g_eff, lr, m
+
+
+def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+          beta2_pow, master_param=None, skip_update=None, weight_decay=0.01,
+          beta1=0.9, beta2=0.999, epsilon=1e-6, always_adapt=False,
+          multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    m1 = beta1 * moment1 + (1 - beta1) * g
+    m2 = beta2 * moment2 + (1 - beta2) * g * g
+    m1_hat = m1 / (1 - beta1_pow)
+    m2_hat = m2 / (1 - beta2_pow)
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    r = m1_hat / (jnp.sqrt(m2_hat) + epsilon) + weight_decay * w
+    w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+    r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    new_w = w - learning_rate.astype(w.dtype) * trust.astype(w.dtype) * r
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, m1, m2, b1p, b2p, m
+
+
+def nadam_(param, grad, learning_rate, momentum_decay_pow, beta2_pow,
+           mu_product, moment1, moment2, master_param=None, beta1=0.9,
+           beta2=0.999, epsilon=1e-8, momentum_decay=0.004,
+           multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    mdp = momentum_decay_pow * 0.96
+    b2p = beta2_pow * beta2
+    mu_t = beta1 * (1 - 0.5 * mdp)
+    mu_t1 = beta1 * (1 - 0.5 * mdp * 0.96)
+    mup = mu_product * mu_t
+    m1 = beta1 * moment1 + (1 - beta1) * g
+    m2 = beta2 * moment2 + (1 - beta2) * g * g
+    m1_hat = mu_t1 * m1 / (1 - mup * mu_t1) + (1 - mu_t) * g / (1 - mup)
+    m2_hat = m2 / (1 - b2p)
+    new_w = w - learning_rate.astype(w.dtype) * m1_hat \
+        / (jnp.sqrt(m2_hat) + epsilon)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, mdp, b2p, mup, m1, m2, m
+
+
+def radam_(param, grad, learning_rate, beta1_pow, beta2_pow, rho,
+           moment1, moment2, master_param=None, beta1=0.9, beta2=0.999,
+           epsilon=1e-8, multi_precision=False):
+    w, has_m = _split_master(param, master_param)
+    g = grad.astype(w.dtype)
+    rho_inf = 2.0 / (1 - beta2) - 1
+    step = jnp.log(beta2_pow) / jnp.log(beta2)   # recovered step count
+    rho_t = rho_inf - 2.0 * step * beta2_pow / (1 - beta2_pow)
+    m1 = beta1 * moment1 + (1 - beta1) * g
+    m2 = beta2 * moment2 + (1 - beta2) * g * g
+    m1_hat = m1 / (1 - beta1_pow)
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+    r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+    r = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+    adaptive = r * m1_hat / (jnp.sqrt(m2 / (1 - beta2_pow)) + epsilon)
+    sgd_step = m1_hat
+    new_w = w - learning_rate.astype(w.dtype) \
+        * jnp.where(rho_t > 5.0, adaptive, sgd_step)
+    p, m = _join_master(new_w, param.dtype, has_m)
+    return p, b1p, b2p, rho_t, m1, m2, m
+
+
+def average_accumulates_(param, in_sum_1, in_sum_2, in_sum_3,
+                         in_num_accumulates, in_old_num_accumulates,
+                         in_num_updates, average_window=0.0,
+                         max_average_window=2 ** 62,
+                         min_average_window=10000):
+    """Sliding-window parameter averaging (reference
+    AverageAccumulatesKernel) — accumulators roll over when the window
+    limit is hit."""
+    num_updates = in_num_updates + 1
+    num_acc = in_num_accumulates + 1
+    window = jnp.maximum(
+        jnp.asarray(average_window) * num_updates.astype(jnp.float32),
+        float(min_average_window)).astype(num_acc.dtype)
+    window = jnp.minimum(window, max_average_window)
+    roll = num_acc >= window
+    sum1 = in_sum_1 + param
+    sum2 = jnp.where(roll, in_sum_2 + sum1, in_sum_2)
+    sum1 = jnp.where(roll, jnp.zeros_like(sum1), sum1)
+    sum3 = jnp.where(num_acc + in_old_num_accumulates >= max_average_window,
+                     sum2, in_sum_3)
+    old_num = jnp.where(roll, num_acc, in_old_num_accumulates)
+    num_acc = jnp.where(roll, jnp.zeros_like(num_acc), num_acc)
+    return sum1, sum2, sum3, num_acc, old_num, num_updates
+
+
+def merged_adam_(param, grad, learning_rate, moment1, moment2, beta1_pow,
+                 beta2_pow, master_param=None, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, multi_precision=False,
+                 use_global_beta_pow=False):
+    """List-of-tensors adam (reference merged_adam — one fused launch; on
+    XLA the jit boundary fuses the per-param updates equivalently)."""
+    n = len(param)
+    mp = master_param if master_param is not None else [None] * n
+    outs = [adam_(param[i], grad[i], learning_rate[i], moment1[i],
+                  moment2[i], beta1_pow[i], beta2_pow[i], mp[i],
+                  None, beta1, beta2, epsilon) for i in range(n)]
+    return tuple(list(col) for col in zip(*outs))
+
+
+def merged_momentum_(param, grad, velocity, learning_rate,
+                     master_param=None, mu=0.9, use_nesterov=False,
+                     regularization_method=(), regularization_coeff=(),
+                     multi_precision=False, rescale_grad=1.0):
+    n = len(param)
+    mp = master_param if master_param is not None else [None] * n
+    rm = list(regularization_method) + [""] * n
+    rc = list(regularization_coeff) + [0.0] * n
+    outs = [momentum_(param[i], grad[i], velocity[i], learning_rate[i],
+                      mp[i], mu, use_nesterov, rm[i], rc[i],
+                      multi_precision, rescale_grad) for i in range(n)]
+    return tuple(list(col) for col in zip(*outs))
+
+
+# -- AMP loss-scaling ops ---------------------------------------------------
+
+def check_finite_and_unscale_(x, scale):
+    """reference: check_finite_and_unscale op (amp) — divide every tensor
+    by scale; found_infinite is true if any value is non-finite."""
+    inv = 1.0 / scale
+    outs = [t * inv.astype(t.dtype) for t in x]
+    found = jnp.any(jnp.stack(
+        [jnp.any(~jnp.isfinite(t.astype(jnp.float32))) for t in x])) \
+        if x else jnp.asarray(False)
+    return outs, found
+
+
+def update_loss_scaling_(x, found_infinite, prev_loss_scaling,
+                         in_good_steps, in_bad_steps, incr_every_n_steps,
+                         decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
+                         stop_update=False):
+    """reference: update_loss_scaling op — dynamic loss-scale schedule."""
+    found = jnp.asarray(found_infinite).reshape(())
+    good = jnp.where(found, jnp.zeros_like(in_good_steps), in_good_steps + 1)
+    bad = jnp.where(found, in_bad_steps + 1, jnp.zeros_like(in_bad_steps))
+    grow = good >= incr_every_n_steps
+    shrink = bad >= decr_every_n_nan_or_inf
+    scale = jnp.where(
+        shrink, jnp.maximum(prev_loss_scaling * decr_ratio, 1.0),
+        jnp.where(grow, prev_loss_scaling * incr_ratio, prev_loss_scaling))
+    good = jnp.where(grow | shrink, jnp.zeros_like(good), good)
+    bad = jnp.where(shrink, jnp.zeros_like(bad), bad)
+    if stop_update:
+        scale, good, bad = prev_loss_scaling, in_good_steps, in_bad_steps
+    outs = [jnp.where(found, jnp.zeros_like(t), t) for t in x]
+    return outs, scale, good, bad
+
+
+_OPTIM_OPS = [
+    sgd_, momentum_, adam_, adamw_, adagrad_, decayed_adagrad, adadelta_,
+    adamax_, asgd_, rmsprop_, rprop_, lamb_, nadam_, radam_,
+    average_accumulates_, merged_adam_, merged_momentum_,
+    check_finite_and_unscale_, update_loss_scaling_,
+]
+
+for _fn in _OPTIM_OPS:
+    register(_fn.__name__, _fn, differentiable=False, tags=("optimizer",))
+    __all__.append(_fn.__name__)

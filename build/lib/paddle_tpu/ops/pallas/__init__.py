@@ -1,0 +1,24 @@
+"""Pallas TPU kernels — the fused-op layer.
+
+Reference analog: paddle/phi/kernels/fusion/gpu/ (hand-written CUDA fused
+kernels: flash attention, fused_rms_norm, fused_rope, ...). On TPU, XLA
+already fuses elementwise chains into matmuls, so only the ops XLA fuses
+poorly get hand kernels: attention (online-softmax blockwise over the KV
+axis) and rmsnorm-style HBM-bound reductions. Every kernel has a pure-jnp
+fallback (used on CPU test meshes and as the custom_vjp backward).
+"""
+import os
+
+import jax
+
+
+def use_pallas() -> bool:
+    flag = os.environ.get("PT_USE_PALLAS", "auto")
+    if flag in ("0", "false", "off"):
+        return False
+    if flag in ("1", "true", "on"):
+        return True
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False

@@ -1,0 +1,169 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Every draw pulls a key from framework.random.next_key(), so randomness is
+deterministic given paddle_tpu.seed() and trace-safe under rng_guard."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..framework.random import next_key
+from . import registry
+
+__all__ = [
+    "uniform", "uniform_", "normal", "normal_", "standard_normal", "randn",
+    "rand", "randint", "randint_like", "randperm", "bernoulli", "poisson",
+    "multinomial", "gaussian", "exponential_", "binomial", "standard_gamma",
+    "log_normal", "cauchy_", "geometric_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    d = convert_dtype(dtype) or jnp.float32
+    key = next_key() if not seed else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), d, min, max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x._value = jax.random.uniform(
+        next_key() if not seed else jax.random.key(seed),
+        x._value.shape, x._value.dtype, min, max)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    d = convert_dtype(dtype) or jnp.float32
+    key = next_key() if not seed else jax.random.key(seed)
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), d))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            np.shape(m), np.shape(s)
+        )
+        return Tensor(m + s * jax.random.normal(next_key(), out_shape,
+                                                jnp.float32))
+    return gaussian(shape if shape is not None else [1], mean, std)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (mean + std * jax.random.normal(
+        next_key(), x._value.shape, x._value.dtype))
+    return x
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return gaussian(shape, 0.0, 1.0, dtype=dtype)
+
+
+def randn(shape, dtype="float32", name=None):
+    return standard_normal(shape, dtype)
+
+
+def rand(shape, dtype="float32", name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype) or x.dtype
+    return Tensor(jax.random.randint(next_key(), tuple(x.shape), low, high)
+                  .astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    d = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.permutation(next_key(), int(n)).astype(d))
+
+
+def bernoulli(x, p=None, name=None):
+    def fn(a):
+        return jax.random.bernoulli(next_key(), a).astype(a.dtype)
+    return apply(fn, x, op_name="bernoulli", differentiable=False)
+
+
+def poisson(x, name=None):
+    def fn(a):
+        return jax.random.poisson(next_key(), a).astype(a.dtype)
+    return apply(fn, x, op_name="poisson", differentiable=False)
+
+
+def binomial(count, prob, name=None):
+    def fn(n, p):
+        return jax.random.binomial(next_key(), n.astype(jnp.float32),
+                                   p).astype(jnp.int64)
+    return apply(fn, count, prob, op_name="binomial", differentiable=False)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def _sample(a):
+        if a.ndim == 1:
+            return jax.random.choice(
+                next_key(), a.shape[0], shape=(num_samples,),
+                replace=replacement, p=a / a.sum()).astype(jnp.int64)
+        rows = []
+        for i in range(a.shape[0]):
+            rows.append(jax.random.choice(
+                next_key(), a.shape[1], shape=(num_samples,),
+                replace=replacement, p=a[i] / a[i].sum()))
+        return jnp.stack(rows).astype(jnp.int64)
+    return apply(_sample, x, op_name="multinomial", differentiable=False)
+
+
+def standard_gamma(x, name=None):
+    def fn(a):
+        return jax.random.gamma(next_key(), a)
+    return apply(fn, x, op_name="standard_gamma", differentiable=False)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(
+        next_key(), x._value.shape, x._value.dtype) / lam
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    g = gaussian(shape if shape is not None else [1], mean, std)
+    return Tensor(jnp.exp(g._value))
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._value = loc + scale * jax.random.cauchy(
+        next_key(), x._value.shape, x._value.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(next_key(), x._value.shape, jnp.float32, 1e-7, 1.0)
+    x._value = (jnp.ceil(jnp.log(u) / jnp.log1p(-probs))).astype(
+        x._value.dtype)
+    return x
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("random",))

@@ -1,0 +1,113 @@
+"""Declarative op registry.
+
+Reference analog: /root/reference/paddle/phi/ops/yaml/ops.yaml (445 ops) +
+KernelFactory (paddle/phi/core/kernel_factory.h:58). There, YAML is the single
+source of truth feeding four code generators. Here the registry is populated
+at import time by @defop decorations; each entry records the pure jax
+implementation (the "kernel"), differentiability (whether a VJP is recorded),
+and is queryable/dumpable — `dump_yaml()` emits the ops.yaml-equivalent
+inventory so coverage vs the reference can be audited mechanically.
+
+On TPU there is exactly one backend (XLA) and jax.vjp supplies every backward,
+so the (op, backend, dtype) -> kernel selection problem collapses to a name ->
+jax-function table; XLA's own dispatch handles dtype/layout specialization.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+__all__ = ["OpInfo", "register", "get", "all_ops", "dump_yaml",
+           "EXCLUSIONS"]
+
+# ops.yaml entries deliberately NOT implemented, with the reason — audited
+# by dump_yaml so coverage vs the reference is named-exclusions-only.
+EXCLUSIONS: Dict[str, str] = {
+    # CUDA-library-specific kernels with no TPU analog
+    "cudnn_lstm": "cuDNN descriptor API; the `rnn` op covers the math",
+    "dgc": "deep-gradient-compression: NCCL-stream sparse allreduce; "
+           "XLA collectives don't expose per-bucket sparse paths",
+    "dgc_momentum": "companion of dgc",
+    "sparse_attention": "CUDA block-sparse SDD/DSD kernels; dense flash "
+                        "attention covers the capability on TPU",
+    "fused_multi_transformer": "CUDA mega-kernel; the compiled-path "
+                               "transformer block is the TPU analog "
+                               "(XLA fuses the stack)",
+    # host-side / data-dependent-shape graph samplers
+    "graph_khop_sampler": "host neighbor sampling with dynamic result "
+                          "shapes; belongs to the input pipeline on TPU",
+    "graph_sample_neighbors": "same as graph_khop_sampler",
+    "weighted_sample_neighbors": "same as graph_khop_sampler",
+    "reindex_graph": "companion of the host graph samplers",
+    # legacy LoD (variable-length lattice) ops
+    "sequence_conv": "LoD sequence layout; masked dense conv covers it",
+    "sequence_pool": "LoD sequence layout; segment_pool covers it",
+    "chunk_eval": "LoD span bookkeeping; metric-layer concern",
+    "partial_concat": "LoD PS-era op",
+    "partial_sum": "LoD PS-era op",
+    # PS/recommender-era hashes & trees bound to the PS C++ runtime
+    "pyramid_hash": "PS-era murmur-hash embedding; DistributedEmbedding "
+                    "covers sparse lookup",
+    "tdm_child": "tree-based-match PS op",
+    "tdm_sampler": "tree-based-match PS op",
+    "rank_attention": "PS-era rank feature op",
+    "shuffle_batch": "PS-era host shuffle; io.DataLoader owns shuffling",
+    # misc CUDA-inference-only
+    "yolo_box_head": "TensorRT-deploy companion op",
+    "yolo_box_post": "TensorRT-deploy companion op",
+    "yolo_loss": "training loss kept in model zoo, not op registry",
+    "detection_map": "mAP metric with LoD inputs; metric-layer concern",
+    "generate_proposals": "dynamic-shape RPN proposal generation; "
+                          "multiclass_nms3-style static variant planned",
+    "flash_attn_unpadded": "ragged varlen layout; XLA needs static "
+                           "shapes — masked flash_attn covers it",
+    "flash_attn_varlen_qkvpacked": "same as flash_attn_unpadded",
+    "flash_attn_with_sparse_mask": "sparse-mask CUDA layout; dense mask "
+                                   "path covers it",
+    "class_center_sample": "PS-style distributed negative sampling",
+    "crf_decoding": None,  # implemented in yaml_extra
+    "coalesce_tensor": "fused-buffer aliasing is XLA's donation/layout "
+                       "job on TPU",
+    "correlation": None,   # implemented in vision_ops
+    "warprnnt": "CUDA warp-rnnt transducer loss kernel",
+    "ctc_align": None,     # implemented in yaml_extra
+}
+EXCLUSIONS = {k: v for k, v in EXCLUSIONS.items() if v is not None}
+
+
+@dataclass
+class OpInfo:
+    name: str
+    fn: Callable
+    differentiable: bool = True
+    tags: tuple = ()
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+
+def register(name: str, fn: Callable, differentiable: bool = True, tags=()):
+    _REGISTRY[name] = OpInfo(name, fn, differentiable, tuple(tags))
+    return _REGISTRY[name]
+
+
+def get(name: str) -> Optional[OpInfo]:
+    return _REGISTRY.get(name)
+
+
+def all_ops() -> Dict[str, OpInfo]:
+    return dict(_REGISTRY)
+
+
+def dump_yaml() -> str:
+    lines = []
+    for name in sorted(_REGISTRY):
+        info = _REGISTRY[name]
+        lines.append(f"- op : {name}")
+        lines.append(f"  backend : xla")
+        lines.append(f"  backward : {'vjp_auto' if info.differentiable else 'none'}")
+    for name in sorted(EXCLUSIONS):
+        lines.append(f"- op : {name}")
+        reason = EXCLUSIONS[name].replace('"', "'")
+        lines.append(f'  excluded : "{reason}"')
+    return "\n".join(lines)

@@ -1,0 +1,244 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py).
+
+Ops with integer companion outputs (topk/sort/mode) compute the indices
+non-differentiably and re-derive values via take_along_axis so the value path
+stays on the autograd tape without mixed-dtype vjps."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from . import registry
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "nonzero", "searchsorted",
+    "kthvalue", "mode", "unique", "unique_consecutive", "index_sample",
+    "masked_select", "bucketize", "histogram", "histogramdd", "bincount",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    def fn(a):
+        out = jnp.argmax(a, axis=None if axis is None else int(axis),
+                         keepdims=keepdim)
+        return out.astype(convert_dtype(dtype))
+    return apply(fn, x, op_name="argmax", differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import convert_dtype
+
+    def fn(a):
+        out = jnp.argmin(a, axis=None if axis is None else int(axis),
+                         keepdims=keepdim)
+        return out.astype(convert_dtype(dtype))
+    return apply(fn, x, op_name="argmin", differentiable=False)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=int(axis), stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return apply(fn, x, op_name="argsort", differentiable=False)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)
+    from .manipulation import take_along_axis
+
+    return take_along_axis(x, idx, axis=int(axis))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = int(axis)
+
+    def idx_fn(a):
+        axn = ax % a.ndim
+        src = a if largest else -a
+        moved = jnp.moveaxis(src, axn, -1)
+        _, idx = jax.lax.top_k(moved, k)
+        return jnp.moveaxis(idx, -1, axn).astype(jnp.int64)
+
+    indices = apply(idx_fn, x, op_name="topk_indices", differentiable=False)
+    from .manipulation import take_along_axis
+
+    values = take_along_axis(x, indices, axis=ax)
+    return values, indices
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    ax = int(axis)
+
+    def idx_fn(a):
+        axn = ax % a.ndim
+        order = jnp.argsort(a, axis=axn)
+        idx = jnp.take(order, k - 1, axis=axn)
+        return jnp.expand_dims(idx, axn).astype(jnp.int64)
+
+    indices = apply(idx_fn, x, op_name="kthvalue_idx", differentiable=False)
+    from .manipulation import take_along_axis
+
+    values = take_along_axis(x, indices, axis=ax)
+    if not keepdim:
+        from .manipulation import squeeze
+
+        values = squeeze(values, axis=ax)
+        indices = squeeze(indices, axis=ax)
+    return values, indices
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = x.numpy()
+    ax = int(axis) % arr.ndim
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # ties -> largest value, last index (reference semantics)
+        maxc = counts.max()
+        v = uniq[counts == maxc].max()
+        vals[i] = v
+        idxs[i] = np.where(row == v)[0][-1]
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(x.numpy())
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def fn(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(
+                lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+            )(s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(fn, sorted_sequence, values, op_name="searchsorted",
+                 differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = x.numpy()
+    res = np.unique(arr, return_index=True, return_inverse=True,
+                    return_counts=True, axis=axis)
+    uniq, idx, inv, counts = res
+    outs = [Tensor(jnp.asarray(uniq))]
+    if return_index:
+        outs.append(Tensor(jnp.asarray(idx.astype(np.int64))))
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = x.numpy()
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    n = arr.shape[ax]
+    if n == 0:
+        keep = np.zeros(0, bool)
+    else:
+        sl = [np.s_[:]] * arr.ndim
+        sl_prev = list(sl); sl_prev[ax] = np.s_[:-1]
+        sl_next = list(sl); sl_next[ax] = np.s_[1:]
+        diff = arr[tuple(sl_next)] != arr[tuple(sl_prev)]
+        other = tuple(i for i in range(arr.ndim) if i != ax)
+        keep = np.concatenate([[True], diff.any(axis=other)])
+    uniq = np.compress(keep, arr, axis=ax)
+    outs = [Tensor(jnp.asarray(uniq))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        pos = np.where(np.concatenate([keep, [True]]))[0]
+        counts = np.diff(pos)
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    arr = input.numpy()
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    w = weight.numpy() if weight is not None else None
+    hist, _ = np.histogram(arr, bins=int(bins), range=(lo, hi), weights=w,
+                           density=density)
+    return Tensor(jnp.asarray(hist if density or w is not None
+                              else hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    arr = x.numpy()
+    w = weights.numpy() if weights is not None else None
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return (Tensor(jnp.asarray(hist)),
+            [Tensor(jnp.asarray(e)) for e in edges])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def fn(a, *ws):
+        w = ws[0] if ws else None
+        return jnp.bincount(a, weights=w, minlength=int(minlength),
+                            length=int(max(int(jax.device_get(a).max()) + 1
+                                           if a.size else 1, minlength, 1)))
+    arr = x.numpy()
+    length = max(int(arr.max()) + 1 if arr.size else 1, int(minlength), 1)
+    def fn2(a, *ws):
+        w = ws[0] if ws else None
+        return jnp.bincount(a, weights=w, length=length)
+    extra = [weights] if weights is not None else []
+    return apply(fn2, x, *extra, op_name="bincount", differentiable=False)
+
+
+for _n in __all__:
+    registry.register(_n, globals()[_n], tags=("search",))

@@ -1,0 +1,535 @@
+"""Detection / vision ops from ops.yaml.
+
+Reference analog: the detection entries of
+/root/reference/paddle/phi/ops/yaml/ops.yaml (nms, roi_align, yolo_box,
+prior_box, box_coder, ...; CPU/CUDA kernels under paddle/phi/kernels/).
+TPU-native: everything is expressed as dense masked math with static
+shapes — greedy NMS as a fori_loop over a fixed box budget, ROI pooling as
+bilinear gathers — so XLA can compile it; no dynamic-shape LoD outputs
+(suppressed slots are marked, not removed).
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register
+
+__all__ = []
+
+
+def _reg(name, fn=None, differentiable=True, tags=("vision",)):
+    def deco(f):
+        f.__name__ = name
+        register(name, f, differentiable=differentiable, tags=tags)
+        globals()[name] = f        # keep `from ... import *` valid
+        __all__.append(name)
+        return f
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def _iou_matrix(boxes):
+    """[N,4] x1y1x2y2 -> [N,N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+@_reg("nms", differentiable=False)
+def _nms(x, threshold=1.0):
+    """Greedy NMS over score-DESCENDING pre-sorted boxes [N,4]; returns
+    kept indices left-packed, suppressed slots = -1 (static shape; the
+    reference returns a dynamic keep list)."""
+    boxes = jnp.asarray(x, jnp.float32)
+    n = boxes.shape[0]
+    iou = _iou_matrix(boxes)
+
+    def body(i, keep):
+        # kept iff no earlier KEPT box overlaps it above threshold
+        ok = ~jnp.any((iou[i] > threshold) & keep
+                      & (jnp.arange(n) < i))
+        return keep.at[i].set(ok)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.zeros((n,), bool))
+    idx = jnp.arange(n)
+    order = jnp.argsort(~keep, stable=True)
+    return jnp.where(jnp.take(keep, order), jnp.take(idx, order), -1)
+
+
+@_reg("matrix_nms", differentiable=False)
+def _matrix_nms(bboxes, scores, score_threshold=0.0, nms_top_k=-1,
+                keep_top_k=-1, post_threshold=0.0, use_gaussian=False,
+                gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (soft decay, reference matrix_nms): returns decayed
+    scores per [B, C, N] without hard suppression."""
+    b = jnp.asarray(bboxes, jnp.float32)   # [B, N, 4]
+    s = jnp.asarray(scores, jnp.float32)   # [B, C, N]
+
+    def per_class(boxes, sc):
+        order = jnp.argsort(-sc)
+        boxes_s = boxes[order]
+        sc_s = sc[order]
+        iou = _iou_matrix(boxes_s)
+        tri = jnp.tril(iou, k=-1)
+        max_iou = jnp.max(tri, axis=1)     # per box: max IoU w/ higher-score
+        if use_gaussian:
+            decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
+                            / gaussian_sigma)
+            decay = jnp.min(jnp.where(tri > 0, decay, 1.0), axis=1)
+        else:
+            comp = jnp.where(max_iou[None, :] > 0,
+                             (1 - tri) / jnp.maximum(1 - max_iou[None, :],
+                                                     1e-10), 1.0)
+            decay = jnp.min(jnp.where(tri > 0, comp, 1.0), axis=1)
+        dec = sc_s * decay
+        inv = jnp.argsort(order)
+        return dec[inv]
+
+    return jax.vmap(lambda bb, ss: jax.vmap(
+        lambda c: per_class(bb, c))(ss))(b, s)
+
+
+@_reg("box_clip")
+def _box_clip(input, im_info):
+    b = jnp.asarray(input)
+    info = jnp.asarray(im_info, b.dtype)       # [B, 3] h, w, scale
+    h = info[:, 0].reshape(-1, *([1] * (b.ndim - 1)))
+    w = info[:, 1].reshape(-1, *([1] * (b.ndim - 1)))
+    x = jnp.clip(b[..., 0::2], 0, w - 1)
+    y = jnp.clip(b[..., 1::2], 0, h - 1)
+    out = jnp.stack([x[..., 0], y[..., 0], x[..., 1], y[..., 1]], axis=-1)
+    return out
+
+
+@_reg("box_coder")
+def _box_coder(prior_box, prior_box_var, target_box,
+               code_type="encode_center_size", box_normalized=True,
+               axis=0, variance=()):
+    pb = jnp.asarray(prior_box, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if prior_box_var is not None:
+        var = jnp.asarray(prior_box_var, jnp.float32)
+    elif variance:
+        var = jnp.asarray(variance, jnp.float32)[None, :]
+    else:
+        var = jnp.ones((1, 4), jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1) / var[None, :, :]
+        return out
+    # decode_center_size: target [N, M, 4] deltas on priors
+    t = tb if tb.ndim == 3 else tb[:, None, :]
+    if axis == 1:
+        pcx, pcy, pw, ph = (a[None, :] for a in (pcx, pcy, pw, ph))
+        varb = var[None, :, :] if var.ndim == 2 else var
+    else:
+        pcx, pcy, pw, ph = (a[:, None] for a in (pcx, pcy, pw, ph))
+        varb = var[:, None, :] if var.ndim == 2 else var
+    d = t * varb
+    cx = d[..., 0] * pw + pcx
+    cy = d[..., 1] * ph + pcy
+    w = jnp.exp(d[..., 2]) * pw
+    h = jnp.exp(d[..., 3]) * ph
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=-1)
+
+
+@_reg("prior_box", differentiable=False)
+def _prior_box(input, image, min_sizes, max_sizes=(), aspect_ratios=(),
+               variances=(), flip=True, clip=True, step_w=0.0, step_h=0.0,
+               offset=0.5, min_max_aspect_ratios_order=False):
+    feat_h, feat_w = jnp.shape(input)[2], jnp.shape(input)[3]
+    img_h, img_w = jnp.shape(image)[2], jnp.shape(image)[3]
+    feat_h, feat_w = int(feat_h), int(feat_w)
+    img_h, img_w = int(img_h), int(img_w)
+    sw = step_w or img_w / feat_w
+    sh = step_h or img_h / feat_h
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms in min_sizes:
+        ms = float(ms)
+        boxes.append((ms, ms))
+        if max_sizes:
+            mx = float(max_sizes[min_sizes.index(ms)
+                                 if ms in min_sizes else 0])
+            s = _pymath.sqrt(ms * mx)
+            boxes.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * _pymath.sqrt(ar), ms / _pymath.sqrt(ar)))
+    cx = (np.arange(feat_w) + offset) * sw
+    cy = (np.arange(feat_h) + offset) * sh
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((feat_h, feat_w, len(boxes), 4), np.float32)
+    for i, (bw, bh) in enumerate(boxes):
+        out[:, :, i, 0] = (cxg - bw / 2) / img_w
+        out[:, :, i, 1] = (cyg - bh / 2) / img_h
+        out[:, :, i, 2] = (cxg + bw / 2) / img_w
+        out[:, :, i, 3] = (cyg + bh / 2) / img_h
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.asarray(variances or [0.1, 0.1, 0.2, 0.2], np.float32)
+    vars_out = np.broadcast_to(var, out.shape).copy()
+    return jnp.asarray(out), jnp.asarray(vars_out)
+
+
+@_reg("yolo_box", differentiable=False)
+def _yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+              downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+              iou_aware=False, iou_aware_factor=0.5):
+    x = jnp.asarray(x, jnp.float32)
+    B, C, H, W = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(B, na, -1, H, W)
+    bx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2
+    by = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None]
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None]
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    cls = jax.nn.sigmoid(x[:, :, 5:5 + class_num])
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    img = jnp.asarray(img_size, jnp.float32)     # [B, 2] h, w
+    in_h = H * downsample_ratio
+    in_w = W * downsample_ratio
+    cx = (bx + gx) / W
+    cy = (by + gy) / H
+    pw = bw / in_w
+    ph = bh / in_h
+    ih = img[:, 0].reshape(B, 1, 1, 1)
+    iw = img[:, 1].reshape(B, 1, 1, 1)
+    x1 = (cx - pw / 2) * iw
+    y1 = (cy - ph / 2) * ih
+    x2 = (cx + pw / 2) * iw
+    y2 = (cy + ph / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, iw - 1)
+        y1 = jnp.clip(y1, 0, ih - 1)
+        x2 = jnp.clip(x2, 0, iw - 1)
+        y2 = jnp.clip(y2, 0, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, -1, 4)
+    keep = (conf > conf_thresh).astype(jnp.float32)
+    scores = (conf[:, :, None] * cls * keep[:, :, None]) \
+        .transpose(0, 1, 3, 4, 2).reshape(B, -1, class_num)
+    return boxes, scores
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C, H, W]; y/x same shape -> [C, *y.shape]."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(y - y0, 0, 1)
+    wx = jnp.clip(x - x0, 0, 1)
+    y0i, y1i, x0i, x1i = (a.astype(jnp.int32) for a in (y0, y1, x0, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@_reg("roi_align")
+def _roi_align(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+               spatial_scale=1.0, sampling_ratio=-1, aligned=False):
+    """ROI Align (reference roi_align): x [B,C,H,W], boxes [R,4]; rois are
+    assigned to images by boxes_num (prefix counts)."""
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(boxes, jnp.float32)
+    B = x.shape[0]
+    R = rois.shape[0]
+    if boxes_num is not None:
+        bn = jnp.asarray(boxes_num)
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                             total_repeat_length=R)
+    else:
+        img_idx = jnp.zeros((R,), jnp.int32)
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one(roi, bi):
+        feat = x[bi]
+        x1 = roi[0] * spatial_scale - off
+        y1 = roi[1] * spatial_scale - off
+        x2 = roi[2] * spatial_scale - off
+        y2 = roi[3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-5)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-5)
+        bin_h = rh / pooled_height
+        bin_w = rw / pooled_width
+        py = jnp.arange(pooled_height, dtype=jnp.float32)
+        px = jnp.arange(pooled_width, dtype=jnp.float32)
+        sy = jnp.arange(sr, dtype=jnp.float32)
+        yy = y1 + (py[:, None] + (sy[None, :] + 0.5) / sr) * bin_h
+        xx = x1 + (px[:, None] + (sy[None, :] + 0.5) / sr) * bin_w
+        # sample grid [ph, sr, pw, sr]
+        ys = yy[:, :, None, None]
+        xs = xx[None, None, :, :]
+        ysb = jnp.broadcast_to(ys, (pooled_height, sr, pooled_width, sr))
+        xsb = jnp.broadcast_to(xs, (pooled_height, sr, pooled_width, sr))
+        vals = _bilinear_sample(feat, ysb, xsb)
+        return jnp.mean(vals, axis=(2, 4))
+
+    return jax.vmap(one)(rois, img_idx)
+
+
+@_reg("roi_pool", differentiable=False)
+def _roi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0):
+    """ROI max pooling via dense masking (static shapes)."""
+    x = jnp.asarray(x, jnp.float32)
+    rois = jnp.asarray(boxes, jnp.float32)
+    B, C, H, W = x.shape
+    R = rois.shape[0]
+    if boxes_num is not None:
+        bn = jnp.asarray(boxes_num)
+        img_idx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                             total_repeat_length=R)
+    else:
+        img_idx = jnp.zeros((R,), jnp.int32)
+    ygrid = jnp.arange(H, dtype=jnp.float32)
+    xgrid = jnp.arange(W, dtype=jnp.float32)
+
+    def one(roi, bi):
+        feat = x[bi]
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bh, bw = rh / pooled_height, rw / pooled_width
+        out = jnp.zeros((C, pooled_height, pooled_width), x.dtype)
+        for ph in range(pooled_height):
+            for pw_ in range(pooled_width):
+                ys = y1 + ph * bh
+                ye = y1 + (ph + 1) * bh
+                xs = x1 + pw_ * bw
+                xe = x1 + (pw_ + 1) * bw
+                my = (ygrid >= jnp.floor(ys)) & (ygrid < jnp.ceil(ye))
+                mx = (xgrid >= jnp.floor(xs)) & (xgrid < jnp.ceil(xe))
+                mask = my[:, None] & mx[None, :]
+                v = jnp.max(jnp.where(mask[None], feat, -jnp.inf),
+                            axis=(1, 2))
+                out = out.at[:, ph, pw_].set(
+                    jnp.where(jnp.isfinite(v), v, 0.0))
+        return out
+
+    return jax.vmap(one)(rois, img_idx)
+
+
+@_reg("psroi_pool", differentiable=False)
+def _psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+                output_channels=1, spatial_scale=1.0):
+    """Position-sensitive ROI pooling: channel c of output bin (i,j) pools
+    input channel c*ph*pw + i*pw + j."""
+    pooled = _roi_pool(x, boxes, boxes_num,
+                       pooled_height, pooled_width, spatial_scale)
+    R = pooled.shape[0]
+    out = jnp.zeros((R, output_channels, pooled_height, pooled_width),
+                    pooled.dtype)
+    for i in range(pooled_height):
+        for j in range(pooled_width):
+            cidx = (jnp.arange(output_channels) * pooled_height
+                    * pooled_width + i * pooled_width + j)
+            out = out.at[:, :, i, j].set(pooled[:, cidx, i, j])
+    return out
+
+
+@_reg("bipartite_match", differentiable=False)
+def _bipartite_match(dist_mat, match_type="bipartite", dist_threshold=0.5):
+    """Greedy bipartite matching (reference bipartite_match): dist
+    [N, M] -> per-column matched row (-1 = unmatched) + distance."""
+    d = jnp.asarray(dist_mat, jnp.float32)
+    N, M = d.shape
+
+    def body(_, carry):
+        dm, row_used, match, md = carry
+        flat = jnp.argmax(dm)
+        i, j = flat // M, flat % M
+        best = dm[i, j]
+        ok = best > 0
+        match = jnp.where(ok, match.at[j].set(i), match)
+        md = jnp.where(ok, md.at[j].set(best), md)
+        dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
+        return dm, row_used, match, md
+
+    init = (d, jnp.zeros((N,), bool), jnp.full((M,), -1, jnp.int64),
+            jnp.zeros((M,), jnp.float32))
+    _, _, match, md = jax.lax.fori_loop(0, min(N, M), body, init)
+    if match_type == "per_prediction":
+        extra = (jnp.max(d, axis=0) >= dist_threshold) & (match < 0)
+        match = jnp.where(extra, jnp.argmax(d, axis=0), match)
+        md = jnp.where(extra, jnp.max(d, axis=0), md)
+    return match, md
+
+
+@_reg("deformable_conv")
+def _deformable_conv(x, offset, filter, mask=None, strides=(1, 1),
+                     paddings=(0, 0), dilations=(1, 1),
+                     deformable_groups=1, groups=1, im2col_step=64):
+    """Deformable conv v1/v2 as bilinear-gather + matmul (reference
+    deformable_conv; CUDA im2col collapses into a gather)."""
+    x = jnp.asarray(x, jnp.float32)
+    off = jnp.asarray(offset, jnp.float32)
+    w = jnp.asarray(filter, jnp.float32)
+    B, C, H, W = x.shape
+    Co, Ci, kh, kw = w.shape
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    base_y = (jnp.arange(Ho) * sh)[:, None, None, None] \
+        + (jnp.arange(kh) * dh)[None, None, :, None]
+    base_x = (jnp.arange(Wo) * sw)[None, :, None, None] \
+        + (jnp.arange(kw) * dw)[None, None, None, :]
+    off = off.reshape(B, deformable_groups, kh * kw, 2, Ho, Wo)
+    oy = off[:, :, :, 0].reshape(B, deformable_groups, kh, kw, Ho, Wo)
+    ox = off[:, :, :, 1].reshape(B, deformable_groups, kh, kw, Ho, Wo)
+    # sample positions [B, g, kh, kw, Ho, Wo]
+    sy = base_y.transpose(2, 3, 0, 1)[None, None] + oy
+    sx = base_x.transpose(2, 3, 0, 1)[None, None] + ox
+
+    def per_img(feat, syy, sxx, mm):
+        # feat [C, H+2p, W+2p]; syy/sxx [g, kh, kw, Ho, Wo]
+        cg = C // deformable_groups
+        outs = []
+        for g in range(deformable_groups):
+            vals = _bilinear_sample(feat[g * cg:(g + 1) * cg],
+                                    syy[g], sxx[g])
+            if mm is not None:
+                vals = vals * mm[g][None]
+            outs.append(vals)
+        return jnp.concatenate(outs, axis=0)   # [C, kh, kw, Ho, Wo]
+
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32).reshape(
+            B, deformable_groups, kh, kw, Ho, Wo)
+        cols = jax.vmap(per_img)(xp, sy, sx, m)
+    else:
+        cols = jax.vmap(lambda f, yy, xx: per_img(f, yy, xx, None))(
+            xp, sy, sx)
+    cols = cols.reshape(B, C, kh, kw, Ho, Wo)
+    if groups == 1:
+        return jnp.einsum("bckhyx,ockh->boyx", cols, w)
+    # grouped conv: filter [Co, C/groups, kh, kw]; split channels
+    cg = C // groups
+    og = Co // groups
+    colsg = cols.reshape(B, groups, cg, kh, kw, Ho, Wo)
+    wg = w.reshape(groups, og, Ci, kh, kw)
+    out = jnp.einsum("bgckhyx,gockh->bgoyx", colsg, wg)
+    return out.reshape(B, Co, Ho, Wo)
+
+
+@_reg("correlation")
+def _correlation(input1, input2, pad_size=0, kernel_size=1,
+                 max_displacement=1, stride1=1, stride2=1,
+                 corr_type_multiply=1):
+    """FlowNet correlation: patch dot products of input1 against
+    displaced input2 patches (reference correlation op)."""
+    a = jnp.asarray(input1, jnp.float32)
+    b = jnp.asarray(input2, jnp.float32)
+    B, C, H, W = a.shape
+    p = max(pad_size, max_displacement)
+    ap = jnp.pad(a, ((0, 0), (0, 0), (p, p), (p, p)))
+    bp = jnp.pad(b, ((0, 0), (0, 0), (p, p), (p, p)))
+    d = max_displacement
+    k = kernel_size
+    kr = k // 2
+
+    def patch_mean(x):
+        """mean over the kernel window at every position (same-size)."""
+        if k == 1:
+            return x
+        xs = jnp.pad(x, ((0, 0), (0, 0), (kr, kr), (kr, kr)))
+        acc = 0.0
+        for oy in range(k):
+            for ox in range(k):
+                acc = acc + xs[:, :, oy:oy + x.shape[2],
+                               ox:ox + x.shape[3]]
+        return acc / (k * k)
+
+    outs = []
+    for dy in range(-d, d + 1, stride2):
+        for dx in range(-d, d + 1, stride2):
+            shifted = jnp.roll(bp, (-dy, -dx), axis=(2, 3))
+            prod = patch_mean(ap * shifted)
+            outs.append(jnp.mean(
+                prod[:, :, p:p + H:stride1, p:p + W:stride1], axis=1))
+    return jnp.stack(outs, axis=1)
+
+
+@_reg("multiclass_nms3", differentiable=False)
+def _multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                     nms_top_k=-1, keep_top_k=100, nms_threshold=0.3,
+                     normalized=True, nms_eta=1.0, background_label=-1):
+    """Per-class greedy NMS, dense output [B, keep_top_k, 6]
+    (class, score, x1, y1, x2, y2); empty slots class=-1 (static-shape
+    variant of the reference's LoD output)."""
+    b = jnp.asarray(bboxes, jnp.float32)   # [B, N, 4]
+    s = jnp.asarray(scores, jnp.float32)   # [B, C, N]
+    B, C, N = s.shape
+    K = keep_top_k if keep_top_k > 0 else N
+
+    def per_image(boxes, sc):
+        rows = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            order = jnp.argsort(-sc[c])
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            bs = boxes[order]
+            ss = sc[c][order]
+            keep_idx = _nms(bs, nms_threshold)
+            kept = keep_idx >= 0
+            sel = jnp.where(kept, keep_idx, 0)
+            ok = kept & (ss[sel] > score_threshold)
+            rows.append(jnp.stack(
+                [jnp.where(ok, float(c), -1.0), jnp.where(ok, ss[sel], 0.0),
+                 *(bs[sel][:, i] for i in range(4))], axis=1))
+        allr = jnp.concatenate(rows, axis=0)
+        order = jnp.argsort(-allr[:, 1] * (allr[:, 0] >= 0))
+        top = allr[order[:K]]
+        pad = jnp.zeros((max(K - top.shape[0], 0), 6), top.dtype) \
+            .at[:, 0].set(-1.0)
+        out = jnp.concatenate([top, pad], axis=0)[:K]
+        return out, jnp.sum((out[:, 0] >= 0).astype(jnp.int32))
+
+    outs, counts = jax.vmap(per_image)(b, s)
+    return outs, counts, counts

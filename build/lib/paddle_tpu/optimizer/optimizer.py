@@ -1,0 +1,585 @@
+"""Optimizers (reference: python/paddle/optimizer/).
+
+TPU-first execution model: each optimizer defines a pure per-parameter update
+rule `_update(p, g, state, lr) -> (p_new, state_new)`. The base class jits ONE
+fused update over the whole parameter pytree (donated buffers, lr as a traced
+scalar), so a step is a single XLA executable regardless of parameter count —
+the analog of the reference's fused/multi-tensor optimizer kernels
+(distributed_fused_lamb, multi_tensor_adam).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam",
+           "LBFGS", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def apply(self, grads_flat):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads_flat]
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads_flat):
+        out = []
+        for g in grads_flat:
+            if g is None:
+                out.append(None)
+                continue
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm:
+    """reference: python/paddle/nn/clip.py ClipGradByGlobalNorm."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads_flat):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads_flat if g is not None]
+        if not sq:
+            return grads_flat
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None
+                else (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads_flat]
+
+
+class Optimizer:
+    _hyperparams: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode)")
+        # accept parameter groups (list of dicts) like the reference; each
+        # group may override learning_rate (a multiplier, like ParamAttr's
+        # learning_rate) and weight_decay (absolute)
+        self._param_groups = []
+        if parameters and isinstance(parameters[0], dict):
+            for group in parameters:
+                self._param_groups.append(dict(group))
+        else:
+            self._param_groups.append({"params": list(parameters)})
+        self._parameter_list = [
+            p for g in self._param_groups for p in g["params"]
+        ]
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay if weight_decay is not None else 0.0
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[int, dict] = {}
+        self._step_count = 0
+        self._jit_step = None
+        self.helper = None
+        # per-parameter (lr multiplier, weight decay) resolved from groups
+        # and ParamAttr.optimize_attr
+        self._per_param: Dict[int, tuple] = {}
+        for group in self._param_groups:
+            g_lr_mult = float(group.get("learning_rate", 1.0))
+            g_wd = group.get("weight_decay", None)
+            if "grad_clip" in group:
+                import warnings
+
+                warnings.warn("per-group grad_clip is not supported; the "
+                              "optimizer-level grad_clip applies to all "
+                              "parameters")
+            for p in group["params"]:
+                attr_mult = 1.0
+                if getattr(p, "optimize_attr", None):
+                    attr_mult = float(
+                        p.optimize_attr.get("learning_rate", 1.0))
+                wd = float(g_wd) if g_wd is not None else None
+                self._per_param[id(p)] = (g_lr_mult * attr_mult, wd)
+
+    def _param_lr_wd(self, p, index):
+        """Resolve (lr multiplier, weight decay) for one parameter,
+        honoring groups and apply_decay_param_fun/exclude fns."""
+        lr_mult, wd = self._per_param.get(id(p), (1.0, None))
+        if wd is None:
+            wd = self._weight_decay
+        fn = getattr(self, "_apply_decay_param_fun", None)
+        if fn is not None:
+            pname = p.name or f"param_{index}"
+            if not fn(pname):
+                wd = 0.0
+        ex = getattr(self, "_exclude_fn", None)
+        if ex is not None and ex(p.name or f"param_{index}"):
+            wd = 0.0
+        return lr_mult, wd
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+        return self._learning_rate
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state -------------------------------------------------------------
+    def _init_state(self, p) -> dict:
+        return {}
+
+    def _get_state(self, p) -> dict:
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._init_state(p)
+        return self._accumulators[key]
+
+    def _update(self, p, g, state, lr, wd):
+        raise NotImplementedError
+
+    # -- the step ----------------------------------------------------------
+    @no_grad()
+    def step(self):
+        indexed = [(i, p) for i, p in enumerate(self._parameter_list)
+                   if p.trainable and not p.stop_gradient
+                   and p.grad is not None]
+        if not indexed:
+            return
+        params = [p for _, p in indexed]
+        grads = [p.grad._value for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(list(grads))
+        states = [self._get_state(p) for p in params]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        self._step_count += 1
+        step = jnp.asarray(self._step_count, jnp.float32)
+
+        lr_wds = tuple(self._param_lr_wd(p, i) for i, p in indexed)
+        if self._jit_step is None:
+            self._jit_step = {}
+        fused_jit = self._jit_step.get(lr_wds)
+        if fused_jit is None:
+            update = self._update
+
+            def fused(ps, gs, sts, lr_, step_):
+                new_ps, new_sts = [], []
+                for p, g, st, (lr_mult, wd) in zip(ps, gs, sts, lr_wds):
+                    st = dict(st)
+                    st["_step"] = step_
+                    np_, nst = update(p, g, st, lr_ * lr_mult, wd)
+                    nst.pop("_step", None)
+                    new_ps.append(np_)
+                    new_sts.append(nst)
+                return new_ps, new_sts
+
+            fused_jit = jax.jit(fused, donate_argnums=(0, 2))
+            self._jit_step[lr_wds] = fused_jit
+
+        p_arrays = [p._value for p in params]
+        new_p, new_states = fused_jit(
+            list(p_arrays), list(grads), list(states), lr, step)
+        for p, np_, nst in zip(params, new_p, new_states):
+            p._value = np_
+            self._accumulators[id(p)] = nst
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- serialization -----------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            st = self._accumulators.get(id(p))
+            if st:
+                pname = p.name or f"param_{i}"
+                for k, v in st.items():
+                    # copy: live state buffers are donated by the fused step
+                    out[f"{pname}.{k}"] = Tensor(jnp.array(v, copy=True))
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("_step_count", 0))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            pname = p.name or f"param_{i}"
+            st = {}
+            for k, v in state.items():
+                if isinstance(k, str) and k.startswith(pname + "."):
+                    arr = v._value if isinstance(v, Tensor) else \
+                        jnp.asarray(v)
+                    st[k[len(pname) + 1:]] = jnp.array(arr, copy=True)
+            if st:
+                self._accumulators[id(p)] = st
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        return (p - (lr * g).astype(p.dtype)), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - (lr * upd).astype(p.dtype), {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state(self, p):
+        st = {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+              "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros(p._value.shape, jnp.float32)
+        return st
+
+    def _decoupled(self):
+        return False
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = state["_step"]
+        if wd and not self._decoupled():
+            g = g + wd * pf
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        new_state = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            vmax = jnp.maximum(state["moment2_max"], vhat)
+            new_state["moment2_max"] = vmax
+            denom = jnp.sqrt(vmax) + self._eps
+        else:
+            denom = jnp.sqrt(vhat) + self._eps
+        upd = mhat / denom
+        if wd and self._decoupled():
+            upd = upd + wd * pf
+        return (pf - lr * upd).astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name, amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._value.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        t = state["_step"]
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        upd = lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_acc,
+                                   jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        acc = state["moment"] + g * g
+        upd = lr * g / (jnp.sqrt(acc) + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) \
+            / jnp.sqrt(asg + self._eps)
+        asu = self._rho * state["avg_squared_update"] \
+            + (1 - self._rho) * upd * upd
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros(p._value.shape, jnp.float32),
+              "velocity": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros(p._value.shape, jnp.float32)
+        return st
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            new_state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        v = self._momentum * state["velocity"] + lr * g / denom
+        new_state["velocity"] = v
+        return (p.astype(jnp.float32) - v).astype(p.dtype), new_state
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = state["_step"]
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * pf
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        r_norm = jnp.sqrt(jnp.sum(r * r))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class NAdam(Adam):
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = state["_step"]
+        if wd:
+            g = g + wd * pf
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = (self._beta1 * m / (1 - self._beta1 ** (t + 1))
+                + (1 - self._beta1) * g / (1 - self._beta1 ** t))
+        vhat = v / (1 - self._beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        return (pf - lr * upd).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class RAdam(Adam):
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = state["_step"]
+        if wd:
+            g = g + wd * pf
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * self._beta2 ** t / (1 - self._beta2 ** t)
+        def rect():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - self._beta2 ** t))
+            return r * mhat / (vhat + self._eps)
+        upd = jnp.where(rho_t > 5.0, rect(), mhat)
+        return (pf - lr * upd).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class LBFGS(Optimizer):
+    """Single-tensor L-BFGS with strong-Wolfe-free backtracking (reference:
+    python/paddle/optimizer/lbfgs.py, simplified line search)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self.max_iter = max_iter
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self._s_hist: List = []
+        self._y_hist: List = []
+        self._prev_flat_grad = None
+        self._prev_flat_param = None
+
+    def _gather(self):
+        ps = [p for p in self._parameter_list if p.grad is not None]
+        flat_g = jnp.concatenate([p.grad._value.reshape(-1).astype(
+            jnp.float32) for p in ps])
+        flat_p = jnp.concatenate([p._value.reshape(-1).astype(jnp.float32)
+                                  for p in ps])
+        return ps, flat_p, flat_g
+
+    def _scatter(self, ps, flat_p):
+        offset = 0
+        for p in ps:
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._value = flat_p[offset:offset + n].reshape(
+                p._value.shape).astype(p._value.dtype)
+            offset += n
+
+    @no_grad()
+    def step(self, closure=None):
+        ps, flat_p, flat_g = self._gather()
+        if not ps:
+            return
+        if self._prev_flat_grad is not None:
+            s = flat_p - self._prev_flat_param
+            y = flat_g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+        q = flat_g
+        alphas = []
+        for s, y in zip(reversed(self._s_hist), reversed(self._y_hist)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s_hist:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        direction = -q
+        lr = self.get_lr()
+        new_p = flat_p + lr * direction
+        self._prev_flat_param = flat_p
+        self._prev_flat_grad = flat_g
+        self._scatter(ps, new_p)
+        self._step_count += 1

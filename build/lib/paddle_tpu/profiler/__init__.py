@@ -1,0 +1,249 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:346 + the C++
+layered tracers in paddle/fluid/platform/profiler/).
+
+TPU-native mapping (SURVEY.md §5): device-side tracing is jax.profiler
+(XPlane -> TensorBoard/perfetto, the CUPTI analog); host spans are
+RecordEvent instrumentation aggregated into a summary table. Both run under
+one Profiler orchestrator with the reference's scheduler-state API."""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "export_protobuf",
+           "load_profiler_result", "SummaryView"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(enum.Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        cycle = closed + ready + record
+        if repeat and s >= cycle * repeat:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+class _HostEventCollector(threading.local):
+    def __init__(self):
+        self.events = []
+        self.active = False
+
+
+_collector = _HostEventCollector()
+
+
+class RecordEvent:
+    """Host instrumentation span (reference: platform/profiler RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self.begin = None
+
+    def __enter__(self):
+        self.begin = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def end(self):
+        if self.begin is not None and _collector.active:
+            _collector.events.append(
+                (self.name, self.begin, time.perf_counter()))
+            self.begin = None
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof._export_dir = dir_name
+        prof.export(os.path.join(
+            dir_name, (worker_name or "worker") + ".json"))
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+def load_profiler_result(filename: str):
+    import json
+
+    with open(filename) as f:
+        return json.load(f)
+
+
+class Profiler:
+    """Orchestrator with scheduler states. Device tracing = jax.profiler
+    (XPlane); host spans = RecordEvent collection."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=max(lo, 0), ready=0,
+                                            record=hi - lo, repeat=1)
+        else:
+            self.scheduler = scheduler or (
+                lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._jax_tracing = False
+        self._trace_dir = None
+        self._step_times = []
+        self._last_step_t = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _jax_start(self):
+        if not self._jax_tracing and not self.timer_only:
+            self._trace_dir = os.environ.get(
+                "PT_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+            try:
+                jax.profiler.start_trace(self._trace_dir)
+                self._jax_tracing = True
+            except Exception:
+                self._jax_tracing = False
+
+    def _jax_stop(self):
+        if self._jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    def start(self):
+        _collector.active = True
+        _collector.events = []
+        self.state = self.scheduler(self.step_num)
+        if self.state in (ProfilerState.RECORD,
+                          ProfilerState.RECORD_AND_RETURN):
+            self._jax_start()
+        self._last_step_t = time.perf_counter()
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self.step_num += 1
+        new_state = self.scheduler(self.step_num)
+        if new_state != self.state:
+            if new_state in (ProfilerState.RECORD,
+                             ProfilerState.RECORD_AND_RETURN):
+                self._jax_start()
+            elif self.state in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN):
+                self._jax_stop()
+                if self.on_trace_ready:
+                    self.on_trace_ready(self)
+            self.state = new_state
+
+    def stop(self):
+        self._jax_stop()
+        _collector.active = False
+        if self.on_trace_ready and self.state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self.on_trace_ready(self)
+
+    def export(self, path: str, format: str = "json"):
+        """Export host spans as chrome-trace; XPlane files live in the
+        jax.profiler trace dir."""
+        import json
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        events = []
+        for name, b, e in _collector.events:
+            events.append({
+                "name": name, "ph": "X", "pid": 0, "tid": 0,
+                "ts": b * 1e6, "dur": (e - b) * 1e6,
+            })
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "xplane_dir": self._trace_dir}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        agg = defaultdict(lambda: [0.0, 0])
+        for name, b, e in _collector.events:
+            agg[name][0] += (e - b) * 1e3
+            agg[name][1] += 1
+        lines = [f"{'Name':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>12}"]
+        for name, (total, calls) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][0]):
+            lines.append(
+                f"{name:<40} {calls:>8} {total:>12.3f} "
+                f"{total / max(calls, 1):>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    # throughput timer (reference: profiler/timer.py benchmark hooks)
+    def step_info(self, unit="samples"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        times = np.asarray([t for t, _ in self._step_times[-20:]])
+        ips = None
+        samples = [n for _, n in self._step_times[-20:] if n]
+        if samples:
+            ips = np.asarray(samples) / times[-len(samples):]
+        msg = f"avg step: {times.mean() * 1e3:.2f} ms"
+        if ips is not None:
+            msg += f", ips: {ips.mean():.1f} {unit}/s"
+        return msg

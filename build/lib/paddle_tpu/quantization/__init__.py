@@ -1,0 +1,300 @@
+"""Quantization (reference: python/paddle/quantization/ — PTQ observers,
+QAT fake-quant quanters, QuantConfig).
+
+TPU-relevant forms: int8 PTQ via absmax/histogram observers and QAT with
+straight-through fake-quant; fp8 via the native float8 dtypes."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["AbsmaxObserver", "HistObserver", "AbsMaxChannelWiseObserver",
+           "FakeQuanterWithAbsMax", "QuantConfig", "QAT", "PTQ",
+           "quanter", "QuantedLinear", "QuantedConv2D",
+           "ConvertedQuantLinear", "save_quantized_model"]
+
+
+class _BaseObserver:
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return self._scale
+
+    def quant_axis(self):
+        return -1
+
+    def bit_length(self):
+        return self.quant_bits
+
+
+class AbsmaxObserver(_BaseObserver):
+    """reference: quantization/observers/abs_max.py."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def observe(self, x):
+        arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+        self._max = max(self._max, float(np.abs(arr).max()))
+        self._scale = self._max / (2 ** (self.quant_bits - 1) - 1)
+        return x
+
+    __call__ = observe
+
+
+class AbsMaxChannelWiseObserver(_BaseObserver):
+    def __init__(self, quant_bits=8, quant_axis=0):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+        self._max = None
+
+    def observe(self, x):
+        arr = np.abs(x.numpy() if isinstance(x, Tensor) else np.asarray(x))
+        axes = tuple(i for i in range(arr.ndim) if i != self._axis)
+        cur = arr.max(axis=axes)
+        self._max = cur if self._max is None else np.maximum(self._max, cur)
+        self._scale = self._max / (2 ** (self.quant_bits - 1) - 1)
+        return x
+
+    __call__ = observe
+
+    def quant_axis(self):
+        return self._axis
+
+
+class HistObserver(_BaseObserver):
+    """Percentile-clipped histogram observer (reference hist.py)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins = bins_count
+        self.percent = percent
+        self._hist = None
+        self._max = 0.0
+
+    def observe(self, x):
+        arr = np.abs(x.numpy() if isinstance(x, Tensor) else np.asarray(x))
+        self._max = max(self._max, float(arr.max()))
+        hist, _ = np.histogram(arr, bins=self.bins, range=(0, self._max))
+        self._hist = hist if self._hist is None else self._hist + hist
+        cdf = np.cumsum(self._hist) / self._hist.sum()
+        cut = np.searchsorted(cdf, self.percent)
+        clip_val = (cut + 1) / self.bins * self._max
+        self._scale = clip_val / (2 ** (self.quant_bits - 1) - 1)
+        return x
+
+    __call__ = observe
+
+
+def _fake_quant(x, scale, bits):
+    qmax = 2 ** (bits - 1) - 1
+
+    def fn(a):
+        s = jnp.maximum(scale, 1e-9)
+        q = jnp.clip(jnp.round(a / s), -qmax, qmax)
+        deq = q * s
+        # straight-through estimator
+        return a + jax.lax.stop_gradient(deq - a)
+    return apply(fn, x, op_name="fake_quant")
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT fake-quant layer (reference quanters/abs_max.py) with running
+    absmax scale + STE gradients."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros(())))
+
+    def forward(self, x):
+        if self.training:
+            cur = float(jnp.abs(x._value).max()) / (
+                2 ** (self.quant_bits - 1) - 1)
+            prev = float(self.scale._value)
+            new = cur if prev == 0 else \
+                self.moving_rate * prev + (1 - self.moving_rate) * cur
+            self.scale._value = jnp.asarray(new)
+        return _fake_quant(x, float(self.scale._value), self.quant_bits)
+
+
+class QuantedLinear(nn.Layer):
+    def __init__(self, linear: nn.Linear, q_config=None):
+        super().__init__()
+        self.inner = linear
+        self.act_quanter = FakeQuanterWithAbsMax()
+        self.weight_quanter = FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        from ..nn import functional as F
+
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, conv, q_config=None):
+        super().__init__()
+        self.inner = conv
+        self.act_quanter = FakeQuanterWithAbsMax()
+        self.weight_quanter = FakeQuanterWithAbsMax()
+
+    def forward(self, x):
+        x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        from ..nn import functional as F
+
+        c = self.inner
+        return F.conv2d(x, w, c.bias, stride=c._stride,
+                        padding=c._padding, dilation=c._dilation,
+                        groups=c._groups)
+
+
+class ConvertedQuantLinear(nn.Layer):
+    """Deploy form after QAT/PTQ convert: int8 weight + per-channel scale,
+    dequantized into the matmul (the weight_only_linear kernel)."""
+
+    def __init__(self, linear: nn.Linear, act_scale=None):
+        super().__init__()
+        import numpy as np
+
+        w = np.asarray(linear.weight._value, np.float32)
+        scale = np.abs(w).max(axis=0) / 127.0
+        self.qweight = np.clip(
+            np.round(w / np.maximum(scale, 1e-12)[None, :]),
+            -127, 127).astype(np.int8)
+        self.register_buffer("weight_scale", __import__(
+            "paddle_tpu").to_tensor(scale.astype(np.float32)))
+        self.bias = linear.bias
+        self.act_scale = act_scale
+
+    def forward(self, x):
+        from ..ops.registry import get
+
+        out = get("weight_only_linear").fn(
+            x._value, self.qweight, None, self.weight_scale._value)
+        from ..core.tensor import Tensor
+
+        y = Tensor(out)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class QuantConfig:
+    """reference: quantization/config.py."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or (lambda: FakeQuanterWithAbsMax())
+        self.weight = weight or (lambda: FakeQuanterWithAbsMax())
+        self._types = {nn.Linear: QuantedLinear,
+                       nn.Conv2D: QuantedConv2D}
+
+    def add_layer_config(self, layers, activation=None, weight=None):
+        pass
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        pass
+
+
+def quanter(name):
+    def deco(cls):
+        return cls
+    return deco
+
+
+class QAT:
+    """Quantization-aware training driver (reference: quantization/qat.py)."""
+
+    def __init__(self, q_config: QuantConfig = None):
+        self.config = q_config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        def convert(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if type(sub) in self.config._types:
+                    layer._sub_layers[name] = self.config._types[type(sub)](
+                        sub, self.config)
+                else:
+                    convert(sub)
+        convert(model)
+        return model
+
+    def convert(self, model, inplace=False):
+        """Fold trained fake-quant observers into deployable int8 weights
+        (reference qat.py convert -> quantized inference program)."""
+        def fold(layer):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, QuantedLinear):
+                    act_scale = float(sub.act_quanter.scale._value)
+                    layer._sub_layers[name] = ConvertedQuantLinear(
+                        sub.inner, act_scale=act_scale)
+                elif isinstance(sub, QuantedConv2D):
+                    # conv deploy form keeps fake-quant folded weights
+                    import jax.numpy as jnp
+
+                    w = sub.weight_quanter(sub.inner.weight)
+                    sub.inner.weight._value = jnp.asarray(w._value)
+                    layer._sub_layers[name] = sub.inner
+                else:
+                    fold(sub)
+        fold(model)
+        return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference: quantization/ptq.py)."""
+
+    def __init__(self, q_config: QuantConfig = None):
+        self.config = q_config or QuantConfig()
+        self.observers = {}
+
+    def quantize(self, model, inplace=False):
+        for name, layer in model.named_sublayers():
+            if isinstance(layer, nn.Linear):
+                obs = AbsmaxObserver()
+                self.observers[name] = obs
+
+                def make_hook(o):
+                    def hook(lyr, inputs):
+                        o.observe(inputs[0])
+                    return hook
+                layer.register_forward_pre_hook(make_hook(obs))
+        return model
+
+    def convert(self, model, inplace=False):
+        """Apply observed scales: swap observed Linears to the int8 deploy
+        form (reference ptq.py convert)."""
+        name_to_obs = dict(self.observers)
+
+        def fold(layer, prefix=""):
+            for name, sub in list(layer._sub_layers.items()):
+                full = f"{prefix}.{name}" if prefix else name
+                if isinstance(sub, nn.Linear) and full in name_to_obs:
+                    obs = name_to_obs[full]
+                    scale = obs.scales()
+                    layer._sub_layers[name] = ConvertedQuantLinear(
+                        sub, act_scale=float(scale)
+                        if scale is not None else None)
+                else:
+                    fold(sub, full)
+        fold(model)
+        return model
+
+
+def save_quantized_model(model, path, input_spec, **configs):
+    """Export a converted (int8-weight) model through the serving path
+    (reference: QAT export via paddle.jit.save + quant passes)."""
+    from ..inference import save_inference_model
+
+    return save_inference_model(path, model, input_spec)

@@ -1,0 +1,104 @@
+"""paddle_tpu.signal (reference: python/paddle/signal.py) — stft/istft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import apply
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(frame_length)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        moved = jnp.moveaxis(a, axis, -1)
+        out = moved[..., idx]  # [..., num, frame_length]
+        # reference layout: frame_length before num_frames
+        out = jnp.swapaxes(out, -1, -2)
+        return jnp.moveaxis(out, (-2, -1), (axis - 1 if axis < 0 else axis,
+                                            axis if axis < 0 else axis + 1))
+    return apply(fn, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(a):
+        # a: [..., frame_length, num_frames] (reference layout)
+        fl = a.shape[-2]
+        num = a.shape[-1]
+        n = (num - 1) * hop_length + fl
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length + fl].add(
+                a[..., i])
+        return out
+    return apply(fn, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = window._value if isinstance(window, Tensor) else (
+        jnp.ones(win_length) if window is None else jnp.asarray(window))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def fn(a):
+        sig = a
+        if center:
+            pads = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pads, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(n_fft)[None, :]
+               + hop_length * jnp.arange(num)[:, None])
+        frames = sig[..., idx] * w  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(float(n_fft))
+        # [..., freq, num_frames]
+        return jnp.swapaxes(spec, -1, -2)
+    return apply(fn, x, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = window._value if isinstance(window, Tensor) else (
+        jnp.ones(win_length) if window is None else jnp.asarray(window))
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+
+    def fn(spec):
+        s = jnp.swapaxes(spec, -1, -2)  # [..., num, freq]
+        if normalized:
+            s = s * jnp.sqrt(float(n_fft))
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided else \
+            jnp.fft.ifft(s, axis=-1).real
+        frames = frames * w
+        num = frames.shape[-2]
+        n = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        norm = jnp.zeros(n, frames.dtype)
+        for i in range(num):
+            out = out.at[..., i * hop_length:i * hop_length + n_fft].add(
+                frames[..., i, :])
+            norm = norm.at[i * hop_length:i * hop_length + n_fft].add(
+                jnp.square(w))
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    return apply(fn, x, op_name="istft")

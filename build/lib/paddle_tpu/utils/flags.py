@@ -1,0 +1,89 @@
+"""Global flag registry.
+
+Reference analog: paddle/common/flags.cc (~1800 lines of
+PHI_DEFINE_EXPORTED_* gflags with FLAGS_* env override) surfaced as
+paddle.get_flags/set_flags (python/paddle/base/framework.py:109,134).
+Flags here follow the same contract: declared with a default + help string,
+overridable by FLAGS_<name> env vars at import, queryable/settable at
+runtime.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    value: Any
+    help: str
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+_lock = threading.Lock()
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, help: str = ""):
+    env = os.environ.get("FLAGS_" + name)
+    value = _coerce(default, env) if env is not None else default
+    with _lock:
+        _REGISTRY[name] = _Flag(name, default, value, help)
+    return value
+
+
+def flag(name: str):
+    f = _REGISTRY.get(name)
+    return f.value if f is not None else None
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return {name: f.value for name, f in _REGISTRY.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _REGISTRY:
+            raise ValueError(f"unknown flag {name}")
+        out[name] = _REGISTRY[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, value in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        with _lock:
+            if key not in _REGISTRY:
+                _REGISTRY[key] = _Flag(key, value, value, "")
+            else:
+                _REGISTRY[key].value = value
+
+
+# core flags (mirroring the reference's most-used ones)
+define_flag("check_nan_inf", False,
+            "check outputs of every op for NaN/Inf (debug)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; 3: report only")
+define_flag("use_pallas", True, "use Pallas kernels for fused ops on TPU")
+define_flag("benchmark", False, "sync after every op for timing")
+define_flag("eager_jit_threshold", 0, "reserved: per-op jit cache policy")
+define_flag("allocator_strategy", "xla",
+            "memory allocator (XLA BFC is authoritative on TPU)")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "accepted for compat; XLA preallocation controls TPU HBM")

@@ -1,0 +1,130 @@
+"""Loader/builder for the native pt_runtime library (csrc/pt_runtime.cpp).
+
+Compiles with g++ on first use into csrc/build/, loads via ctypes. All
+callers must tolerate `lib() is None` (pure-python fallback) so the
+framework runs on toolchain-less machines.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_ROOT, "csrc", "pt_runtime.cpp")
+_BUILD_DIR = os.path.join(_ROOT, "csrc", "build")
+_SO = os.path.join(_BUILD_DIR, "libpt_runtime.so")
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= \
+            os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+             "-o", _SO + ".tmp", "-lrt"],
+            check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except Exception:
+        return False
+
+
+def lib():
+    """The loaded CDLL or None."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        try:
+            l = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        l.pt_ring_open.restype = ctypes.c_void_p
+        l.pt_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_int]
+        l.pt_ring_write.restype = ctypes.c_int
+        l.pt_ring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, ctypes.c_int64]
+        l.pt_ring_read.restype = ctypes.c_int64
+        l.pt_ring_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_int64]
+        l.pt_ring_next_size.restype = ctypes.c_int64
+        l.pt_ring_next_size.argtypes = [ctypes.c_void_p]
+        l.pt_ring_mark_closed.argtypes = [ctypes.c_void_p]
+        l.pt_ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        l.pt_now_ns.restype = ctypes.c_uint64
+        _lib = l
+        return _lib
+
+
+class ShmRing:
+    """SPSC shared-memory ring of length-prefixed messages."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        l = lib()
+        if l is None:
+            raise RuntimeError("pt_runtime native library unavailable")
+        self._lib = l
+        self.name = name
+        self._h = l.pt_ring_open(name.encode(), capacity, 1 if create else 0)
+        if not self._h:
+            raise OSError(f"cannot open shm ring {name}")
+        self._creator = create
+
+    def write(self, data: bytes, timeout_ms: int = 60000):
+        rc = self._lib.pt_ring_write(self._h, data, len(data), timeout_ms)
+        if rc == -1:
+            raise TimeoutError("ring full")
+        if rc == -2:
+            raise BrokenPipeError("ring closed or message oversized")
+
+    def read(self, timeout_ms: int = 60000):
+        """Returns bytes, or None when the ring is closed and drained."""
+        size = self._lib.pt_ring_next_size(self._h)
+        cap = max(size, 1 << 20)
+        while True:
+            buf = ctypes.create_string_buffer(int(cap))
+            n = self._lib.pt_ring_read(self._h, buf, cap, timeout_ms)
+            if n == -3:
+                cap *= 4
+                continue
+            if n == -2:
+                return None
+            if n == -1:
+                raise TimeoutError("ring empty")
+            return buf.raw[:n]
+
+    def mark_closed(self):
+        self._lib.pt_ring_mark_closed(self._h)
+
+    def close(self, unlink: bool = None):
+        if self._h:
+            self._lib.pt_ring_close(
+                self._h, 1 if (self._creator if unlink is None else unlink)
+                else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close(unlink=False)
+        except Exception:
+            pass
+
+
+def available() -> bool:
+    return lib() is not None

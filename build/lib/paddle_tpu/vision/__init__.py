@@ -1,0 +1,13 @@
+"""paddle_tpu.vision (reference: python/paddle/vision/)."""
+from . import models
+from . import transforms
+from . import datasets
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+
+
+def set_image_backend(backend):
+    return None
+
+
+def get_image_backend():
+    return "numpy"

@@ -1,0 +1,74 @@
+"""Dataset stubs + synthetic datasets (reference: python/paddle/vision/datasets/).
+Real dataset downloads are environment-gated (zero egress); FakeData mirrors
+torchvision-style synthetic data for smoke training."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeImageDataset"]
+
+
+class FakeImageDataset(Dataset):
+    def __init__(self, num_samples=1024, image_shape=(1, 28, 28),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.rand(
+            num_samples, *self.image_shape).astype(np.float32)
+        self._labels = self._rng.randint(
+            0, num_classes, (num_samples, 1)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(FakeImageDataset):
+    """Offline env: synthesizes MNIST-shaped data; pass data_file to load a
+    local .npz with keys images/labels."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None,
+                 data_file=None):
+        if data_file is not None:
+            d = np.load(data_file)
+            n = len(d["labels"])
+            super().__init__(n, (1, 28, 28), 10, transform)
+            self._images = d["images"].astype(np.float32).reshape(
+                n, 1, 28, 28)
+            self._labels = d["labels"].astype(np.int64).reshape(n, 1)
+        else:
+            n = 60000 if mode == "train" else 10000
+            super().__init__(min(n, 4096), (1, 28, 28), 10, transform)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(FakeImageDataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        n = 2048 if mode == "train" else 512
+        super().__init__(n, (3, 32, 32), 10, transform)
+
+    def __getitem__(self, idx):
+        img, label = super().__getitem__(idx)
+        return img, int(label[0])
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        n = 2048 if mode == "train" else 512
+        FakeImageDataset.__init__(self, n, (3, 32, 32), 100, transform)

@@ -1,0 +1,63 @@
+"""MobileNetV1 (reference: python/paddle/vision/models/mobilenetv1.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSep(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.dw = _ConvBNRelu(in_c, in_c, 3, stride, 1, groups=in_c)
+        self.pw = _ConvBNRelu(in_c, out_c, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+               (1024, 2), (1024, 1)]
+        layers = [_ConvBNRelu(3, s(32), 3, 2, 1)]
+        in_c = s(32)
+        for out_c, stride in cfg:
+            layers.append(_DepthwiseSep(in_c, s(out_c), stride))
+            in_c = s(out_c)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

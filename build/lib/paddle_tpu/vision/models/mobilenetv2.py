@@ -1,0 +1,86 @@
+"""MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV2", "mobilenet_v2"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNReLU6(nn.Layer):
+    def __init__(self, in_c, out_c, k=3, stride=1, groups=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = nn.ReLU6()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU6(in_c, hidden, k=1))
+        layers += [
+            _ConvBNReLU6(hidden, hidden, stride=stride, groups=hidden),
+            nn.Conv2D(hidden, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        layers = [_ConvBNReLU6(3, in_c, stride=2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_ConvBNReLU6(in_c, last_c, k=1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,134 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops.manipulation import concat, reshape, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = {"relu": nn.ReLU(), "swish": nn.Swish(),
+                    None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(branch_c, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride, groups=branch_c,
+                           act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act))
+        else:
+            self.branch1 = nn.Sequential(
+                _ConvBNAct(in_c, in_c, 3, stride, groups=in_c, act=None),
+                _ConvBNAct(in_c, branch_c, 1, act=act))
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(in_c, branch_c, 1, act=act),
+                _ConvBNAct(branch_c, branch_c, 3, stride, groups=branch_c,
+                           act=None),
+                _ConvBNAct(branch_c, branch_c, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cs = _STAGE_OUT[scale]
+        self.conv1 = _ConvBNAct(3, cs[0], 3, stride=2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = cs[0]
+        for i, reps in enumerate([4, 8, 4]):
+            out_c = cs[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2, act)]
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(in_c, cs[4], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(cs[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape([x.shape[0], -1])
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

@@ -1,0 +1,35 @@
+"""Wheel build (reference analog: the reference's setup.py wrapping its
+CMake superbuild — here the native piece is one host-side C++ library,
+csrc/pt_runtime.cpp, compiled at install or lazily at first import by
+paddle_tpu.utils.native)."""
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNative(build_py):
+    """Best-effort pre-compile of the native host runtime so wheels ship
+    the .so; falls back to lazy build at import when g++ is absent."""
+
+    def run(self):
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "csrc", "pt_runtime.cpp")
+        if os.path.exists(src):
+            out = os.path.join(os.path.dirname(src), "libpt_runtime.so")
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                     src, "-o", out, "-lpthread"],
+                    check=True, capture_output=True)
+                print(f"built native runtime: {out}")
+            except (OSError, subprocess.CalledProcessError) as e:
+                print(f"native runtime build skipped ({e}); "
+                      "it will be built lazily at first import",
+                      file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNative})

@@ -1,0 +1,93 @@
+"""Dataset loaders parse the real file formats (reference:
+python/paddle/vision/datasets/ mnist.py idx parsing, cifar.py tar
+batches, folder.py DatasetFolder/ImageFolder)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import datasets as D
+
+
+def _write_idx(path, arr):
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", (0x08 << 8) | arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def test_mnist_idx_format(tmp_path):
+    imgs = np.random.RandomState(0).randint(0, 255, (10, 28, 28),
+                                            np.uint8)
+    labs = np.random.RandomState(1).randint(0, 10, (10,), np.uint8)
+    _write_idx(tmp_path / "im.gz", imgs)
+    _write_idx(tmp_path / "lb.gz", labs)
+    ds = D.MNIST(image_path=str(tmp_path / "im.gz"),
+                 label_path=str(tmp_path / "lb.gz"))
+    assert len(ds) == 10
+    x, y = ds[3]
+    assert x.shape == (1, 28, 28)
+    np.testing.assert_allclose(x[0], imgs[3] / 255.0, rtol=1e-6)
+    assert int(y[0]) == int(labs[3])
+
+
+def test_cifar_tar_format(tmp_path):
+    cdir = tmp_path / "cifar-10-batches-py"
+    os.makedirs(cdir)
+    rng = np.random.RandomState(2)
+    batch = {b"data": rng.randint(0, 255, (5, 3072), np.uint8),
+             b"labels": list(range(5))}
+    with open(cdir / "data_batch_1", "wb") as f:
+        pickle.dump(batch, f)
+    test_batch = {b"data": rng.randint(0, 255, (3, 3072), np.uint8),
+                  b"labels": [1, 2, 3]}
+    with open(cdir / "test_batch", "wb") as f:
+        pickle.dump(test_batch, f)
+    tar = tmp_path / "c10.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(cdir, arcname="cifar-10-batches-py")
+    train = D.Cifar10(data_file=str(tar), mode="train")
+    assert len(train) == 5
+    x, y = train[0]
+    assert np.shape(x) == (3, 32, 32) and y == 0
+    test = D.Cifar10(data_file=str(tar), mode="test")
+    assert len(test) == 3
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    from PIL import Image
+
+    for c in ("cat", "dog"):
+        os.makedirs(tmp_path / "imgs" / c)
+        Image.fromarray(np.full((8, 8, 3), 100, np.uint8)).save(
+            tmp_path / "imgs" / c / "a.png")
+    df = D.DatasetFolder(str(tmp_path / "imgs"))
+    assert df.classes == ["cat", "dog"]
+    x, t = df[0]
+    assert x.shape == (3, 8, 8) and t == 0
+    flat = D.ImageFolder(str(tmp_path / "imgs"))
+    assert len(flat) == 2
+    (img,) = flat[0]
+    assert img.shape == (3, 8, 8)
+
+
+def test_cifar100_real_data(tmp_path):
+    cdir = tmp_path / "cifar-100-python"
+    os.makedirs(cdir)
+    rng = np.random.RandomState(3)
+    batch = {b"data": rng.randint(0, 255, (4, 3072), np.uint8),
+             b"fine_labels": [10, 20, 30, 99]}
+    with open(cdir / "train", "wb") as f:
+        pickle.dump(batch, f)
+    tar = tmp_path / "c100.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(cdir, arcname="cifar-100-python")
+    ds = D.Cifar100(data_file=str(tar), mode="train")
+    assert len(ds) == 4
+    x, y = ds[3]
+    assert y == 99 and np.shape(x) == (3, 32, 32)
