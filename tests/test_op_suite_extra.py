@@ -1,0 +1,116 @@
+"""OpTest sweep over the yaml_extra / vision op surfaces: forward vs
+NumPy + numeric-vs-analytic gradients (reference
+test/legacy_test/op_test.py:418, check_grad :3026)."""
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401
+from op_harness import OpCase, run_case
+
+R = np.random.RandomState
+
+
+def _f(shape, seed=0, scale=1.0):
+    return (R(seed).randn(*shape) * scale).astype(np.float32)
+
+
+CASES = [
+    OpCase("cast", (_f((3, 4)),), {"dtype": "float32"},
+           ref=lambda x, dtype: x.astype(dtype)),
+    OpCase("fill", (_f((3, 4)), 2.5),
+           ref=lambda x, v: np.full_like(x, v), no_grad=True),
+    OpCase("trans_layout", (_f((2, 3, 4)),), {"perm": (2, 0, 1)},
+           ref=lambda x, perm: x.transpose(perm)),
+    OpCase("fill_diagonal", (_f((4, 4)),), {"value": 1.5},
+           no_grad=True),
+    OpCase("diag_embed", (_f((3,)),),
+           ref=lambda x: np.diag(x)),
+    OpCase("view_shape", (_f((3, 4)),), {"dims": (4, 3)},
+           ref=lambda x, dims: x.reshape(dims)),
+    OpCase("reverse", (_f((3, 4)),), {"axis": 1},
+           ref=lambda x, axis: np.flip(x, axis)),
+    OpCase("mean_all", (_f((3, 4)),), ref=lambda x: x.mean()),
+    OpCase("split_with_num", (_f((4, 6)),), {"num": 2, "axis": 1},
+           ref=lambda x, num, axis: tuple(np.split(x, num, axis))),
+    OpCase("inverse", (_f((3, 3)) + 3 * np.eye(3, dtype=np.float32),),
+           ref=lambda x: np.linalg.inv(x), grad_rtol=5e-2,
+           bf16=False),   # lapack getrf has no bf16 kernel
+    OpCase("l1_norm", (_f((3, 4)),), ref=lambda x: np.abs(x).sum(),
+           no_grad=True),   # |x| non-smooth
+    OpCase("squared_l2_norm", (_f((3, 4)),),
+           ref=lambda x: (x ** 2).sum()),
+    OpCase("frobenius_norm", (_f((3, 4)),),
+           ref=lambda x: np.linalg.norm(x)),
+    OpCase("p_norm", (_f((3, 4)),), {"porder": 2.0, "axis": -1},
+           ref=lambda x, porder, axis: np.linalg.norm(x, axis=-1)),
+    OpCase("clip_by_norm", (_f((3, 4), scale=5.0),), {"max_norm": 1.0}),
+    OpCase("renorm", (_f((3, 4), scale=5.0),),
+           {"p": 2.0, "axis": 0, "max_norm": 1.0}),
+    OpCase("gammaln", (np.abs(_f((3, 4))) + 0.5,), bf16=False),
+    OpCase("frame", (_f((64,)),),
+           {"frame_length": 16, "hop_length": 8}),
+    OpCase("overlap_add", (_f((16, 4)),), {"hop_length": 16}),
+    OpCase("segment_pool",
+           (_f((6, 3)), np.asarray([0, 0, 1, 1, 2, 2])),
+           {"pooltype": "SUM"}, grad_args=(0,)),
+    OpCase("send_u_recv",
+           (_f((4, 3)), np.asarray([0, 1, 2]), np.asarray([1, 2, 1])),
+           {"reduce_op": "SUM"}, grad_args=(0,)),
+    OpCase("send_uv",
+           (_f((4, 3)), _f((4, 3), 1), np.asarray([0, 1]),
+            np.asarray([2, 3])),
+           {"message_op": "ADD"}, grad_args=(0, 1)),
+    OpCase("apply_per_channel_scale", (_f((3, 4)), _f((4,), 1)),
+           ref=lambda x, s: x * s),
+    OpCase("weight_only_linear",
+           (_f((2, 8)),
+            np.clip(np.round(_f((8, 4), 1) * 20), -127, 127)
+            .astype(np.int8),
+            None, np.abs(_f((4,), 2)) * 0.05),
+           grad_args=(0,)),
+    OpCase("flash_attn",
+           (_f((2, 16, 2, 8), 1, 0.5), _f((2, 16, 2, 8), 2, 0.5),
+            _f((2, 16, 2, 8), 3, 0.5)),
+           {"causal": True}, grad_rtol=5e-2,
+           out_select=lambda o: o[0]),
+    OpCase("memory_efficient_attention",
+           (_f((2, 16, 2, 8), 1, 0.5), _f((2, 16, 2, 8), 2, 0.5),
+            _f((2, 16, 2, 8), 3, 0.5)),
+           {"causal": False}, grad_rtol=5e-2),
+    OpCase("moe",
+           (_f((2, 4, 8), 1, 0.5), _f((2, 4, 3), 2, 0.5),
+            _f((3, 8, 16), 3, 0.3), _f((3, 16, 8), 4, 0.3)),
+           grad_rtol=5e-2),
+    OpCase("roi_align",
+           (_f((1, 2, 8, 8), 1), np.asarray(
+               [[0.0, 0.0, 6.0, 6.0]], np.float32),
+            np.asarray([1])),
+           {"pooled_height": 2, "pooled_width": 2, "spatial_scale": 1.0},
+           grad_args=(0,), grad_rtol=5e-2),
+    OpCase("box_clip",
+           (np.abs(_f((1, 3, 4), 1)) * 50,
+            np.asarray([[40.0, 40.0, 1.0]], np.float32)),
+           no_grad=True),
+    OpCase("correlation",
+           (_f((1, 2, 6, 6), 1, 0.5), _f((1, 2, 6, 6), 2, 0.5)),
+           {"max_displacement": 1}, grad_rtol=5e-2),
+    OpCase("deformable_conv",
+           (_f((1, 2, 5, 5), 1, 0.5),
+            _f((1, 18, 3, 3), 2, 0.1),
+            _f((4, 2, 3, 3), 3, 0.5)),
+           {"paddings": (0, 0)}, grad_args=(0, 2), grad_rtol=8e-2),
+    OpCase("gru_unit",
+           (_f((2, 9), 1, 0.5), _f((2, 3), 2, 0.5),
+            _f((3, 9), 3, 0.5)),
+           grad_rtol=5e-2),
+    OpCase("lstm",
+           (_f((4, 2, 3), 1, 0.5), _f((2, 5), 2, 0.1),
+            _f((2, 5), 3, 0.1), _f((20, 3), 4, 0.3),
+            _f((20, 5), 5, 0.3), np.zeros(20, np.float32)),
+           grad_rtol=5e-2),
+]
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_extra_op(case):
+    run_case(case)
