@@ -36,6 +36,14 @@ class _Capture:
     active = None
 
 
+class _ProgramRecorder:
+    """When set, every apply() also appends an op entry to the active
+    static Program (paddle_tpu.static) — the ProgramDesc analog: a
+    replayable, inspectable op list."""
+
+    active = None
+
+
 class param_capture:
     def __enter__(self):
         self.prev = _Capture.active
@@ -109,7 +117,11 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
 
         if _flags.flag("check_nan_inf"):
             check_nan_inf(name, jax.tree.leaves(out))
-        return _wrap_outputs(out, node=None)
+        wrapped = _wrap_outputs(out, node=None)
+        if _ProgramRecorder.active is not None:
+            _ProgramRecorder.active._record(
+                name, fn, flat, tensor_pos, treedef, wrapped)
+        return wrapped
 
     diff_pos = [
         i
@@ -150,7 +162,11 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
     ]
     for i, t in enumerate(wrapped_flat):
         node.set_output(i, t)
-    return jax.tree.unflatten(out_treedef, wrapped_flat)
+    result = jax.tree.unflatten(out_treedef, wrapped_flat)
+    if _ProgramRecorder.active is not None:
+        _ProgramRecorder.active._record(
+            name, fn, flat, tensor_pos, treedef, result)
+    return result
 
 
 def _wrap_outputs(out, node):

@@ -24,6 +24,11 @@ from . import autograd
 from .dtype import convert_dtype, is_floating_point
 from .place import Place, place_of, to_jax_device, get_default_place
 
+# static Program capture flag — set by paddle_tpu.static.program_guard
+# (mirrors dispatch._ProgramRecorder.active; lives here so the hot _value
+# setter needs no cross-module import)
+_prog_recording = [None]
+
 
 def _to_array(data, dtype=None, place: Optional[Place] = None):
     if isinstance(data, Tensor):
@@ -56,7 +61,7 @@ def _to_array(data, dtype=None, place: Optional[Place] = None):
 
 class Tensor:
     __slots__ = (
-        "_value",
+        "_value_raw",
         "stop_gradient",
         "grad",
         "_grad_node",
@@ -66,6 +71,7 @@ class Tensor:
         "_hooks",
         "trainable",
         "_dist_attr",
+        "_prog_uid",
         "__weakref__",
     )
 
@@ -91,6 +97,32 @@ class Tensor:
         self.trainable = True
 
     # -- basic properties ---------------------------------------------------
+    @property
+    def _value(self):
+        return self._value_raw
+
+    @_value.setter
+    def _value(self, v):
+        # during static Program capture, rebinding a tensor's buffer is an
+        # in-place mutation: freeze the pre-mutation value for already-
+        # recorded consumers and drop the uid so later recorded ops see a
+        # fresh SSA value (read live at replay)
+        prog = _prog_recording[0]
+        if prog is not None and \
+                getattr(self, "_prog_uid", None) is not None:
+            import warnings
+
+            warnings.warn(
+                "in-place mutation of a captured tensor during static "
+                "Program recording: earlier ops keep the pre-mutation "
+                "value; later ops read the live value at run time",
+                RuntimeWarning, stacklevel=3)
+            freeze = getattr(prog, "_freeze_external", None)
+            if freeze is not None:
+                freeze(self)
+            self._prog_uid = None
+        self._value_raw = v
+
     @property
     def shape(self):
         return list(self._value.shape)

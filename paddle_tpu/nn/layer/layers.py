@@ -110,6 +110,9 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[str(name)] = tensor
+        # mark on the tensor too: mutable module state must never be
+        # constant-folded out of a recorded static Program
+        tensor.persistable = True
         if not persistable:
             self._non_persistable_buffer_names.add(str(name))
         return tensor

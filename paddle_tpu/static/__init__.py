@@ -16,26 +16,101 @@ from ..core.place import CPUPlace, Place, TPUPlace
 from ..core.tensor import Tensor
 from ..jit.api import InputSpec
 
-__all__ = ["InputSpec", "Program", "default_main_program",
+from . import passes
+from .passes import PassManager
+
+__all__ = ["InputSpec", "Program", "data", "default_main_program",
+           "passes", "PassManager",
            "default_startup_program", "program_guard", "Executor",
            "name_scope", "device_guard", "py_func", "nn", "gradients",
            "save", "load", "save_inference_model", "load_inference_model"]
 
 
 class Program:
-    """Compatibility shell. Captured computation lives in compiled
-    StaticFunctions; Program tracks feed/fetch structure only."""
+    """A recorded static program (reference ProgramDesc analog).
+
+    Under program_guard, every op flowing through the dispatcher is
+    appended to `ops` as (op_name, kernel_fn, flat_args, tensor
+    positions, treedef, input tensors, output tensors) — a replayable,
+    inspectable op list. Executor.run replays it under jax.jit with feed
+    values substituted for `data` placeholders; parameters are read live
+    from their Tensors at run time so state updates between runs are
+    seen. `__str__` prints the op list (the `print(program)` debugging
+    workflow of the reference)."""
+
+    _uid_counter = [0]
 
     def __init__(self):
-        self.feed_targets = {}
+        self.feed_targets = {}        # name -> placeholder Tensor
         self.fetch_targets = []
+        self.ops = []                 # recorded op entries
+        self._live = {}               # uid -> Tensor, EXTERNAL inputs only
+        #                               (params/constants, read fresh at
+        #                               run time); intermediates are keyed
+        #                               by uid and never pinned
+        self._produced = set()        # uids produced by recorded ops
         self._fn = None
+        self._compiled = {}
+
+    def _freeze_external(self, t):
+        """Called by Tensor._value's setter when a captured tensor is
+        mutated in place: pin a SNAPSHOT of the pre-mutation value for
+        consumers already recorded (the live-read contract must not feed
+        them the post-mutation buffer)."""
+        u = getattr(t, "_prog_uid", None)
+        if u is not None and self._live.get(u) is t:
+            snap = Tensor(t._value_raw)
+            snap._prog_uid = u
+            self._live[u] = snap
+
+    @classmethod
+    def _uid(cls, t):
+        u = getattr(t, "_prog_uid", None)
+        if u is None:
+            cls._uid_counter[0] += 1
+            u = cls._uid_counter[0]
+            t._prog_uid = u
+        return u
+
+    def _record(self, name, fn, flat, tensor_pos, treedef, out):
+        import jax
+
+        entry_flat = list(flat)
+        in_uids = []
+        for i in tensor_pos:
+            t = flat[i]
+            u = self._uid(t)
+            in_uids.append(u)
+            if u not in self._produced:
+                self._live.setdefault(u, t)   # external: param/constant
+            entry_flat[i] = None          # filled from env at replay
+        # positions of Tensor leaves within the FULL output leaf list —
+        # the same selection replay applies to the raw fn output
+        all_leaves = jax.tree_util.tree_leaves(out)
+        out_positions = [i for i, o in enumerate(all_leaves)
+                         if isinstance(o, Tensor)]
+        out_uids = []
+        for i in out_positions:
+            u = self._uid(all_leaves[i])
+            out_uids.append(u)
+            self._produced.add(u)
+        self.ops.append((name, fn, entry_flat, list(tensor_pos), in_uids,
+                         treedef, out_positions, out_uids))
+        self._compiled.clear()
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
         return self
+
+    def __str__(self):
+        lines = [f"Program({len(self.ops)} ops, "
+                 f"{len(self.feed_targets)} feeds)"]
+        for name, _, _, _, in_uids, _, _, out_uids in self.ops:
+            lines.append(f"  {name}({len(in_uids)} in) -> "
+                         f"{len(out_uids)} out")
+        return "\n".join(lines)
 
 
 _main_program = Program()
@@ -52,15 +127,43 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
+    """Ops executed inside the guard are ALSO recorded into
+    `main_program` (define-by-run capture of a define-and-run program)."""
+    from ..core import tensor as _tensor_mod
+    from ..core.dispatch import _ProgramRecorder
+
     global _main_program, _startup_program
     prev = (_main_program, _startup_program)
+    prev_rec = _ProgramRecorder.active
     _main_program = main_program
     if startup_program is not None:
         _startup_program = startup_program
+    _ProgramRecorder.active = main_program
+    _tensor_mod._prog_recording[0] = main_program
     try:
         yield
     finally:
         _main_program, _startup_program = prev
+        _ProgramRecorder.active = prev_rec
+        _tensor_mod._prog_recording[0] = prev_rec
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference paddle.static.data): a zero Tensor of
+    the declared spec, registered as a feed target of the active
+    program. None dims become 1 at capture time; the replay jit
+    respecializes kernels per fed shape — but Python-level shape reads
+    during capture (e.g. reshape([x.shape[0], -1])) bake the placeholder
+    dim as a literal. Use -1 in reshape specs (or feed the declared
+    shape) for dynamic dims."""
+    import numpy as np
+
+    concrete = tuple(1 if (d is None or d < 0) else int(d)
+                     for d in shape)
+    t = Tensor(np.zeros(concrete, dtype))
+    t.name = name
+    _main_program.feed_targets[name] = t
+    return t
 
 
 @contextlib.contextmanager
@@ -74,9 +177,10 @@ def device_guard(device=None):
 
 
 class Executor:
-    """reference: python/paddle/base/executor.py:1179. Runs compiled
-    callables; `program` may be a Program shell, a StaticFunction, or any
-    callable taking the feed dict."""
+    """reference: python/paddle/base/executor.py:1179 (run :1637 via the
+    StandaloneExecutor/PirInterpreter). Replays a recorded Program under
+    jax.jit — first run builds+compiles the replay (the reference's
+    build-instruction-list phase), steady state reuses the executable."""
 
     def __init__(self, place=None):
         self.place = place
@@ -84,6 +188,10 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
         feed = feed or {}
+        program = program if program is not None else _main_program
+        if isinstance(program, Program) and program.ops:
+            return self._replay(program, feed, fetch_list or [],
+                                return_numpy)
         target = program._fn if isinstance(program, Program) else program
         if target is None:
             return []
@@ -91,8 +199,80 @@ class Executor:
         out = target(*inputs)
         outs = out if isinstance(out, (list, tuple)) else [out]
         if return_numpy:
-            return [o.numpy() if isinstance(o, Tensor) else o for o in outs]
+            return [o.numpy() if isinstance(o, Tensor) else o
+                    for o in outs]
         return list(outs)
+
+    def _replay(self, program, feed, fetch_list, return_numpy):
+        import numpy as np
+
+        import jax
+
+        # remember fetch roots so passes (dead_op_elimination) have them
+        seen_fetch = {id(f) for f in program.fetch_targets}
+        for f in fetch_list:
+            if id(f) not in seen_fetch:
+                program.fetch_targets.append(f)
+
+        fetch_uids = [Program._uid(f) for f in fetch_list]
+        key = (tuple(fetch_uids),
+               tuple((n, np.shape(v),
+                      str(getattr(v, "dtype", np.asarray(v).dtype)))
+                     for n, v in sorted(feed.items())))
+        cached = program._compiled.get(key)
+        if cached is None:
+            # feeds actually consumed by recorded ops; unused declared
+            # feeds may be omitted (reference prunes them too)
+            used_uids = {u for (_, _, _, _, in_uids, _, _, _)
+                         in program.ops for u in in_uids}
+            feed_uid_of = {n: Program._uid(t)
+                           for n, t in program.feed_targets.items()}
+            feed_names = sorted(n for n in feed_uid_of
+                                if feed_uid_of[n] in used_uids
+                                or n in feed)
+            missing = [n for n in feed_names if n not in feed]
+            if missing:
+                raise KeyError(f"feed targets {missing} are consumed by "
+                               f"the program but absent from feed")
+            feed_uids_used = {feed_uid_of[n] for n in feed_names}
+            ext_uids = [u for u in program._live
+                        if u in used_uids and u not in feed_uids_used]
+            producible = set(feed_uids_used) | set(ext_uids)
+            for (_, _, _, _, _, _, _, out_uids) in program.ops:
+                producible.update(out_uids)
+            bad = [f for f, u in zip(fetch_list, fetch_uids)
+                   if u not in producible]
+            if bad:
+                raise ValueError(
+                    "fetch_list contains tensors the program neither "
+                    "produces nor feeds (fetched placeholder without a "
+                    f"feed, or value never recorded): {bad}")
+            feed_uid_list = [feed_uid_of[n] for n in feed_names]
+
+            def replay(feed_arrays, ext_arrays):
+                env = dict(zip(feed_uid_list, feed_arrays))
+                env.update(zip(ext_uids, ext_arrays))
+                for (name, fn, entry_flat, tpos, in_uids, treedef,
+                     out_positions, out_uids) in program.ops:
+                    flat2 = list(entry_flat)
+                    for i, u in zip(tpos, in_uids):
+                        flat2[i] = env[u]
+                    a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+                    out = fn(*a2, **k2)
+                    leaves = jax.tree_util.tree_leaves(out)
+                    for pos, u in zip(out_positions, out_uids):
+                        env[u] = leaves[pos]
+                return [env[u] for u in fetch_uids]
+
+            cached = (jax.jit(replay), feed_names, ext_uids)
+            program._compiled[key] = cached
+        compiled, feed_names, ext_uids = cached
+        feed_arrays = [np.asarray(feed[n]) for n in feed_names]
+        ext_arrays = [program._live[u]._value for u in ext_uids]
+        outs = compiled(feed_arrays, ext_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
 
     def close(self):
         pass

@@ -1,0 +1,129 @@
+"""Program transform passes.
+
+Reference analog: the PIR pass infrastructure
+(/root/reference/paddle/pir/include/pass/, transform sets under
+paddle/fluid/pir/transforms/ and the DRR rewrite engine). Here a pass is a
+function Program -> mutated Program over the recorded op list; PassManager
+mirrors pir::PassManager's run-in-order contract. Kernel-level fusion is
+XLA's job (the replay is jit-compiled whole), so the passes that matter at
+this level are graph hygiene: dead-op elimination and constant folding.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+__all__ = ["PassManager", "register_pass", "get_pass",
+           "dead_op_elimination", "constant_folding"]
+
+_PASSES: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+    return deco
+
+
+def get_pass(name: str) -> Callable:
+    if name not in _PASSES:
+        raise KeyError(f"unknown pass {name!r}; have {sorted(_PASSES)}")
+    return _PASSES[name]
+
+
+class PassManager:
+    """pir::PassManager analog: holds an ordered pass list, runs them over
+    a Program."""
+
+    def __init__(self, passes: List = ()):
+        self.passes = [get_pass(p) if isinstance(p, str) else p
+                       for p in passes]
+
+    def add_pass(self, p):
+        self.passes.append(get_pass(p) if isinstance(p, str) else p)
+        return self
+
+    def run(self, program):
+        for p in self.passes:
+            p(program)
+        return program
+
+
+@register_pass("dead_op_elimination")
+def dead_op_elimination(program, fetch_list=None):
+    """Drop ops whose outputs are never consumed by later ops or fetched
+    (reference dead_code_elimination_pass). Fetch roots come from
+    `fetch_list` or program.fetch_targets (populated by Executor.run);
+    with no roots at all the pass is a no-op — deleting the whole program
+    is never what anyone meant."""
+    fetches = fetch_list if fetch_list is not None else \
+        program.fetch_targets
+    if not fetches:
+        import warnings
+
+        warnings.warn("dead_op_elimination: no fetch targets known yet "
+                      "(run the program once, or pass fetch_list); "
+                      "skipping", RuntimeWarning)
+        return program
+    needed = {type(program)._uid(f) for f in fetches}
+    kept = []
+    for entry in reversed(program.ops):
+        (_, _, _, _, in_uids, _, _, out_uids) = entry
+        if any(u in needed for u in out_uids):
+            needed.update(in_uids)
+            kept.append(entry)
+    removed = len(program.ops) - len(kept)
+    program.ops = list(reversed(kept))
+    if removed:
+        program._compiled.clear()
+    return program
+
+
+@register_pass("constant_folding")
+def constant_folding(program):
+    """Evaluate ops whose every tensor input is a non-feed external
+    constant, baking the results (reference constant_folding_pass). Feeds
+    and parameters stay symbolic (parameters are read live per run, so
+    folding them would freeze training state)."""
+    import jax
+
+    from ..core.tensor import Parameter
+
+    feed_uids = {type(program)._uid(t)
+                 for t in program.feed_targets.values()}
+    # constants: external inputs that are NOT feeds, NOT Parameters and
+    # NOT persistable module state (buffers are mutated between runs and
+    # must stay live-read)
+    const = {}
+    for u, t in program._live.items():
+        if u not in feed_uids and not isinstance(t, Parameter) and \
+                not getattr(t, "persistable", False) and \
+                getattr(t, "stop_gradient", True):
+            const[u] = t._value
+    produced_const = dict(const)
+    kept = []
+    for entry in program.ops:
+        (name, fn, entry_flat, tpos, in_uids, treedef, out_positions,
+         out_uids) = entry
+        if in_uids and all(u in produced_const for u in in_uids):
+            flat2 = list(entry_flat)
+            for i, u in zip(tpos, in_uids):
+                flat2[i] = produced_const[u]
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            leaves = jax.tree_util.tree_leaves(out)
+            for pos, u in zip(out_positions, out_uids):
+                produced_const[u] = leaves[pos]
+        else:
+            kept.append(entry)
+    folded = {u: v for u, v in produced_const.items() if u not in const}
+    if folded:
+        from ..core.tensor import Tensor
+
+        for u, v in folded.items():
+            t = Tensor(v)
+            t._prog_uid = u
+            program._live[u] = t
+        program.ops = kept
+        program._compiled.clear()
+    return program

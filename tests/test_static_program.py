@@ -1,0 +1,164 @@
+"""Static Program record/replay (reference: paddle.static Program +
+Executor over the PirInterpreter — base/executor.py:1637)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+def _build():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        lin = nn.Linear(8, 4)
+        y = (lin(x)).tanh() * 2.0
+    return main, lin, y
+
+
+def test_program_records_and_replays():
+    main, lin, y = _build()
+    assert len(main.ops) >= 3
+    assert "Program(" in str(main)
+    exe = static.Executor()
+    feed = np.random.RandomState(0).randn(2, 8).astype("float32")
+    (out,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    want = np.tanh(feed @ np.asarray(lin.weight.numpy())
+                   + np.asarray(lin.bias.numpy())) * 2
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_replay_sees_updated_params():
+    main, lin, y = _build()
+    exe = static.Executor()
+    feed = np.random.RandomState(1).randn(2, 8).astype("float32")
+    exe.run(main, feed={"x": feed}, fetch_list=[y])
+    lin.weight.set_value(np.zeros((8, 4), np.float32))
+    (out,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    want = np.tanh(np.zeros((2, 4)) + np.asarray(lin.bias.numpy())) * 2
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_replay_respecializes_on_batch_size():
+    main, lin, y = _build()
+    exe = static.Executor()
+    for bs in (1, 3, 7):
+        feed = np.random.RandomState(bs).randn(bs, 8).astype("float32")
+        (out,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        assert out.shape == (bs, 4)
+
+
+def test_recording_does_not_leak_outside_guard():
+    from paddle_tpu.core.dispatch import _ProgramRecorder
+
+    main = static.Program()
+    with static.program_guard(main):
+        t = paddle.to_tensor(np.ones((2, 2), "float32"))
+        _ = t + t
+    n = len(main.ops)
+    assert _ProgramRecorder.active is None
+    t2 = paddle.to_tensor(np.ones((2, 2), "float32"))
+    _ = t2 * t2
+    assert len(main.ops) == n            # nothing recorded outside
+
+
+def test_different_fetch_lists_same_feed():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x * 3.0
+        z = x + 1.0
+    exe = static.Executor()
+    ones = np.ones((2, 2), np.float32)
+    (oy,) = exe.run(main, feed={"x": ones}, fetch_list=[y])
+    (oz,) = exe.run(main, feed={"x": ones}, fetch_list=[z])
+    np.testing.assert_allclose(oy, 3.0)
+    np.testing.assert_allclose(oz, 2.0)     # not y's cached value
+
+
+def test_unused_feed_may_be_omitted():
+    main = static.Program()
+    with static.program_guard(main):
+        a = static.data("a", [2], "float32")
+        b = static.data("b", [2], "float32")   # declared, never consumed
+        w = a * 2.0
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"a": np.ones(2, np.float32)},
+                     fetch_list=[w])
+    np.testing.assert_allclose(out, 2.0)
+    with pytest.raises(KeyError):
+        exe.run(main, feed={"b": np.ones(2, np.float32)},
+                fetch_list=[w])   # the consumed feed is genuinely missing
+
+
+def test_pass_manager_dce_and_constant_folding():
+    from paddle_tpu.static.passes import PassManager, dead_op_elimination
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        c = paddle.to_tensor(np.asarray([2.0, 2.0], np.float32))
+        folded = (c * 3.0) + 1.0          # constant subgraph
+        y = x * folded
+        _dead = x - 5.0                   # never fetched
+    n0 = len(main.ops)
+    dead_op_elimination(main, fetch_list=[y])
+    assert len(main.ops) < n0
+    PassManager(["constant_folding"]).run(main)
+    # the constant chain is baked: only the x-consuming op remains
+    assert len(main.ops) == 1, str(main)
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                     fetch_list=[y])
+    np.testing.assert_allclose(out, 7.0)
+
+
+def test_inplace_mutation_during_capture_warns_and_reads_live():
+    import warnings
+
+    main = static.Program()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            c = paddle.to_tensor(np.asarray([1.0, 1.0], np.float32))
+            y = x + c
+            c.set_value(np.asarray([5.0, 5.0], np.float32))  # in-place
+            z = x * c
+        assert any("in-place" in str(wi.message).lower() for wi in w)
+    exe = static.Executor()
+    feed = np.ones(2, np.float32)
+    oy, oz = exe.run(main, feed={"x": feed}, fetch_list=[y, z])
+    np.testing.assert_allclose(oy, 2.0)   # pre-mutation value captured
+    np.testing.assert_allclose(oz, 5.0)   # post-mutation read live
+
+
+def test_fetch_of_unproduced_tensor_raises_clearly():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    stray = paddle.to_tensor(np.zeros(2, np.float32))
+    exe = static.Executor()
+    with pytest.raises(ValueError, match="fetch_list"):
+        exe.run(main, feed={"x": np.ones(2, np.float32)},
+                fetch_list=[stray])
+
+
+def test_dce_noop_without_fetch_roots():
+    import warnings
+
+    from paddle_tpu.static.passes import PassManager
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x * 2.0
+    n0 = len(main.ops)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        PassManager(["dead_op_elimination"]).run(main)
+        assert any("skipping" in str(wi.message) for wi in w)
+    assert len(main.ops) == n0            # not wiped
