@@ -108,7 +108,9 @@ class Tensor:
         # recorded consumers and drop the uid so later recorded ops see a
         # fresh SSA value (read live at replay)
         prog = _prog_recording[0]
-        if prog is not None and \
+        # Parameter rebinds are optimizer updates: the recorded program
+        # reads params LIVE each run by contract — no freeze, no warning
+        if prog is not None and not isinstance(self, Parameter) and \
                 getattr(self, "_prog_uid", None) is not None:
             import warnings
 

@@ -110,9 +110,12 @@ class Layer:
 
     def register_buffer(self, name, tensor, persistable=True):
         self._buffers[str(name)] = tensor
-        # mark on the tensor too: mutable module state must never be
-        # constant-folded out of a recorded static Program
-        tensor.persistable = True
+        # mark on the tensor too (None allowed, reference layers.py:1308):
+        # mutable module state must never be constant-folded out of a
+        # recorded static Program. state_dict filtering uses
+        # _non_persistable_buffer_names, not this attribute.
+        if tensor is not None:
+            tensor.persistable = True
         if not persistable:
             self._non_persistable_buffer_names.add(str(name))
         return tensor

@@ -81,9 +81,50 @@ class _SyntheticTextDataset(Dataset):
 
 class datasets:
     class Imdb(_SyntheticTextDataset):
+        """IMDB sentiment. With data_file pointing at the standard
+        aclImdb tar (reference imdb.py parses the same archive), the real
+        reviews are tokenized against a frequency-cutoff vocabulary;
+        otherwise synthetic."""
+
         def __init__(self, data_file=None, mode="train", cutoff=150,
                      download=False):
+            if data_file is not None:
+                self._load_real(data_file, mode, cutoff)
+                return
             super().__init__(num_samples=2000 if mode == "train" else 500)
+
+        def _load_real(self, data_file, mode, cutoff):
+            import re
+            import tarfile
+            from collections import Counter
+
+            # vocab over BOTH splits, freq strictly > cutoff, trailing
+            # <unk> mapping OOV — the reference imdb.py contract
+            any_split = re.compile(r"aclImdb/(train|test)/(pos|neg)/"
+                                   r".*\.txt$")
+            want = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+            texts, labels, freq = [], [], Counter()
+            with tarfile.open(data_file, "r:*") as tar:
+                for m in tar.getmembers():
+                    if not any_split.match(m.name):
+                        continue
+                    raw = tar.extractfile(m).read().decode(
+                        "utf-8", "ignore").lower()
+                    toks = re.findall(r"[a-z]+", raw)
+                    freq.update(toks)
+                    g = want.match(m.name)
+                    if g:
+                        texts.append(toks)
+                        labels.append(0 if g.group(1) == "pos" else 1)
+            vocab = {w: i for i, (w, c) in enumerate(
+                sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))
+                if c > cutoff}
+            vocab["<unk>"] = len(vocab)
+            unk = vocab["<unk>"]
+            self.word_idx = vocab
+            self.x = [np.asarray([vocab.get(w, unk) for w in t],
+                                 np.int64) for t in texts]
+            self.y = np.asarray(labels, np.int64)
 
     class Imikolov(_SyntheticTextDataset):
         def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
@@ -99,7 +140,23 @@ class datasets:
             super().__init__()
 
     class UCIHousing(Dataset):
+        """Boston-housing regression. data_file = the standard
+        whitespace-separated housing.data (reference uci_housing.py
+        parses, normalizes per feature, 80/20 split)."""
+
         def __init__(self, data_file=None, mode="train", download=False):
+            if data_file is not None:
+                raw = np.loadtxt(data_file).astype(np.float32)
+                feat, target = raw[:, :-1], raw[:, -1:]
+                mins, maxs, avgs = feat.min(0), feat.max(0), feat.mean(0)
+                # reference uci_housing.py: (x - avg) / (max - min)
+                feat = (feat - avgs) / np.maximum(maxs - mins, 1e-6)
+                split = int(len(raw) * 0.8)
+                if mode == "train":
+                    self.x, self.y = feat[:split], target[:split]
+                else:
+                    self.x, self.y = feat[split:], target[split:]
+                return
             rng = np.random.RandomState(0)
             n = 404 if mode == "train" else 102
             self.x = rng.rand(n, 13).astype(np.float32)

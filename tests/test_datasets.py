@@ -91,3 +91,40 @@ def test_cifar100_real_data(tmp_path):
     assert len(ds) == 4
     x, y = ds[3]
     assert y == 99 and np.shape(x) == (3, 32, 32)
+
+
+def test_imdb_real_archive(tmp_path):
+    from paddle_tpu.text import datasets as T
+
+    for cls in ("pos", "neg"):
+        os.makedirs(tmp_path / "aclImdb" / "train" / cls)
+        for i in range(2):
+            (tmp_path / "aclImdb" / "train" / cls / f"{i}.txt").write_text(
+                "great movie the the best" if cls == "pos"
+                else "bad movie the worst")
+    tar = tmp_path / "imdb.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(tmp_path / "aclImdb", arcname="aclImdb")
+    ds = T.Imdb(data_file=str(tar), mode="train", cutoff=2)
+    assert len(ds.y) == 4
+    assert "the" in ds.word_idx and "<unk>" in ds.word_idx
+    x0, y0 = ds[0]
+    assert x0.dtype == np.int64
+    # OOV words map to <unk>, none dropped: lengths == raw token counts
+    assert sorted(len(x) for x, _ in ds) == [4, 4, 5, 5]
+
+
+def test_uci_housing_real_file(tmp_path):
+    from paddle_tpu.text import datasets as T
+
+    data = np.random.RandomState(0).rand(50, 14)
+    np.savetxt(tmp_path / "housing.data", data)
+    tr = T.UCIHousing(data_file=str(tmp_path / "housing.data"),
+                      mode="train")
+    te = T.UCIHousing(data_file=str(tmp_path / "housing.data"),
+                      mode="test")
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,)
+    # reference normalization (x-avg)/(max-min) is roughly zero-centered
+    assert abs(float(np.concatenate([t[0] for t in tr]).mean())) < 0.2

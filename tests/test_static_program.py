@@ -162,3 +162,28 @@ def test_dce_noop_without_fetch_roots():
         PassManager(["dead_op_elimination"]).run(main)
         assert any("skipping" in str(wi.message) for wi in w)
     assert len(main.ops) == n0            # not wiped
+
+
+def test_param_updates_inside_guard_stay_live():
+    """Optimizer updates (Parameter rebinds) during capture must NOT
+    freeze the param — the replay reads params live (the static training
+    pattern: forward + step captured together)."""
+    main = static.Program()
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                               learning_rate=0.5)
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = lin(x)
+        loss = (y * y).mean()
+        loss.backward()
+        opt.step()              # rebinds lin.weight._value mid-capture
+        opt.clear_grad()
+    exe = static.Executor()
+    feed = np.ones((2, 2), np.float32)
+    (o1,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    lin.weight.set_value(np.zeros((2, 2), np.float32))
+    lin.bias.set_value(np.zeros((2,), np.float32))
+    (o2,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
+    np.testing.assert_allclose(o2, 0.0, atol=1e-7)   # live params seen
+    assert not np.allclose(o1, 0.0)
