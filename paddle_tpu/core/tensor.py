@@ -108,21 +108,31 @@ class Tensor:
         # recorded consumers and drop the uid so later recorded ops see a
         # fresh SSA value (read live at replay)
         prog = _prog_recording[0]
-        # Parameter rebinds are optimizer updates: the recorded program
-        # reads params LIVE each run by contract — no freeze, no warning
-        if prog is not None and not isinstance(self, Parameter) and \
+        if prog is not None and \
                 getattr(self, "_prog_uid", None) is not None:
             import warnings
 
-            warnings.warn(
-                "in-place mutation of a captured tensor during static "
-                "Program recording: earlier ops keep the pre-mutation "
-                "value; later ops read the live value at run time",
-                RuntimeWarning, stacklevel=3)
-            freeze = getattr(prog, "_freeze_external", None)
-            if freeze is not None:
-                freeze(self)
-            self._prog_uid = None
+            if isinstance(self, Parameter):
+                # optimizer update captured mid-program: params keep their
+                # LIVE binding (read fresh each run), but the computed
+                # update is NOT written back at replay — static-mode
+                # training belongs to jit.TrainStep / auto_parallel Engine
+                warnings.warn(
+                    "Parameter updated during static Program capture: "
+                    "replay reads the live parameter each run but does "
+                    "NOT apply captured optimizer updates — use "
+                    "jit.TrainStep or the auto-parallel Engine for "
+                    "training", RuntimeWarning, stacklevel=3)
+            else:
+                warnings.warn(
+                    "in-place mutation of a captured tensor during "
+                    "static Program recording: earlier ops keep the "
+                    "pre-mutation value; later ops read the live value "
+                    "at run time", RuntimeWarning, stacklevel=3)
+                freeze = getattr(prog, "_freeze_external", None)
+                if freeze is not None:
+                    freeze(self)
+                self._prog_uid = None
         self._value_raw = v
 
     @property

@@ -1,2 +1,3 @@
 from . import nn
 from . import distributed
+from . import asp, optimizer

@@ -1,0 +1,91 @@
+"""Automatic SParsity (reference: python/paddle/incubate/asp/ — 2:4
+structured sparsity: mask computation, model pruning, a masked optimizer
+decorator). On TPU there is no sparse-tensor-core fast path, but the
+capability — train a 2:4-sparse model whose masks survive optimizer
+steps — is hardware-independent; XLA folds the mask multiply into the
+matmul producers."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["calculate_density", "compute_mask_2d", "prune_model",
+           "decorate", "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x)
+    return float((arr != 0).sum() / max(arr.size, 1))
+
+
+def compute_mask_2d(weight, n=2, m=4):
+    """Best n-of-m mask along the input dim (reference
+    asp/utils.py get_mask_2d_best): keep the n largest-|w| entries in
+    every group of m."""
+    w = np.asarray(weight)
+    flat = np.abs(w).reshape(-1, m)
+    keep = np.argsort(-flat, axis=1)[:, :n]
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(w.shape).astype(w.dtype)
+
+
+def _prunable(name, p):
+    return p is not None and p.ndim == 2 and p.shape[0] % 4 == 0 and \
+        name not in _excluded and not p.stop_gradient
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best",
+                with_mask=True):
+    """Apply n:m masks to every prunable 2-D parameter (reference
+    asp/asp.py prune_model). Returns {param_name: mask}."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        w = np.asarray(p._value)
+        mask = compute_mask_2d(w.T, n, m).T   # groups along input dim
+        p._value = jnp.asarray(w * mask)
+        masks[name] = mask
+    return masks
+
+
+class _ASPOptimizer:
+    """Masked optimizer (reference asp decorate): re-applies the sparsity
+    masks after every step so pruned weights stay zero."""
+
+    def __init__(self, inner, model, masks):
+        self._inner = inner
+        self._masks = {id(p): masks[name]
+                       for name, p in model.named_parameters()
+                       if name in masks}
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._parameter_list:
+            m = self._masks.get(id(p))
+            if m is not None:
+                p._value = p._value * jnp.asarray(m, p._value.dtype)
+
+
+def decorate(optimizer, model=None, masks=None, n=2, m=4):
+    """Wrap `optimizer` so masks survive updates (reference asp.decorate).
+    When masks is None, prune_model(model) is run first."""
+    if model is None:
+        raise ValueError("asp.decorate requires the model")
+    if masks is None:
+        masks = prune_model(model, n, m)
+    return _ASPOptimizer(optimizer, model, masks)

@@ -1,0 +1,98 @@
+"""incubate optimizers (reference: python/paddle/incubate/optimizer/ —
+LookAhead, ModelAverage)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead wrapper (reference incubate/optimizer/lookahead.py):
+    every k fast steps, slow weights move alpha toward the fast weights
+    and the fast weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        # copies: the inner optimizer's compiled step donates param
+        # buffers, which would delete aliased snapshots
+        self._slow = {id(p): jnp.array(p._value, copy=True)
+                      for p in inner_optimizer._parameter_list}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in self.inner_optimizer._parameter_list:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = slow
+                # hand the param a COPY — the next inner step donates it
+                p._value = jnp.array(slow, copy=True)
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["_lookahead_slow"] = {str(i): np.asarray(s) for i, s in
+                                 enumerate(self._slow.values())}
+        sd["_lookahead_step"] = self._step_num
+        return sd
+
+
+class ModelAverage:
+    """Running average of parameters for evaluation (reference
+    incubate/optimizer/modelaverage.py): apply() swaps averaged weights
+    in, restore() swaps the training weights back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self.max_average_window = max_average_window
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._count = min(self._count + 1, self.max_average_window)
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = {id(p): jnp.array(p._value, copy=True)
+                        for p in self._params}
+        for p in self._params:
+            p._value = self._sum[id(p)] / self._count
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._value = self._backup[id(p)]
+        self._backup = None

@@ -105,12 +105,18 @@ class datasets:
             want = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
             texts, labels, freq = [], [], Counter()
             with tarfile.open(data_file, "r:*") as tar:
+                import string
+
+                punct = str.maketrans("", "", string.punctuation)
                 for m in tar.getmembers():
                     if not any_split.match(m.name):
                         continue
                     raw = tar.extractfile(m).read().decode(
-                        "utf-8", "ignore").lower()
-                    toks = re.findall(r"[a-z]+", raw)
+                        "utf-8", "ignore")
+                    # reference imdb.py: strip punctuation, lowercase,
+                    # whitespace split (digits/contractions keep joined)
+                    toks = raw.rstrip("\n\r").translate(punct) \
+                        .lower().split()
                     freq.update(toks)
                     g = want.match(m.name)
                     if g:

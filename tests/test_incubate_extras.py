@@ -1,0 +1,78 @@
+"""incubate.asp 2:4 sparsity + LookAhead/ModelAverage (reference:
+python/paddle/incubate/asp/, incubate/optimizer/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+from paddle_tpu.incubate.optimizer import LookAhead, ModelAverage
+
+
+def test_asp_prune_and_masked_training():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+    masks = asp.prune_model(model)
+    assert masks, "no prunable params found"
+    for name, p in model.named_parameters():
+        if name in masks:
+            assert abs(asp.calculate_density(p.numpy()) - 0.5) < 1e-6
+    opt = asp.decorate(
+        paddle.optimizer.SGD(parameters=model.parameters(),
+                             learning_rate=0.1), model, masks)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.zeros((4, 4), "float32"))
+    loss_fn = nn.MSELoss()
+    for _ in range(3):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # masks survived the updates
+    for name, p in model.named_parameters():
+        if name in masks:
+            assert abs(asp.calculate_density(p.numpy()) - 0.5) < 1e-6
+
+
+def test_mask_2d_structure():
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)
+    mask = asp.compute_mask_2d(w, 2, 4)
+    assert mask.shape == (4, 4)
+    np.testing.assert_array_equal(mask.reshape(-1, 4).sum(1), 2)
+
+
+def test_lookahead_converges_and_syncs():
+    paddle.seed(2)
+    model = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(parameters=model.parameters(),
+                                 learning_rate=0.2)
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    loss_fn = nn.MSELoss()
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(8, 4).astype("float32"))
+    y = paddle.to_tensor(np.zeros((8, 4), "float32"))
+    losses = []
+    for _ in range(8):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_model_average_apply_restore():
+    paddle.seed(4)
+    model = nn.Linear(2, 2)
+    ma = ModelAverage(parameters=list(model.parameters()))
+    w0 = np.asarray(model.weight.numpy()).copy()
+    ma.step()
+    model.weight.set_value(w0 + 1.0)
+    ma.step()
+    ma.apply()
+    np.testing.assert_allclose(np.asarray(model.weight.numpy()),
+                               w0 + 0.5, rtol=1e-6)
+    ma.restore()
+    np.testing.assert_allclose(np.asarray(model.weight.numpy()),
+                               w0 + 1.0, rtol=1e-6)

@@ -164,21 +164,27 @@ def test_dce_noop_without_fetch_roots():
     assert len(main.ops) == n0            # not wiped
 
 
-def test_param_updates_inside_guard_stay_live():
-    """Optimizer updates (Parameter rebinds) during capture must NOT
-    freeze the param — the replay reads params live (the static training
-    pattern: forward + step captured together)."""
+def test_param_updates_inside_guard_stay_live_and_warn():
+    """Parameter rebinds during capture keep the LIVE binding (replay
+    reads params fresh each run) and warn that captured optimizer
+    updates are not replayed — static-mode training belongs to
+    jit.TrainStep / the auto-parallel Engine."""
+    import warnings
+
     main = static.Program()
     lin = nn.Linear(2, 2)
     opt = paddle.optimizer.SGD(parameters=lin.parameters(),
                                learning_rate=0.5)
-    with static.program_guard(main):
-        x = static.data("x", [2, 2], "float32")
-        y = lin(x)
-        loss = (y * y).mean()
-        loss.backward()
-        opt.step()              # rebinds lin.weight._value mid-capture
-        opt.clear_grad()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            y = lin(x)
+            loss = (y * y).mean()
+            loss.backward()
+            opt.step()          # rebinds lin.weight._value mid-capture
+            opt.clear_grad()
+        assert any("TrainStep" in str(wi.message) for wi in w)
     exe = static.Executor()
     feed = np.ones((2, 2), np.float32)
     (o1,) = exe.run(main, feed={"x": feed}, fetch_list=[y])
