@@ -371,14 +371,19 @@ class TrainStep:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists state dict + structure note. On TPU the
-    deploy format is the orbax/safetensors-style state dict; recompilation
-    happens at load (XLA compiles per target chip anyway)."""
+    """paddle.jit.save — persists the state dict, and (with input_spec,
+    matching the reference's deploy contract) ALSO the serialized
+    multi-platform executable the inference Predictor loads in a fresh
+    process (reference: jit.save -> inference program + params)."""
     from ..framework.io import save as fsave
 
     state = layer.state_dict() if hasattr(layer, "state_dict") else {}
     fsave({"state_dict": state,
            "class": type(layer).__name__}, path + ".pdparams")
+    if input_spec is not None:
+        from ..inference import save_inference_model
+
+        save_inference_model(path, layer, input_spec)
 
 
 def load(path, **configs):

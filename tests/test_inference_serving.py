@@ -109,3 +109,17 @@ def test_bf16_precision_knob(tmp_path):
     (got,) = create_predictor(Config(prefix)).run([x])
     assert got.dtype == np.float32
     np.testing.assert_allclose(got, want, rtol=5e-2, atol=5e-2)
+
+
+def test_jit_save_with_spec_produces_deployable(tmp_path):
+    """jit.save(input_spec=...) emits the serving artifact too (reference
+    jit.save -> inference program contract)."""
+    model = SmallMLP()
+    model.eval()
+    prefix = str(tmp_path / "jitsaved")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([None, 8], "float32", "x")])
+    x = np.random.RandomState(5).randn(2, 8).astype(np.float32)
+    (got,) = create_predictor(Config(prefix)).run([x])
+    want = np.asarray(model(paddle.to_tensor(x)).numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
