@@ -144,5 +144,204 @@ class features:
                          db, self.dct, op_name="mfcc")
 
 
+def _read_wav(path, normalize=True):
+    """8/16/32-bit PCM WAV -> mono array + sample rate (stdlib wave; the
+    reference uses soundfile for the same job). 8-bit WAV PCM is
+    UNSIGNED (centered at 128) per the format. normalize=False returns
+    the raw integer samples."""
+    import wave
+
+    with wave.open(str(path), "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 1:
+        arr = np.frombuffer(raw, np.uint8).astype(np.int16) - 128
+        scale = 128.0
+    elif width == 2:
+        arr = np.frombuffer(raw, np.int16)
+        scale = float(np.iinfo(np.int16).max)
+    elif width == 4:
+        arr = np.frombuffer(raw, np.int32)
+        scale = float(np.iinfo(np.int32).max)
+    else:
+        raise ValueError(f"unsupported WAV sample width {width} bytes "
+                         "(8/16/32-bit PCM supported)")
+    if ch > 1:
+        arr = arr.reshape(-1, ch)     # [N, C]; callers mono-mix if wanted
+    if not normalize:
+        return arr, sr
+    return arr.astype(np.float32) / scale, sr
+
+
+class backends:
+    """paddle.audio.backends (reference backends/ wave_backend.py):
+    stdlib-wave load/save."""
+
+    @staticmethod
+    def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+             channels_first=True):
+        arr, sr = _read_wav(filepath, normalize=normalize)
+        arr = arr[frame_offset:]
+        if num_frames > 0:
+            arr = arr[:num_frames]
+        if arr.ndim == 1:
+            out = arr[None, :] if channels_first else arr[:, None]
+        else:
+            out = arr.T if channels_first else arr
+        return Tensor(out), sr
+
+    @staticmethod
+    def save(filepath, src, sample_rate, channels_first=True,
+             encoding="PCM_16"):
+        import wave
+
+        arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if not channels_first:
+            arr = arr.T                      # -> [C, N]
+        if encoding == "PCM_32":
+            dt, width = np.int32, 4
+        elif encoding == "PCM_16":
+            dt, width = np.int16, 2
+        else:
+            raise ValueError(f"unsupported encoding {encoding!r} "
+                             "(PCM_16/PCM_32)")
+        pcm = (np.clip(arr, -1, 1) * np.iinfo(dt).max).astype(dt)
+        with wave.open(str(filepath), "wb") as w:
+            w.setnchannels(pcm.shape[0])
+            w.setsampwidth(width)
+            w.setframerate(int(sample_rate))
+            w.writeframes(pcm.T.reshape(-1).tobytes())  # interleave
+
+    @staticmethod
+    def list_available_backends():
+        return ["wave"]
+
+    get_current_backend = staticmethod(lambda: "wave")
+    set_backend = staticmethod(lambda name: None)
+
+
+class _AudioClassificationDataset:
+    """Base (reference datasets/dataset.py): wav files + labels, optional
+    feature transform (raw | spectrogram | mfcc names accepted)."""
+
+    sample_rate = 16000
+
+    def __init__(self, files, labels, feat_type="raw", **feat_kwargs):
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+
+    def __len__(self):
+        return len(self.files)
+
+    def _feature(self, wav):
+        if self.feat_type == "raw":
+            return wav.astype(np.float32)
+        x = Tensor(wav[None, :].astype(np.float32))
+        kw = dict(self.feat_kwargs)
+        kw.setdefault("sr", self.sample_rate)
+        if self.feat_type == "spectrogram":
+            kw.pop("sr", None)               # Spectrogram takes no sr
+            out = features.Spectrogram(**kw)(x)
+        elif self.feat_type in ("melspectrogram", "mel_spectrogram"):
+            out = features.MelSpectrogram(**kw)(x)
+        elif self.feat_type == "mfcc":
+            out = features.MFCC(**kw)(x)
+        else:
+            raise ValueError(f"unknown feat_type {self.feat_type!r}")
+        return np.asarray(out.numpy())[0]
+
+    def __getitem__(self, idx):
+        entry = self.files[idx]
+        if isinstance(entry, str):
+            wav, _ = _read_wav(entry)
+            if wav.ndim == 2:
+                wav = wav.mean(axis=1)    # mono-mix for classification
+        else:
+            wav = entry
+        return self._feature(wav), np.int64(self.labels[idx])
+
+
 class datasets:
-    """Offline env: no downloadable audio datasets in-tree."""
+    """paddle.audio.datasets (reference esc50.py / tess.py). Zero-egress:
+    point data_dir at a local copy of the standard layout; synthetic
+    audio otherwise."""
+
+    class ESC50(_AudioClassificationDataset):
+        """ESC-50 layout: <dir>/meta/esc50.csv + <dir>/audio/*.wav with
+        5-fold split columns (reference esc50.py)."""
+
+        sample_rate = 44100
+
+        def __init__(self, mode="train", split=1, feat_type="raw",
+                     data_dir=None, **kw):
+            import csv
+            import os
+
+            if data_dir is None:
+                import zlib
+
+                rng = np.random.RandomState(
+                    zlib.crc32(f"esc50/{mode}/{split}".encode()))
+                files = [rng.randn(4410).astype(np.float32) * 0.1
+                         for _ in range(64 if mode == "train" else 16)]
+                labels = list(rng.randint(
+                    0, 50, 64 if mode == "train" else 16))
+                super().__init__(files, labels, feat_type, **kw)
+                return
+            files, labels = [], []
+            with open(os.path.join(data_dir, "meta", "esc50.csv")) as f:
+                for row in csv.DictReader(f):
+                    in_split = int(row["fold"]) == int(split)
+                    if (mode == "train") == (not in_split):
+                        files.append(os.path.join(data_dir, "audio",
+                                                  row["filename"]))
+                        labels.append(int(row["target"]))
+            super().__init__(files, labels, feat_type, **kw)
+
+    class TESS(_AudioClassificationDataset):
+        """TESS layout: wav files named *_<emotion>.wav under data_dir
+        (reference tess.py); 7 emotion classes."""
+
+        sample_rate = 24414
+        emotions = ["angry", "disgust", "fear", "happy", "neutral",
+                    "ps", "sad"]
+
+        def __init__(self, mode="train", n_folds=5, split=1,
+                     feat_type="raw", data_dir=None, **kw):
+            import os
+
+            if data_dir is None:
+                import zlib
+
+                rng = np.random.RandomState(
+                    zlib.crc32(f"tess/{mode}/{split}".encode()))
+                n = 35 if mode == "train" else 14
+                files = [rng.randn(2441).astype(np.float32) * 0.1
+                         for _ in range(n)]
+                labels = list(rng.randint(0, 7, n))
+                super().__init__(files, labels, feat_type, **kw)
+                return
+            files, labels = [], []
+            emo_idx = {e: i for i, e in enumerate(self.emotions)}
+            all_files = []
+            for dirpath, _, names in sorted(os.walk(data_dir)):
+                for fn in sorted(names):
+                    if not fn.lower().endswith(".wav"):
+                        continue
+                    emo = fn.rsplit("_", 1)[-1][:-4].lower()
+                    if emo in emo_idx:
+                        all_files.append((os.path.join(dirpath, fn),
+                                          emo_idx[emo]))
+            for i, (path, lab) in enumerate(all_files):
+                in_split = i % n_folds == (split - 1)
+                if (mode == "train") == (not in_split):
+                    files.append(path)
+                    labels.append(lab)
+            super().__init__(files, labels, feat_type, **kw)
