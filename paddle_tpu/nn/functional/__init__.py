@@ -459,31 +459,68 @@ def _pool_nd(x, kernel_size, stride, padding, n_spatial, reducer, init,
     return apply(fn, x, op_name=op_name)
 
 
+def _max_pool_indices(x, kernel_size, stride, padding, n, ceil_mode,
+                      data_format, op_name):
+    from ...ops.nn_compat import _max_pool_with_index
+
+    channels_last = data_format in ("NLC", "NHWC", "NDHWC")
+    if isinstance(padding, str):
+        if padding.upper() == "VALID":
+            padding = 0
+        else:
+            raise NotImplementedError(
+                "padding='SAME' with return_mask=True is not supported; "
+                "pass explicit integer padding")
+    if isinstance(padding, (list, tuple)) and len(padding) not in (1, n):
+        raise ValueError(
+            f"return_mask=True expects per-dim padding of length {n}, "
+            f"got {padding!r} (per-side [lo, hi] pads unsupported here)")
+
+    def fn(a):
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+        v, i = _max_pool_with_index(a, kernel_size, stride, padding, n,
+                                    ceil_mode)
+        if channels_last:
+            v = jnp.moveaxis(v, 1, -1)
+            i = jnp.moveaxis(i, 1, -1)
+        return v, i
+
+    return apply(fn, x, op_name=op_name)
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    out = _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
-                   lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating)
-                   else jnp.iinfo(d).min,
-                   ceil_mode, True, data_format, "max_pool2d")
     if return_mask:
-        return out, None
-    return out
+        return _max_pool_indices(x, kernel_size, stride, padding, 2,
+                                 ceil_mode, data_format,
+                                 "max_pool2d_with_index")
+    return _pool_nd(x, kernel_size, stride, padding, 2, jax.lax.max,
+                    lambda d: -jnp.inf if jnp.issubdtype(d, jnp.floating)
+                    else jnp.iinfo(d).min,
+                    ceil_mode, True, data_format, "max_pool2d")
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    out = _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
-                   lambda d: -jnp.inf, ceil_mode, True, data_format,
-                   "max_pool1d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _max_pool_indices(x, kernel_size, stride, padding, 1,
+                                 ceil_mode, data_format,
+                                 "max_pool1d_with_index")
+    return _pool_nd(x, kernel_size, stride, padding, 1, jax.lax.max,
+                    lambda d: -jnp.inf, ceil_mode, True, data_format,
+                    "max_pool1d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    out = _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
-                   lambda d: -jnp.inf, ceil_mode, True, data_format,
-                   "max_pool3d")
-    return (out, None) if return_mask else out
+    if return_mask:
+        return _max_pool_indices(x, kernel_size, stride, padding, 3,
+                                 ceil_mode, data_format,
+                                 "max_pool3d_with_index")
+    return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                    lambda d: -jnp.inf, ceil_mode, True, data_format,
+                    "max_pool3d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -1360,3 +1397,9 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
         out = jnp.concatenate([left, right, rest], axis=2)
         return out.reshape(nt, c, h, w)
     return apply(fn, x, op_name="temporal_shift")
+
+
+from .extra import *  # noqa: F401,F403,E402
+from .extra import __all__ as _extra_all
+
+__all__ = list(globals().get("__all__", [])) + list(_extra_all)

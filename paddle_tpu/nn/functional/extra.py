@@ -1,0 +1,363 @@
+"""Remaining nn.functional surface (reference:
+python/paddle/nn/functional/__init__.py exports not covered by the main
+module): distance/loss functions, unpooling, lp pooling, zero padding,
+in-place activation aliases, and re-exports of registry kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import apply
+from ...core.tensor import Tensor
+
+__all__ = [
+    "pairwise_distance", "zeropad2d", "bilinear", "lp_pool1d", "lp_pool2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+    "dice_loss", "npair_loss", "multi_margin_loss", "soft_margin_loss",
+    "gaussian_nll_loss", "triplet_margin_with_distance_loss",
+    "adaptive_log_softmax_with_loss", "rnnt_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "gather_tree", "flash_attn_qkvpacked",
+    "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+def _reg(name):
+    from ...ops.registry import get
+
+    info = get(name)
+    assert info is not None, name
+    return info.fn
+
+
+def _wrap_reg(name):
+    fn = _reg(name)
+
+    def op(*args, **kwargs):
+        # through apply(): Tensors anywhere in args/kwargs are unwrapped,
+        # autograd is recorded, AMP casting applies
+        return apply(fn, *args, op_name=name, **kwargs)
+    op.__name__ = name
+    return op
+
+
+bilinear = _wrap_reg("bilinear")
+lp_pool2d = _wrap_reg("lp_pool2d")
+fractional_max_pool2d = _wrap_reg("fractional_max_pool2d")
+fractional_max_pool3d = _wrap_reg("fractional_max_pool3d")
+hsigmoid_loss = _wrap_reg("hsigmoid_loss")
+margin_cross_entropy = _wrap_reg("margin_cross_entropy")
+gather_tree = _wrap_reg("gather_tree")
+flash_attn_qkvpacked = _wrap_reg("flash_attn_qkvpacked")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1),
+                         1.0 / p) if p != jnp.inf else \
+            jnp.max(jnp.abs(d), axis=-1)
+    out = apply(fn, x, y, op_name="pairwise_distance")
+    if keepdim:
+        from ...ops.manipulation import unsqueeze
+
+        out = unsqueeze(out, -1)
+    return out
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = (int(p) for p in padding)
+
+    def fn(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (t, b), (l, r)))
+        return jnp.pad(a, ((0, 0), (t, b), (l, r), (0, 0)))
+    return apply(fn, x, op_name="zeropad2d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    k = int(kernel_size[0] if isinstance(kernel_size, (list, tuple))
+            else kernel_size)
+    s = int((stride[0] if isinstance(stride, (list, tuple)) else stride)
+            or k)
+    pad = int(padding[0] if isinstance(padding, (list, tuple))
+              else padding)
+
+    def fn(a):
+        if data_format == "NLC":
+            a = jnp.swapaxes(a, 1, 2)
+        hi = pad
+        if ceil_mode:
+            span = a.shape[-1] + 2 * pad - k
+            out_l = -(-span // s) + 1
+            hi = max(pad, (out_l - 1) * s + k - a.shape[-1] - pad)
+        ap = jnp.pad(jnp.abs(a) ** norm_type,
+                     ((0, 0), (0, 0), (pad, hi)))
+        summed = jax.lax.reduce_window(
+            ap, 0.0, jax.lax.add, (1, 1, k), (1, 1, s), "VALID")
+        out = jnp.power(summed, 1.0 / norm_type)
+        if data_format == "NLC":
+            out = jnp.swapaxes(out, 1, 2)
+        return out
+    return apply(fn, x, op_name="lp_pool1d")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                spatial_ndim):
+    def fn(a, idx):
+        lead = a.shape[:-spatial_ndim]
+        spatial = a.shape[-spatial_ndim:]
+        if output_size is not None:
+            out_spatial = tuple(int(s) for s in
+                                output_size[-spatial_ndim:])
+        else:
+            ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+                else [kernel_size] * spatial_ndim
+            st = stride if isinstance(stride, (list, tuple)) else \
+                [stride if stride else k for k in ks]
+            pd = padding if isinstance(padding, (list, tuple)) else \
+                [padding] * spatial_ndim
+            out_spatial = tuple(
+                (spatial[i] - 1) * int(st[i]) - 2 * int(pd[i])
+                + int(ks[i]) for i in range(spatial_ndim))
+        size = int(np.prod(out_spatial))
+        flat_in = a.reshape(lead + (-1,))
+        flat_idx = idx.reshape(lead + (-1,)).astype(jnp.int32)
+        # scatter values at their recorded argmax positions
+        out = jnp.zeros(lead + (size,), a.dtype)
+        b_idx = jnp.indices(flat_idx.shape)
+        out = out.at[(*b_idx[:-1], flat_idx)].set(flat_in)
+        return out.reshape(lead + out_spatial)
+    return apply(fn, x, indices, op_name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, 3)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference nn/functional/loss.py dice_loss."""
+    def fn(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1],
+                            dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1,
+                                                       axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(fn, input, label, op_name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference npair_loss: cross-entropy over anchor.positive^T
+    similarities + L2 on embeddings."""
+    def fn(a, p, y):
+        logits = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return ce + reg
+    return apply(fn, anchor, positive, labels, op_name="npair_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def fn(x, y, w=None):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        mask = 1 - jax.nn.one_hot(y, c, dtype=x.dtype)
+        loss = jnp.sum(m * mask, axis=1) / c
+        if w is not None:
+            loss = loss * w[y]        # per-sample class weight
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    if weight is not None:
+        return apply(fn, input, label, weight,
+                     op_name="multi_margin_loss")
+    return apply(fn, input, label, op_name="multi_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        loss = jnp.log1p(jnp.exp(-y.astype(x.dtype) * x))
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, input, label, op_name="soft_margin_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, mu.dtype))
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, input, label, variance, op_name="gaussian_nll_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_sw = dist(positive, negative)
+        d_neg = apply(jnp.minimum, d_neg, d_sw, op_name="minimum")
+
+    def fn(dp, dn):
+        loss = jnp.maximum(0.0, dp - dn + margin)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, d_pos, d_neg, op_name="triplet_margin_distance")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference loss.py adaptive_log_softmax_with_loss):
+    frequent classes in the head, tail clusters projected down."""
+    def fn(x, y, hw, *tails_and_bias):
+        if head_bias is not None:
+            *tails, hb = tails_and_bias
+        else:
+            tails, hb = list(tails_and_bias), None
+        n_clusters = len(cutoffs)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logprob = jax.nn.log_softmax(head_logits, axis=-1)
+        shortlist = cutoffs[0]
+        out = jnp.zeros(x.shape[0], x.dtype)
+        # head classes
+        in_head = y < shortlist
+        head_ll = jnp.take_along_axis(
+            head_logprob, jnp.clip(y, 0, shortlist - 1)[:, None],
+            axis=1)[:, 0]
+        out = jnp.where(in_head, head_ll, out)
+        lo = shortlist
+        for ci in range(n_clusters):
+            hi = cutoffs[ci + 1] if ci + 1 < len(cutoffs) else None
+            w1, w2 = tails[ci * 2], tails[ci * 2 + 1]
+            hi = hi if hi is not None else w2.shape[1] + lo
+            cluster_logprob = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+            in_c = (y >= lo) & (y < hi)
+            rel = jnp.clip(y - lo, 0, w2.shape[1] - 1)
+            ll = head_logprob[:, shortlist + ci] + jnp.take_along_axis(
+                cluster_logprob, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, ll, out)
+            lo = hi
+        return out, -jnp.mean(out)
+
+    tails = [w._value if isinstance(w, Tensor) else w
+             for pair in tail_weights for w in pair]
+    args = [input, label, head_weight] + tails
+    if head_bias is not None:
+        args.append(head_bias)
+    return apply(fn, *args, op_name="adaptive_log_softmax_with_loss")
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss via the standard alpha-recursion DP
+    (reference fuses warprnnt; this is the pure-XLA lattice)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) "
+            "is not implemented — silently ignoring it would train the "
+            "wrong objective")
+    def fn(lg, lb, tl, ul):
+        B, T, U1, V = lg.shape
+        logp = jax.nn.log_softmax(lg, axis=-1)
+
+        def one(lp, y, t_len, u_len):
+            # alpha[t, u]; emit prob lp[t, u, y[u]], blank lp[t, u, blank]
+            blanks = lp[:, :, blank]                       # [T, U1]
+            y_pad = jnp.concatenate([y, jnp.zeros(1, y.dtype)])
+            emits = jnp.take_along_axis(
+                lp, jnp.broadcast_to(y_pad[None, :, None],
+                                     (T, U1, 1)).astype(jnp.int32),
+                axis=2)[:, :, 0]                           # [T, U1]
+            neg = jnp.asarray(-1e30, lp.dtype)
+
+            def row(alpha_prev, t):
+                def col(carry, u):
+                    a_left = carry                          # alpha[t, u-1]
+                    from_top = jnp.where(
+                        t > 0, alpha_prev[u] + blanks[t - 1, u], neg)
+                    from_left = jnp.where(
+                        u > 0, a_left + emits[t, u - 1], neg)
+                    init = jnp.where((t == 0) & (u == 0), 0.0, neg)
+                    a = jnp.logaddexp(jnp.logaddexp(from_top, from_left),
+                                      init)
+                    return a, a
+                _, alpha_t = jax.lax.scan(col, neg, jnp.arange(U1))
+                return alpha_t, alpha_t
+            _, alphas = jax.lax.scan(row, jnp.full((U1,), neg),
+                                     jnp.arange(T))
+            final = alphas[t_len - 1, u_len] + \
+                blanks[t_len - 1, u_len]
+            return -final
+        losses = jax.vmap(one)(logp, lb, tl, ul)
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+    return apply(fn, logits, labels, logit_lengths, label_lengths,
+                 op_name="rnnt_loss")
+
+
+def _inplace(fn_name):
+    def op(x, *args, **kwargs):
+        from .. import functional as F
+
+        out = getattr(F, fn_name)(x, *args, **kwargs)
+        x.set_value(out._value if isinstance(out, Tensor) else out)
+        return x
+    op.__name__ = fn_name + "_"
+    return op
+
+
+elu_ = _inplace("elu")
+hardtanh_ = _inplace("hardtanh")
+leaky_relu_ = _inplace("leaky_relu")
+softmax_ = _inplace("softmax")
+tanh_ = _inplace("tanh")
+thresholded_relu_ = _inplace("thresholded_relu")

@@ -1,0 +1,161 @@
+"""nn.functional extras (reference nn/functional exports): distances,
+losses (incl. exact RNN-T), unpooling with real argmax indices, in-place
+aliases."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def test_pairwise_distance_and_zeropad():
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 6)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 6)
+                         .astype("float32"))
+    pd = F.pairwise_distance(x, y)
+    want = np.linalg.norm(np.asarray(x.numpy()) - np.asarray(y.numpy())
+                          + 1e-6, axis=-1)
+    np.testing.assert_allclose(np.asarray(pd.numpy()), want, rtol=1e-5)
+    z = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2), "float32")),
+                    [1, 2, 3, 4])
+    assert tuple(z.shape) == (1, 1, 9, 5)
+
+
+def test_max_pool_return_mask_and_unpool():
+    img = paddle.to_tensor(np.arange(16, dtype="float32")
+                           .reshape(1, 1, 4, 4))
+    pooled, idx = F.max_pool2d(img, 2, stride=2, return_mask=True)
+    np.testing.assert_array_equal(
+        np.asarray(idx.numpy()).reshape(-1), [5, 7, 13, 15])
+    un = F.max_unpool2d(pooled, idx, 2, stride=2)
+    got = np.asarray(un.numpy())
+    assert got[0, 0, 1, 1] == 5 and got[0, 0, 3, 3] == 15
+    assert got.sum() == 5 + 7 + 13 + 15
+
+
+def test_losses_against_closed_forms():
+    lbl = paddle.to_tensor(np.asarray([1, -1, 1, -1], "float32"))
+    sm = F.soft_margin_loss(paddle.to_tensor(np.zeros(4, "float32")), lbl)
+    np.testing.assert_allclose(float(sm.numpy()), np.log(2), rtol=1e-5)
+
+    mu = paddle.to_tensor(np.zeros((3, 2), "float32"))
+    yv = paddle.to_tensor(np.ones((3, 2), "float32"))
+    var = paddle.to_tensor(np.ones((3, 2), "float32"))
+    g = F.gaussian_nll_loss(mu, yv, var)
+    np.testing.assert_allclose(float(g.numpy()), 0.5, rtol=1e-5)
+
+    probs = paddle.to_tensor(
+        np.asarray([[0.8, 0.1, 0.1]], "float32"))
+    lab = paddle.to_tensor(np.asarray([[0]], "int64"))
+    d = F.dice_loss(probs, lab)
+    assert 0 <= float(d.numpy()) < 1
+
+    a = paddle.to_tensor(np.random.RandomState(2).randn(4, 8)
+                         .astype("float32"))
+    p = paddle.to_tensor(np.random.RandomState(3).randn(4, 8)
+                         .astype("float32"))
+    lbls = paddle.to_tensor(np.asarray([0, 1, 0, 1], "int64"))
+    n = F.npair_loss(a, p, lbls)
+    assert np.isfinite(float(n.numpy()))
+
+    mm = F.multi_margin_loss(
+        paddle.to_tensor(np.asarray([[2.0, 0.0, 0.0]], "float32")),
+        paddle.to_tensor(np.asarray([0], "int64")))
+    np.testing.assert_allclose(float(mm.numpy()), 0.0, atol=1e-6)
+
+
+def test_rnnt_loss_exact_small_lattice():
+    rng = np.random.RandomState(0)
+    T, U, V = 2, 1, 4
+    logits = rng.randn(1, T, U + 1, V).astype("float32")
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    y = [2]
+    blank = 0
+    p1 = logp[0, 0, 0, y[0]] + logp[0, 0, 1, blank] \
+        + logp[0, 1, 1, blank]
+    p2 = logp[0, 0, 0, blank] + logp[0, 1, 0, y[0]] \
+        + logp[0, 1, 1, blank]
+    want = -np.logaddexp(p1, p2)
+    got = float(F.rnnt_loss(
+        paddle.to_tensor(logits),
+        paddle.to_tensor(np.asarray([y], "int32")),
+        paddle.to_tensor(np.asarray([T], "int32")),
+        paddle.to_tensor(np.asarray([U], "int32")),
+        reduction="none").numpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_triplet_and_inplace_aliases():
+    a = paddle.to_tensor(np.zeros((2, 4), "float32"))
+    pos = paddle.to_tensor(np.zeros((2, 4), "float32"))
+    neg = paddle.to_tensor(np.full((2, 4), 3.0, "float32"))
+    t = F.triplet_margin_with_distance_loss(a, pos, neg, margin=1.0)
+    np.testing.assert_allclose(float(t.numpy()), 0.0, atol=1e-5)
+
+    x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+    out = F.softmax_(x)
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x.numpy()), 1 / 3, rtol=1e-6)
+    x2 = paddle.to_tensor(np.asarray([-1.0, 1.0], "float32"))
+    F.tanh_(x2)
+    np.testing.assert_allclose(np.asarray(x2.numpy()),
+                               np.tanh([-1.0, 1.0]), rtol=1e-6)
+
+
+def test_adaptive_log_softmax_with_loss():
+    rng = np.random.RandomState(4)
+    B, D, shortlist, tail = 6, 8, 4, 6
+    x = paddle.to_tensor(rng.randn(B, D).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, shortlist + tail, B)
+                         .astype("int64"))
+    hw = paddle.to_tensor(rng.randn(D, shortlist + 1).astype("float32"))
+    t1 = paddle.to_tensor(rng.randn(D, 4).astype("float32"))
+    t2 = paddle.to_tensor(rng.randn(4, tail).astype("float32"))
+    ll, loss = F.adaptive_log_softmax_with_loss(
+        x, y, hw, [(t1, t2)], cutoffs=[shortlist])
+    assert np.isfinite(float(loss.numpy()))
+    assert (np.asarray(ll.numpy()) <= 0).all()
+
+
+def test_return_mask_channels_last_and_padding_guards():
+    img = np.arange(16, dtype="float32").reshape(1, 4, 4, 1)
+    pooled, idx = F.max_pool2d(paddle.to_tensor(img), 2, stride=2,
+                               return_mask=True, data_format="NHWC")
+    assert tuple(pooled.shape) == (1, 2, 2, 1)
+    np.testing.assert_array_equal(
+        np.asarray(idx.numpy()).reshape(-1), [5, 7, 13, 15])
+    with pytest.raises(NotImplementedError):
+        F.max_pool2d(paddle.to_tensor(img), 3, stride=2, padding="SAME",
+                     return_mask=True, data_format="NHWC")
+
+
+def test_wrapped_registry_ops_record_grads():
+    x = paddle.to_tensor(np.random.RandomState(6).randn(2, 3)
+                         .astype("float32"), stop_gradient=False)
+    y = paddle.to_tensor(np.random.RandomState(7).randn(2, 4)
+                         .astype("float32"))
+    w = paddle.to_tensor(np.random.RandomState(8).randn(5, 3, 4)
+                         .astype("float32"))
+    out = F.bilinear(x, y, w)
+    assert not out.stop_gradient
+    out.sum().backward()
+    assert x.grad is not None
+
+
+def test_multi_margin_weight_scales():
+    x = paddle.to_tensor(np.asarray([[0.0, 1.0, 0.0]], "float32"))
+    y = paddle.to_tensor(np.asarray([0], "int64"))
+    base = float(F.multi_margin_loss(x, y).numpy())
+    w = paddle.to_tensor(np.asarray([2.0, 1.0, 1.0], "float32"))
+    weighted = float(F.multi_margin_loss(x, y, weight=w).numpy())
+    np.testing.assert_allclose(weighted, 2 * base, rtol=1e-6)
+
+
+def test_lp_pool1d_ceil_and_nlc():
+    x = paddle.to_tensor(np.ones((1, 1, 5), "float32"))
+    out = F.lp_pool1d(x, 2, 2, stride=2, ceil_mode=True)
+    assert tuple(out.shape) == (1, 1, 3)
+    xc = paddle.to_tensor(np.ones((1, 5, 1), "float32"))
+    outc = F.lp_pool1d(xc, 2, 2, stride=2, data_format="NLC")
+    assert tuple(outc.shape) == (1, 2, 1)
