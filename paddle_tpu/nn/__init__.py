@@ -55,3 +55,9 @@ from .layer.transformer import (MultiHeadAttention, Transformer,
 from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, SimpleRNN,
                         SimpleRNNCell)
 from . import utils
+
+from .layer.extra_layers import (  # noqa: F401,E402
+    FractionalMaxPool2D, FractionalMaxPool3D, GaussianNLLLoss, LPPool1D,
+    LPPool2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, MultiMarginLoss,
+    PairwiseDistance, RNNTLoss, SoftMarginLoss,
+    TripletMarginWithDistanceLoss)

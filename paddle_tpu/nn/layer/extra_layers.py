@@ -1,0 +1,157 @@
+"""Layer wrappers over nn.functional.extra (reference:
+python/paddle/nn/layer/distance.py, loss.py, pooling.py classes)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["PairwiseDistance", "MaxUnPool1D", "MaxUnPool2D",
+           "MaxUnPool3D", "LPPool1D", "LPPool2D", "FractionalMaxPool2D",
+           "FractionalMaxPool3D", "MultiMarginLoss", "SoftMarginLoss",
+           "GaussianNLLLoss", "TripletMarginWithDistanceLoss",
+           "RNNTLoss"]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)
+
+
+class _UnpoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return type(self)._fn(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size=self.output_size)
+
+
+class MaxUnPool1D(_UnpoolNd):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_UnpoolNd):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_UnpoolNd):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode,
+                     data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, *self.args)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.norm_type = norm_type
+        self.kernel_size = kernel_size
+        self.stride = stride
+
+    def forward(self, x):
+        return F.lp_pool2d(x, self.norm_type, self.kernel_size,
+                           self.stride)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self.p, self.margin,
+                                   self.weight, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.distance_function,
+            self.margin, self.swap, self.reduction)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, logits, labels, logit_lengths, label_lengths):
+        return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda,
+                           self.reduction)

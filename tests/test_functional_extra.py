@@ -159,3 +159,22 @@ def test_lp_pool1d_ceil_and_nlc():
     xc = paddle.to_tensor(np.ones((1, 5, 1), "float32"))
     outc = F.lp_pool1d(xc, 2, 2, stride=2, data_format="NLC")
     assert tuple(outc.shape) == (1, 2, 1)
+
+
+def test_layer_wrappers():
+    import paddle_tpu.nn as nn
+
+    img = paddle.to_tensor(np.arange(16, dtype="float32")
+                           .reshape(1, 1, 4, 4))
+    pooled, idx = F.max_pool2d(img, 2, stride=2, return_mask=True)
+    un = nn.MaxUnPool2D(2, stride=2)(pooled, idx)
+    assert tuple(un.shape) == (1, 1, 4, 4)
+    loss = nn.GaussianNLLLoss()(
+        paddle.to_tensor(np.zeros((2, 2), "float32")),
+        paddle.to_tensor(np.ones((2, 2), "float32")),
+        paddle.to_tensor(np.ones((2, 2), "float32")))
+    np.testing.assert_allclose(float(loss.numpy()), 0.5, rtol=1e-5)
+    lp = nn.LPPool1D(2, 2, stride=2)(
+        paddle.to_tensor(np.ones((1, 1, 4), "float32")))
+    np.testing.assert_allclose(np.asarray(lp.numpy()).reshape(-1),
+                               [np.sqrt(2), np.sqrt(2)], rtol=1e-5)
