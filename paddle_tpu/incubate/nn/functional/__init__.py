@@ -175,10 +175,123 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return (out, None) if return_softmax is not None else out
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError("varlen flash attention: round 2 (pallas "
-                              "kernel with segment ids)")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="",
+                        training=True, name=None):
+    """Varlen (packed/ragged) flash attention (reference
+    flash_attn_unpadded): q/k/v are [total_tokens, H, D] with cumulative
+    sequence offsets. TPU-native: segment-id block-diagonal masking over
+    one fused attention — XLA keeps static shapes, the mask carries the
+    raggedness."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ....core.dispatch import apply
+    from ....core.tensor import Tensor
+
+    cq = np.asarray(cu_seqlens_q.numpy()
+                    if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q)
+    ck = np.asarray(cu_seqlens_k.numpy()
+                    if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k)
+
+    def fn(q, k, v):
+        # per-segment dense attention (the reference kernel's memory
+        # profile: logits bounded by the LARGEST segment, not total²;
+        # cu_seqlens are concrete in eager so the loop unrolls statically)
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        outs = []
+        from ....framework.random import next_key
+
+        key_d = next_key() if (dropout > 0.0 and training) else None
+        for i in range(len(cq) - 1):
+            qs = q[int(cq[i]):int(cq[i + 1])].astype(jnp.float32)
+            ks = k[int(ck[i]):int(ck[i + 1])].astype(jnp.float32)
+            vs = v[int(ck[i]):int(ck[i + 1])].astype(jnp.float32)
+            logits = jnp.einsum("qhd,khd->hqk", qs, ks) * s
+            if causal:
+                qi = jnp.arange(qs.shape[0])[:, None]
+                ki = jnp.arange(ks.shape[0])[None, :]
+                logits = jnp.where((qi >= ki)[None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if key_d is not None:
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(key_d, i), 1.0 - dropout,
+                    probs.shape)
+                probs = probs * keep / (1.0 - dropout)
+            outs.append(jnp.einsum("hqk,khd->qhd", probs, vs))
+        return jnp.concatenate(outs, axis=0).astype(q.dtype)
+
+    out = apply(fn, query, key, value, op_name="flash_attn_unpadded")
+    return out, None
 
 
-def variable_length_memory_efficient_attention(*args, **kwargs):
-    raise NotImplementedError("varlen attention: round 2")
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, **kw):
+    """Packed [total, 3, H, D] varlen attention (reference
+    flash_attn_varlen_qkvpacked): unpack and delegate."""
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale,
+                               dropout, causal, return_softmax)
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens=None, kv_seq_lens=None, mask=None,
+        scale=None, causal=False, pre_cache_length=0, name=None):
+    """Batched variable-length attention (reference
+    variable_length_memory_efficient_attention): [B, H, S, D] with
+    per-example valid lengths masking the key axis; `mask` is an
+    additive attention bias."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....core.dispatch import apply
+    from ....core.tensor import Tensor
+
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: "
+            "pre_cache_length > 0 (cached-prefix offsets) is not "
+            "implemented — silently ignoring it would misalign the "
+            "causal mask")
+
+    def fn(q, k, v, *rest):
+        kl = rest[0] if len(rest) >= 1 else None
+        bias = rest[1] if len(rest) >= 2 else None
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        if kl is not None:
+            valid = jnp.arange(k.shape[2])[None, :] < \
+                kl.reshape(-1, 1)
+            logits = jnp.where(valid[:, None, None, :], logits, -1e30)
+        if causal:
+            qi = jnp.arange(q.shape[2])[:, None]
+            ki = jnp.arange(k.shape[2])[None, :]
+            logits = jnp.where((qi >= ki)[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    lens = kv_seq_lens if kv_seq_lens is not None else seq_lens
+    if mask is not None and lens is None:
+        def fn_bias(q, k, v, b):
+            return fn(q, k, v, None, b)
+        return apply(fn_bias, query, key, value, mask,
+                     op_name="varlen_attention")
+    if lens is not None and mask is not None:
+        return apply(fn, query, key, value, lens, mask,
+                     op_name="varlen_attention")
+    if lens is not None:
+        return apply(fn, query, key, value, lens,
+                     op_name="varlen_attention")
+    return apply(fn, query, key, value, op_name="varlen_attention")

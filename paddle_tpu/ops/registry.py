@@ -59,9 +59,8 @@ EXCLUSIONS: Dict[str, str] = {
     "detection_map": "mAP metric with LoD inputs; metric-layer concern",
     "generate_proposals": "dynamic-shape RPN proposal generation; "
                           "multiclass_nms3-style static variant planned",
-    "flash_attn_unpadded": "ragged varlen layout; XLA needs static "
-                           "shapes — masked flash_attn covers it",
-    "flash_attn_varlen_qkvpacked": "same as flash_attn_unpadded",
+    "flash_attn_unpadded": None,          # implemented (incubate varlen)
+    "flash_attn_varlen_qkvpacked": None,  # implemented (incubate varlen)
     "flash_attn_with_sparse_mask": "sparse-mask CUDA layout; dense mask "
                                    "path covers it",
     "class_center_sample": "PS-style distributed negative sampling",

@@ -819,6 +819,38 @@ def _flash_attn_qkvpacked(qkv, fixed_seed_offset=None, attn_mask=None,
                        causal, return_softmax, is_test, rng_name)
 
 
+@_reg("flash_attn_unpadded")
+def _flash_attn_unpadded_op(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                            fixed_seed_offset=None, attn_mask=None,
+                            max_seqlen_q=0, max_seqlen_k=0, scale=1.0,
+                            dropout=0.0, causal=False,
+                            return_softmax=False, is_test=False,
+                            rng_name=""):
+    """Varlen (packed) flash attention — segment-wise dense math; see
+    incubate.nn.functional.flash_attn_unpadded."""
+    from ..incubate.nn import functional as incf
+
+    out, _ = incf.flash_attn_unpadded(
+        Tensor(jnp.asarray(q)), Tensor(jnp.asarray(k)),
+        Tensor(jnp.asarray(v)), cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q, max_seqlen_k, scale or None, dropout, causal,
+        return_softmax)
+    return out._value, None, None, None
+
+
+@_reg("flash_attn_varlen_qkvpacked")
+def _flash_attn_varlen_qkvpacked_op(qkv, cu_seqlens_q, cu_seqlens_k,
+                                    **kw):
+    from ..incubate.nn import functional as incf
+
+    out, _ = incf.flash_attn_varlen_qkvpacked(
+        Tensor(jnp.asarray(qkv)), cu_seqlens_q, cu_seqlens_k,
+        **{k_: v_ for k_, v_ in kw.items()
+           if k_ in ("max_seqlen_q", "max_seqlen_k", "scale", "dropout",
+                     "causal", "return_softmax")})
+    return out._value, None, None, None
+
+
 @_reg("memory_efficient_attention")
 def _memory_efficient_attention(query, key, value, bias=None,
                                 cu_seqlens_q=None, cu_seqlens_k=None,

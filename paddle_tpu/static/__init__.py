@@ -285,8 +285,55 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
                 allow_unused=True)
 
 
-def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    raise NotImplementedError("py_func: wrap the python fn as an eager op")
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """reference static.py_func: run a host python function as an op.
+    TPU-native: jax.pure_callback (host roundtrip; shapes from `out`)."""
+    import jax
+    import numpy as np
+
+    from ..core.dispatch import apply
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), np.dtype(o.dtype))
+              for o in outs]
+    in_shapes = [jax.ShapeDtypeStruct(tuple(t.shape),
+                                      np.dtype(t.dtype)) for t in xs]
+
+    def fwd_cb(*arrs):
+        return jax.pure_callback(
+            lambda *hs: func(*[np.asarray(h) for h in hs]),
+            shapes if len(shapes) > 1 else shapes[0], *arrs)
+
+    if backward_func is None:
+        return apply(fwd_cb, *xs, op_name="py_func",
+                     differentiable=False)
+
+    # custom VJP: backward_func(*xs, *outs, *douts) -> dxs (host side),
+    # the reference py_func backward contract
+    import jax.numpy as jnp
+
+    @jax.custom_vjp
+    def fn(*arrs):
+        return fwd_cb(*arrs)
+
+    def fn_fwd(*arrs):
+        res = fwd_cb(*arrs)
+        res_t = res if isinstance(res, (list, tuple)) else (res,)
+        return res, (arrs, tuple(res_t))
+
+    def fn_bwd(resids, douts):
+        arrs, res_t = resids
+        douts_t = douts if isinstance(douts, (list, tuple)) else (douts,)
+        grads = jax.pure_callback(
+            lambda *hs: tuple(np.asarray(g) for g in backward_func(
+                *[np.asarray(h) for h in hs])),
+            tuple(in_shapes), *arrs, *res_t, *douts_t)
+        return tuple(grads)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return apply(fn, *xs, op_name="py_func")
 
 
 def save(program, model_path, protocol=4):

@@ -178,3 +178,38 @@ def test_layer_wrappers():
         paddle.to_tensor(np.ones((1, 1, 4), "float32")))
     np.testing.assert_allclose(np.asarray(lp.numpy()).reshape(-1),
                                [np.sqrt(2), np.sqrt(2)], rtol=1e-5)
+
+
+def test_varlen_flash_attention_segment_masked():
+    from paddle_tpu.incubate.nn import functional as incf
+
+    rng = np.random.RandomState(0)
+    lens = [3, 5]
+    H, D = 2, 8
+    q = rng.randn(sum(lens), H, D).astype("float32")
+    cu = np.asarray([0, 3, 8], np.int32)
+    out, _ = incf.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        cu, cu, causal=True)
+    got = np.asarray(out.numpy())
+    ofs = 0
+    for L in lens:
+        seg = q[ofs:ofs + L]
+        lg = np.einsum("qhd,khd->hqk", seg, seg) / np.sqrt(D)
+        m = np.tril(np.ones((L, L), bool))
+        lg = np.where(m[None], lg, -1e30)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("hqk,khd->qhd", p, seg)
+        np.testing.assert_allclose(got[ofs:ofs + L], want, rtol=1e-4,
+                                   atol=1e-5)
+        ofs += L
+
+
+def test_py_func_host_callback():
+    from paddle_tpu import static
+
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    out = static.py_func(lambda a: a * 2 + 1, x,
+                         paddle.to_tensor(np.zeros((2, 2), "float32")))
+    np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
