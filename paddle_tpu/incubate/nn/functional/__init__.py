@@ -213,7 +213,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
             vs = v[int(ck[i]):int(ck[i + 1])].astype(jnp.float32)
             logits = jnp.einsum("qhd,khd->hqk", qs, ks) * s
             if causal:
-                qi = jnp.arange(qs.shape[0])[:, None]
+                # bottom-right aligned (FA2 varlen semantics): with
+                # q_len < k_len the queries sit at the END of the keys
+                off = ks.shape[0] - qs.shape[0]
+                qi = jnp.arange(qs.shape[0])[:, None] + off
                 ki = jnp.arange(ks.shape[0])[None, :]
                 logits = jnp.where((qi >= ki)[None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
@@ -232,13 +235,15 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
                                 max_seqlen_q=None, max_seqlen_k=None,
                                 scale=None, dropout=0.0, causal=False,
-                                return_softmax=False, **kw):
+                                return_softmax=False, training=True,
+                                **kw):
     """Packed [total, 3, H, D] varlen attention (reference
     flash_attn_varlen_qkvpacked): unpack and delegate."""
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
                                max_seqlen_q, max_seqlen_k, scale,
-                               dropout, causal, return_softmax)
+                               dropout, causal, return_softmax,
+                               training=training)
 
 
 def variable_length_memory_efficient_attention(

@@ -61,3 +61,12 @@ from .layer.extra_layers import (  # noqa: F401,E402
     LPPool2D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D, MultiMarginLoss,
     PairwiseDistance, RNNTLoss, SoftMarginLoss,
     TripletMarginWithDistanceLoss)
+
+from .layer.extra_layers import (  # noqa: F401,E402
+    AdaptiveLogSoftmaxWithLoss, HSigmoidLoss, Softmax2D, Unflatten,
+    ZeroPad1D, ZeroPad3D)
+from .layer.decode import BeamSearchDecoder, dynamic_decode  # noqa: F401,E402
+from .layer.rnn import RNNCellBase  # noqa: F401,E402
+from ..optimizer.optimizer import (  # noqa: F401,E402
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue)
+Silu = SiLU  # reference exports both spellings

@@ -1,0 +1,96 @@
+"""Sequence decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode over RNN cells)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from .layers import Layer
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode"]
+
+
+class BeamSearchDecoder:
+    """Beam search over an RNN cell with output projection (reference
+    decode.py BeamSearchDecoder). Works on concrete (eager) arrays: the
+    decode loop is host-driven, each step's cell call is XLA."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, cell_out):
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        return cell_out
+
+    def decode(self, inits, max_step_num=32):
+        """inits: initial cell states [B, H] (or tuple). Returns
+        (ids [B, beam, T], scores [B, beam])."""
+        state0 = inits if isinstance(inits, (tuple, list)) else (inits,)
+        b = state0[0].shape[0]
+        k = self.beam_size
+
+        def embed(tok):
+            t = Tensor(jnp.asarray(tok))
+            if self.embedding_fn is not None:
+                return self.embedding_fn(t)
+            return t
+
+        # expand each state to [B*k, H]
+        states = tuple(
+            Tensor(jnp.repeat(s._value if isinstance(s, Tensor)
+                              else jnp.asarray(s), k, axis=0))
+            for s in state0)
+        tokens = np.full((b * k,), self.start_token, np.int64)
+        # only beam 0 live at t=0 so beams diverge
+        scores = np.full((b, k), -1e9, np.float32)
+        scores[:, 0] = 0.0
+        scores = scores.reshape(-1)
+        finished = np.zeros(b * k, bool)
+        history = []
+        for _ in range(max_step_num):
+            out = self.cell(embed(tokens), states if len(states) > 1
+                            else states[0])
+            cell_out, new_states = out
+            if not isinstance(new_states, (tuple, list)):
+                new_states = (new_states,)
+            logits = self._logits(cell_out)
+            logp = np.asarray(jax.nn.log_softmax(
+                logits._value if isinstance(logits, Tensor)
+                else jnp.asarray(logits), axis=-1))
+            v = logp.shape[-1]
+            # finished beams only extend with end_token at score 0
+            logp = np.where(finished[:, None],
+                            np.full_like(logp, -1e9), logp)
+            logp[finished, self.end_token] = 0.0
+            total = scores[:, None] + logp          # [B*k, V]
+            total = total.reshape(b, k * v)
+            top = np.argsort(-total, axis=1)[:, :k]  # [B, k]
+            scores = np.take_along_axis(total, top, axis=1).reshape(-1)
+            beam_src = top // v                      # [B, k]
+            tokens = (top % v).reshape(-1).astype(np.int64)
+            gather = (np.arange(b)[:, None] * k + beam_src).reshape(-1)
+            gidx = jnp.asarray(gather)
+            states = tuple(
+                Tensor(jnp.take(s._value, gidx, axis=0))
+                for s in new_states)
+            finished = finished[gather] | (tokens == self.end_token)
+            history = [h[gather] for h in history]
+            history.append(tokens.copy())
+            if finished.all():
+                break
+        ids = np.stack(history, axis=1).reshape(b, k, -1)
+        return Tensor(ids), Tensor(scores.reshape(b, k))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """reference decode.py dynamic_decode: run a decoder to completion."""
+    return decoder.decode(inits, max_step_num=max_step_num)

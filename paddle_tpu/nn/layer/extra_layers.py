@@ -9,7 +9,8 @@ __all__ = ["PairwiseDistance", "MaxUnPool1D", "MaxUnPool2D",
            "MaxUnPool3D", "LPPool1D", "LPPool2D", "FractionalMaxPool2D",
            "FractionalMaxPool3D", "MultiMarginLoss", "SoftMarginLoss",
            "GaussianNLLLoss", "TripletMarginWithDistanceLoss",
-           "RNNTLoss"]
+           "RNNTLoss", "Softmax2D", "Unflatten", "ZeroPad1D", "ZeroPad3D",
+           "HSigmoidLoss", "AdaptiveLogSoftmaxWithLoss"]
 
 
 class PairwiseDistance(Layer):
@@ -155,3 +156,104 @@ class RNNTLoss(Layer):
         return F.rnnt_loss(logits, labels, logit_lengths, label_lengths,
                            self.blank, self.fastemit_lambda,
                            self.reduction)
+
+
+class Softmax2D(Layer):
+    """Channel-wise softmax for NCHW inputs (reference layer)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.target = list(shape)
+
+    def forward(self, x):
+        from ...ops.manipulation import reshape
+
+        s = list(x.shape)
+        ax = self.axis % len(s)
+        return reshape(x, s[:ax] + self.target + s[ax + 1:])
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding, padding]
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, list(self.padding), mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 6
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, list(self.padding), mode="constant", value=0.0,
+                     data_format=self.data_format)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference layer/loss.py); holds
+    the path weight table, delegates to the registry kernel."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "HSigmoidLoss(is_custom=True): custom path tables are "
+                "not implemented — the default complete-binary tree "
+                "would silently compute a different loss")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else             self.create_parameter([num_classes - 1], attr=bias_attr
+                                  if bias_attr is not True else None,
+                                  is_bias=True)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.weight, self.bias,
+                               num_classes=self.num_classes)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference layer/loss.py AdaptiveLogSoftmaxWithLoss: head +
+    projected tail clusters, delegating to the functional."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs)
+        shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs)
+        self.head_weight = self.create_parameter(
+            [in_features, shortlist + n_clusters])
+        self.head_bias = self.create_parameter(
+            [shortlist + n_clusters], is_bias=True) if head_bias else None
+        self.tail_weights = []
+        bounds = self.cutoffs + [n_classes]
+        for i in range(n_clusters):
+            proj = max(1, int(in_features / (div_value ** (i + 1))))
+            size = bounds[i + 1] - bounds[i]
+            w1 = self.create_parameter([in_features, proj])
+            w2 = self.create_parameter([proj, size])
+            self.add_parameter(f"tail_{i}_0", w1)
+            self.add_parameter(f"tail_{i}_1", w2)
+            self.tail_weights.append((w1, w2))
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, self.head_bias)

@@ -830,11 +830,16 @@ def _flash_attn_unpadded_op(q, k, v, cu_seqlens_q, cu_seqlens_k,
     incubate.nn.functional.flash_attn_unpadded."""
     from ..incubate.nn import functional as incf
 
+    if attn_mask is not None:
+        raise NotImplementedError(
+            "flash_attn_unpadded: dense attn_mask on the varlen path is "
+            "not implemented — silently dropping it would unmask "
+            "positions")
     out, _ = incf.flash_attn_unpadded(
         Tensor(jnp.asarray(q)), Tensor(jnp.asarray(k)),
         Tensor(jnp.asarray(v)), cu_seqlens_q, cu_seqlens_k,
         max_seqlen_q, max_seqlen_k, scale or None, dropout, causal,
-        return_softmax)
+        return_softmax, training=not is_test)
     return out._value, None, None, None
 
 
@@ -843,11 +848,12 @@ def _flash_attn_varlen_qkvpacked_op(qkv, cu_seqlens_q, cu_seqlens_k,
                                     **kw):
     from ..incubate.nn import functional as incf
 
+    fwd_kw = {k_: v_ for k_, v_ in kw.items()
+              if k_ in ("max_seqlen_q", "max_seqlen_k", "scale",
+                        "dropout", "causal", "return_softmax")}
+    fwd_kw["training"] = not kw.get("is_test", False)
     out, _ = incf.flash_attn_varlen_qkvpacked(
-        Tensor(jnp.asarray(qkv)), cu_seqlens_q, cu_seqlens_k,
-        **{k_: v_ for k_, v_ in kw.items()
-           if k_ in ("max_seqlen_q", "max_seqlen_k", "scale", "dropout",
-                     "causal", "return_softmax")})
+        Tensor(jnp.asarray(qkv)), cu_seqlens_q, cu_seqlens_k, **fwd_kw)
     return out._value, None, None, None
 
 

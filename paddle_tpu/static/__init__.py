@@ -323,13 +323,18 @@ def py_func(func, x, out, backward_func=None,
         res_t = res if isinstance(res, (list, tuple)) else (res,)
         return res, (arrs, tuple(res_t))
 
+    skip_ids = {id(t) for t in (skip_vars_in_backward_input or [])}
+    keep = [i for i, t in enumerate(xs) if id(t) not in skip_ids]
+
     def fn_bwd(resids, douts):
         arrs, res_t = resids
         douts_t = douts if isinstance(douts, (list, tuple)) else (douts,)
+        kept = [arrs[i] for i in keep]   # reference: skipped vars are
+        #                                  omitted from backward inputs
         grads = jax.pure_callback(
             lambda *hs: tuple(np.asarray(g) for g in backward_func(
                 *[np.asarray(h) for h in hs])),
-            tuple(in_shapes), *arrs, *res_t, *douts_t)
+            tuple(in_shapes), *kept, *res_t, *douts_t)
         return tuple(grads)
 
     fn.defvjp(fn_fwd, fn_bwd)

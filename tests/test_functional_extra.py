@@ -213,3 +213,52 @@ def test_py_func_host_callback():
     out = static.py_func(lambda a: a * 2 + 1, x,
                          paddle.to_tensor(np.zeros((2, 2), "float32")))
     np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
+
+
+def test_nn_export_parity_with_reference():
+    import re
+
+    import paddle_tpu.nn as nn
+
+    ref = open("/root/reference/python/paddle/nn/__init__.py").read()
+    names = re.findall(r"^\s+'(\w+)',\s*$", ref, re.M)
+    missing = [n for n in names if not hasattr(nn, n)]
+    assert not missing, missing
+
+
+def test_new_layers_and_beam_search():
+    import paddle_tpu.nn as nn
+
+    s2 = nn.Softmax2D()(paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 2, 2).astype("float32")))
+    np.testing.assert_allclose(np.asarray(s2.numpy()).sum(axis=1), 1.0,
+                               rtol=1e-5)
+    u = nn.Unflatten(1, [2, 3])(
+        paddle.to_tensor(np.zeros((4, 6), "float32")))
+    assert tuple(u.shape) == (4, 2, 3)
+    h = nn.HSigmoidLoss(8, 10)
+    loss = h(paddle.to_tensor(
+        np.random.RandomState(1).randn(4, 8).astype("float32")),
+        paddle.to_tensor(np.asarray([[1], [2], [3], [4]], "int64")))
+    assert np.isfinite(float(np.asarray(loss.numpy()).mean()))
+    als = nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[4])
+    ll, l2 = als(
+        paddle.to_tensor(np.random.RandomState(2).randn(6, 8)
+                         .astype("float32")),
+        paddle.to_tensor(np.random.RandomState(3).randint(0, 12, 6)
+                         .astype("int64")))
+    assert np.isfinite(float(l2.numpy()))
+
+    emb = nn.Embedding(10, 6)
+    cell = nn.GRUCell(6, 6)
+    proj = nn.Linear(6, 10)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=9,
+                               beam_size=3, embedding_fn=emb,
+                               output_fn=proj)
+    ids, scores = nn.dynamic_decode(
+        dec, paddle.to_tensor(np.zeros((2, 6), "float32")),
+        max_step_num=5)
+    assert tuple(np.asarray(ids.numpy()).shape)[:2] == (2, 3)
+    # beams are sorted best-first
+    sc = np.asarray(scores.numpy())
+    assert (np.diff(sc, axis=1) <= 1e-5).all()
