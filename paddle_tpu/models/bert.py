@@ -75,6 +75,15 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         x = self.embeddings(input_ids, token_type_ids)
+        # match the encoder's ACTUAL compute dtype (set by amp.decorate O2):
+        # the fp32 embedding LayerNorm re-promotes, so re-cast here keeps
+        # the encoder matmuls on the MXU's native low precision. Keyed off
+        # the real weight dtype, not config, so plain-fp32 models are
+        # untouched.
+        enc_dtype = next((p.dtype for p in self.encoder.parameters()
+                          if str(p.dtype) in ("bfloat16", "float16")), None)
+        if enc_dtype is not None and enc_dtype != x.dtype:
+            x = x.astype(enc_dtype)
         x = self.encoder(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled

@@ -412,7 +412,9 @@ def test_flash_attention_bwd_fallback_matches_ref():
     v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
     for causal in (False, True):
         def loss_p(q, k, v):
-            return (fa._flash_attention(q, k, v, causal) ** 2).sum()
+            return (fa._flash_attention(
+                q, k, v, None, jnp.zeros((1,), jnp.int32), causal, 0.0)
+                ** 2).sum()
 
         def loss_r(q, k, v):
             return (fa._attention_ref(q, k, v, None, causal, 0.0) ** 2).sum()
@@ -436,11 +438,12 @@ def test_flash_attention_causal_cross_window():
     q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
-    o = fa._flash_attention(q, k, v, True)
+    seed0 = jnp.zeros((1,), jnp.int32)
+    o = fa._flash_attention(q, k, v, None, seed0, True, 0.0)
     ref = fa._attention_ref(q, k, v, None, True, 0.0)
     assert np.allclose(np.asarray(o), np.asarray(ref), rtol=1e-4, atol=1e-5)
-    gp = jax.grad(lambda q, k, v: (fa._flash_attention(q, k, v, True) ** 2
-                                   ).sum(), (0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda q, k, v: (fa._flash_attention(
+        q, k, v, None, seed0, True, 0.0) ** 2).sum(), (0, 1, 2))(q, k, v)
     gr = jax.grad(lambda q, k, v: (fa._attention_ref(q, k, v, None, True,
                                                      0.0) ** 2
                                    ).sum(), (0, 1, 2))(q, k, v)
