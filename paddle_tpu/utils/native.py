@@ -20,10 +20,17 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _SRC = os.path.join(_ROOT, "csrc", "pt_runtime.cpp")
 _BUILD_DIR = os.path.join(_ROOT, "csrc", "build")
 _SO = os.path.join(_BUILD_DIR, "libpt_runtime.so")
+# wheel installs ship the prebuilt library inside the package
+_PKG_SO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_native", "libpt_runtime.so")
 
 
 def _build() -> bool:
+    global _SO
     if not os.path.exists(_SRC):
+        if os.path.exists(_PKG_SO):
+            _SO = _PKG_SO
+            return True
         return False
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= \
@@ -37,6 +44,10 @@ def _build() -> bool:
         os.replace(_SO + ".tmp", _SO)
         return True
     except Exception:
+        # toolchain-less env: a wheel-shipped prebuilt still works
+        if os.path.exists(_PKG_SO):
+            _SO = _PKG_SO
+            return True
         return False
 
 

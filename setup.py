@@ -1,7 +1,8 @@
 """Wheel build (reference analog: the reference's setup.py wrapping its
 CMake superbuild — here the native piece is one host-side C++ library,
-csrc/pt_runtime.cpp, compiled at install or lazily at first import by
-paddle_tpu.utils.native)."""
+csrc/pt_runtime.cpp, compiled at build time into paddle_tpu/_native/ so
+wheels ship the .so; paddle_tpu.utils.native falls back to a lazy source
+build when running from a checkout)."""
 import os
 import subprocess
 import sys
@@ -11,18 +12,21 @@ from setuptools.command.build_py import build_py
 
 
 class BuildWithNative(build_py):
-    """Best-effort pre-compile of the native host runtime so wheels ship
-    the .so; falls back to lazy build at import when g++ is absent."""
+    """Pre-compile the native host runtime into the package tree so the
+    wheel ships it; falls back to lazy build at import when g++ is
+    absent."""
 
     def run(self):
-        src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "csrc", "pt_runtime.cpp")
+        root = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(root, "csrc", "pt_runtime.cpp")
         if os.path.exists(src):
-            out = os.path.join(os.path.dirname(src), "libpt_runtime.so")
+            native_dir = os.path.join(root, "paddle_tpu", "_native")
+            os.makedirs(native_dir, exist_ok=True)
+            out = os.path.join(native_dir, "libpt_runtime.so")
             try:
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     src, "-o", out, "-lpthread"],
+                     src, "-o", out, "-lpthread", "-lrt"],
                     check=True, capture_output=True)
                 print(f"built native runtime: {out}")
             except (OSError, subprocess.CalledProcessError) as e:

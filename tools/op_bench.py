@@ -98,7 +98,11 @@ def main():
     out = {"device": str(jax.devices()[0]),
            "backend": jax.default_backend(),
            "ops": {k: round(v, 6) for k, v in results.items()}}
-    path = sys.argv[1] if len(sys.argv) > 1 else "op_bench.json"
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__)
+        sys.exit(0)
+    path = args[0] if args else "op_bench.json"
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
