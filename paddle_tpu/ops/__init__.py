@@ -19,6 +19,7 @@ from . import optim_ops  # registers the optimizer/AMP yaml op surface
 from . import nn_compat  # registers the nn yaml op surface
 from . import yaml_extra  # framework/signal/sequence/moe/quant/... surface
 from . import vision_ops  # detection/roi/yolo surface
+from . import fused_compat  # fused_ops.yaml surface as XLA-fused compositions
 from ..core.tensor import Tensor
 
 _METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search,

@@ -70,7 +70,58 @@ EXCLUSIONS: Dict[str, str] = {
     "correlation": None,   # implemented in vision_ops
     "warprnnt": "CUDA warp-rnnt transducer loss kernel",
     "ctc_align": None,     # implemented in yaml_extra
+    # cuDNN-runtime-fusion artifacts (fused_ops.yaml): kernels whose
+    # signatures are cuDNN execution-plan handles, not math; XLA fuses the
+    # equivalent conv+bn+act compositions automatically
+    "fused_dconv_drelu_dbn": "cuDNN backward-fusion execution plan",
+    "fused_scale_bias_add_relu": "cuDNN runtime fusion plan; "
+                                 "scale*x+bias+add+relu is one XLA fusion",
+    "fused_scale_bias_relu_conv_bn": "cuDNN runtime fusion plan; XLA "
+                                     "fuses conv+bn+act",
+    "gemm_epilogue": "cuBLASLt epilogue handle; matmul+bias+act is one "
+                     "XLA fusion (fc / fused_matmul_bias cover the API)",
+    # oneDNN/LoD-era CPU fusion ops (fusion_*): packed-weight / LoD
+    # sequence layouts from the pre-PIR CPU inference path
+    "fusion_group": "JIT-generated CPU fusion region; XLA owns fusion",
+    "fusion_gru": "oneDNN packed-weight GRU; the `rnn` op covers the math",
+    "fusion_lstm": "oneDNN packed-weight LSTM; the `rnn` op covers it",
+    "fusion_repeated_fc_relu": "oneDNN CPU fusion; fc chain + XLA fusion",
+    "fusion_seqconv_eltadd_relu": "LoD sequence layout CPU fusion",
+    "fusion_seqexpand_concat_fc": "LoD sequence layout CPU fusion",
+    "fusion_seqpool_cvm_concat": "LoD sequence layout CPU fusion",
+    "fusion_squared_mat_sub": "oneDNN CPU fusion; two matmuls + sub is "
+                              "one XLA fusion",
+    "fusion_transpose_flatten_concat": "CPU layout fusion; XLA owns "
+                                       "layout assignment",
+    # CUDA paged-KV serving kernels
+    "blha_get_max_len": "companion of block_multihead_attention_",
+    "block_multihead_attention_": "CUDA paged-KV-cache decoder attention; "
+                                  "the jit.save/Predictor decode path with "
+                                  "dense KV cache covers serving on TPU",
+    "distributed_fused_lamb_init": "CUDA multi-tensor fused LAMB state "
+                                   "init; optimizer.Lamb covers the math",
+    "fused_token_prune": "data-dependent output length (slimmed token "
+                         "set); XLA requires static shapes — masking "
+                         "covers the capability",
 }
+# Baidu-Kunlun (XPU) vendor kernels (fused_ops.yaml *_xpu entries):
+# hardware-specific packed formats with no TPU analog; the base ops cover
+# the math and XLA performs the fusion the XPU runtime hand-codes.
+for _xpu_op in (
+        "add_act_xpu", "add_layernorm_xpu", "addcmul_xpu",
+        "block_multihead_attention_xpu", "bn_act_xpu", "conv1d_xpu",
+        "conv2d_transpose_xpu", "conv2d_xpu", "cross_attention_xpu",
+        "dequantize_xpu", "embedding_with_eltwise_add_xpu",
+        "fast_layernorm_xpu", "fast_where_xpu", "fc_xpu",
+        "fused_multi_transformer_int8_xpu", "fused_multi_transformer_xpu",
+        "generate_sequence_xpu", "group_norm_silu_xpu",
+        "layer_norm_act_xpu", "mask_adaptive_xpu", "multi_encoder_xpu",
+        "pad2d_xpu", "qkv_attention_xpu", "quantize_xpu",
+        "roformer_relative_embedding_xpu", "sequence_unpad_xpu",
+        "sine_pos_xpu", "spatial_transformer_resblock_xpu",
+        "weight_only_linear_xpu", "yolo_box_xpu"):
+    EXCLUSIONS[_xpu_op] = ("XPU (Kunlun) vendor kernel; base ops + XLA "
+                           "fusion cover it")
 EXCLUSIONS = {k: v for k, v in EXCLUSIONS.items() if v is not None}
 
 

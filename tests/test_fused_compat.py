@@ -1,0 +1,254 @@
+"""fused_ops.yaml compat surface (reference: paddle/phi/ops/yaml/
+fused_ops.yaml) — numeric checks of the XLA-fused compositions against
+their unfused references."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # registers everything
+from paddle_tpu.ops import fused_compat as fc
+from paddle_tpu.ops import registry
+
+
+def _r(shape, seed=0, scale=1.0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype(np.float32)) * scale
+
+
+def _ln_ref(x, scale, bias, eps, axis):
+    axes = tuple(range(axis, x.ndim))
+    m = x.mean(axis=axes, keepdims=True)
+    v = x.var(axis=axes, keepdims=True)
+    out = (x - m) / np.sqrt(v + eps)
+    if scale is not None:
+        out = out * scale
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def test_yaml_audit_zero_missing():
+    """Every ops.yaml + fused_ops.yaml + sparse_ops.yaml entry is either
+    registered or a named exclusion."""
+    yaml = pytest.importorskip("yaml")
+    ref = set()
+    for f in ["/root/reference/paddle/phi/ops/yaml/ops.yaml",
+              "/root/reference/paddle/phi/ops/yaml/fused_ops.yaml",
+              "/root/reference/paddle/phi/ops/yaml/sparse_ops.yaml"]:
+        try:
+            docs = yaml.safe_load(open(f))
+        except OSError:
+            pytest.skip("reference tree unavailable")
+        names = [d["op"].split("(")[0].strip() for d in docs]
+        ref |= {("sparse_" + n if "sparse" in f else n) for n in names}
+    reg = set(registry.all_ops())
+    missing = ref - reg - set(registry.EXCLUSIONS)
+    assert not missing, f"unregistered, unexcluded ops: {sorted(missing)}"
+
+
+def test_fused_elementwise_and_activation():
+    x, y = _r((4, 8), 1), _r((4, 8), 2)
+    out = fc.fused_elementwise_add(x, y, fuse_activation="relu")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(np.asarray(x + y), 0), atol=1e-6)
+    out = fc.fused_elementwise_mul(x, y, fused_output_scale=2.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x * y) * 2.0,
+                               rtol=1e-6)
+    out, inter = fc.fused_elemwise_add_activation(
+        x, y, ["relu", "elementwise_add"])
+    np.testing.assert_allclose(np.asarray(out),
+                               np.maximum(np.asarray(x + y), 0), atol=1e-6)
+
+
+def test_fc_and_fc_layernorm():
+    x, w, b = _r((3, 5, 8), 3), _r((8, 6), 4), _r((6,), 5)
+    out = fc.fc(x, w, b, in_num_col_dims=2, activation_type="relu")
+    ref = np.maximum(np.asarray(x) @ np.asarray(w) + np.asarray(b), 0)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+    y = _r((3, 5, 6), 6)
+    scale, bias1 = _r((6,), 7), _r((6,), 8)
+    out, mean, var = fc.fused_fc_elementwise_layernorm(
+        x, w, y, bias0=b, scale=scale, bias1=bias1, x_num_col_dims=2,
+        begin_norm_axis=2)
+    fcref = np.asarray(x) @ np.asarray(w) + np.asarray(b) + np.asarray(y)
+    ref = _ln_ref(fcref, np.asarray(scale), np.asarray(bias1), 1e-5, 2)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_skip_and_residual_layernorms():
+    x, y = _r((2, 4, 8), 9), _r((2, 4, 8), 10)
+    scale, bias = _r((8,), 11), _r((8,), 12)
+    out = fc.skip_layernorm(x, y, scale, bias, epsilon=1e-5)
+    ref = _ln_ref(np.asarray(x + y), np.asarray(scale), np.asarray(bias),
+                  1e-5, 2)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    b = _r((8,), 13)
+    out, resid, mean, var = fc.fused_bias_residual_layernorm(
+        x, bias=b, residual=y, norm_weight=scale, norm_bias=bias,
+        epsilon=1e-5, residual_alpha=0.5, begin_norm_axis=2)
+    h = np.asarray(x) + np.asarray(b) + 0.5 * np.asarray(y)
+    np.testing.assert_allclose(np.asarray(resid), h, atol=1e-5)
+    ref = _ln_ref(h, np.asarray(scale), np.asarray(bias), 1e-5, 2)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+    out, res_out, mask, mean, var = \
+        fc.fused_bias_dropout_residual_layer_norm(
+            x, y, bias=b, ln_scale=scale, ln_bias=bias, dropout_rate=0.0)
+    np.testing.assert_allclose(np.asarray(res_out),
+                               np.asarray(x) + np.asarray(b)
+                               + np.asarray(y), atol=1e-5)
+
+
+def test_fused_embedding_eltwise_layernorm():
+    rng = np.random.RandomState(14)
+    ids = [jnp.asarray(rng.randint(0, 10, (2, 6, 1))) for _ in range(2)]
+    embs = [_r((10, 8), 15), _r((10, 8), 16)]
+    scale, bias = _r((8,), 17), _r((8,), 18)
+    out = fc.fused_embedding_eltwise_layernorm(ids, embs, bias=bias,
+                                               scale=scale)
+    acc = sum(np.asarray(e)[np.asarray(i).reshape(2, 6)]
+              for i, e in zip(ids, embs))
+    ref = _ln_ref(acc, np.asarray(scale), np.asarray(bias), 1e-5, 2)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_fused_linear_param_grad_add():
+    x, dout = _r((4, 6, 8), 19), _r((4, 6, 5), 20)
+    dw0, db0 = _r((8, 5), 21), _r((5,), 22)
+    dw, db = fc.fused_linear_param_grad_add(x, dout, dw0, db0)
+    x2 = np.asarray(x).reshape(-1, 8)
+    d2 = np.asarray(dout).reshape(-1, 5)
+    np.testing.assert_allclose(np.asarray(dw),
+                               np.asarray(dw0) + x2.T @ d2, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db),
+                               np.asarray(db0) + d2.sum(0), atol=1e-4)
+
+
+def test_fused_conv_and_pool():
+    x = _r((1, 3, 8, 8), 23)
+    w = _r((4, 3, 3, 3), 24, 0.3)
+    b = _r((4,), 25)
+    out, extra = fc.fused_conv2d_add_act(x, w, bias=b, paddings=(1, 1),
+                                         activation="relu")
+    assert out.shape == (1, 4, 8, 8)
+    assert float(jnp.min(out)) >= 0.0
+    res = _r((1, 4, 8, 8), 26)
+    out2, _ = fc.fused_conv2d_add_act(x, w, bias=b, residual_data=res,
+                                      paddings=(1, 1), activation="")
+    np.testing.assert_allclose(
+        np.asarray(out2 - res),
+        np.asarray(fc.fused_conv2d_add_act(x, w, bias=b, paddings=(1, 1),
+                                           activation="")[0]), atol=1e-5)
+    pooled, idx = fc.max_pool2d_v2(x, (2, 2), strides=(2, 2))
+    assert pooled.shape == (1, 3, 4, 4)
+
+
+def test_attention_fusions():
+    b, s, h, d = 2, 8, 2, 4
+    q = _r((b, s, h, d), 27)
+    out, softmax_out, rng_state = fc.fused_dot_product_attention(
+        q, q, q, is_causal_masking=True)
+    assert out.shape == (b, s, h, d)
+
+    x = _r((b, s, 3, h, d), 28)
+    out = fc.self_dp_attention(x, alpha=1.0 / np.sqrt(d), head_number=h)
+    assert out.shape == (b, s, h, d)
+
+    hdim = h * d
+    inp = _r((b, s, hdim), 29)
+    w = _r((hdim, 3 * hdim), 30, 0.2)
+    bias = _r((3 * hdim,), 31, 0.1)
+    out = fc.multihead_matmul(inp, w, bias=bias, alpha=1.0 / np.sqrt(d),
+                              head_number=h)
+    assert out.shape == (b, s, hdim)
+
+    # varlen: masked tail keys must not affect earlier queries' outputs
+    qb = _r((b, h, s, d), 32)
+    seq_lens = jnp.asarray([s, s // 2], jnp.int32)
+    out_full = fc.variable_length_memory_efficient_attention(
+        qb, qb, qb, seq_lens, seq_lens, scale=1.0 / np.sqrt(d))
+    ref = fc.variable_length_memory_efficient_attention(
+        qb, qb, qb, jnp.asarray([s, s], jnp.int32),
+        jnp.asarray([s, s], jnp.int32), scale=1.0 / np.sqrt(d))
+    # batch 0 has full length in both: identical
+    np.testing.assert_allclose(np.asarray(out_full[0]), np.asarray(ref[0]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(out_full[1]), np.asarray(ref[1]))
+
+
+def test_fused_rope_and_dropout_add():
+    b, s, h, d = 2, 6, 2, 8
+    q = _r((b, s, h, d), 33)
+    outs = fc.fused_rotary_position_embedding(q)
+    assert outs[0].shape == q.shape
+    # rope preserves per-pair norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(outs[0])), np.linalg.norm(np.asarray(q)),
+        rtol=1e-4)
+
+    x, y = _r((4, 8), 34), _r((4, 8), 35)
+    out, seed_off = fc.fused_dropout_add(x, y, p=0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x + y))
+    out, _ = fc.fused_dropout_add(x, y, p=0.5, is_test=True,
+                                  mode="upscale_in_train")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x + y))
+
+
+def test_add_group_norm_silu():
+    x = _r((2, 8, 4, 4), 36)
+    res = _r((2, 8, 4, 4), 37)
+    scale, bias = _r((8,), 38), _r((8,), 39)
+    out, resid, mean, var = fc.add_group_norm_silu(
+        x, residual=res, scale=scale, bias=bias, groups=4,
+        activation="silu")
+    h = np.asarray(x) + np.asarray(res)
+    np.testing.assert_allclose(np.asarray(resid), h, atol=1e-6)
+    hf = h.reshape(2, 4, 2, -1)
+    m = hf.mean(axis=(2, 3), keepdims=True)
+    v = hf.var(axis=(2, 3), keepdims=True)
+    gn = ((hf - m) / np.sqrt(v + 1e-5)).reshape(h.shape)
+    gn = gn * np.asarray(scale).reshape(1, 8, 1, 1) \
+        + np.asarray(bias).reshape(1, 8, 1, 1)
+    ref = gn / (1 + np.exp(-gn))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+    # yaml default activation="" applies NO activation (reference withSilu
+    # only for "silu")
+    out_noact, _, _, _ = fc.add_group_norm_silu(
+        x, residual=res, scale=scale, bias=bias, groups=4, activation="")
+    np.testing.assert_allclose(np.asarray(out_noact), gn, atol=1e-4)
+
+
+def test_bias_dropout_residual_ln_masks_bias_jointly():
+    """Dropout must apply to (x + bias), not to x alone: with x == -bias
+    the dropout input is exactly zero, so res_out == residual regardless
+    of the mask."""
+    xz = jnp.zeros((4, 16), jnp.float32) - 1.0   # x = -bias
+    residual = _r((4, 16), 41)
+    out, res_out, mask, mean, var = \
+        fc.fused_bias_dropout_residual_layer_norm(
+            xz, residual, bias=jnp.asarray(np.full((16,), 1.0, np.float32)),
+            dropout_rate=0.5, is_test=False,
+            dropout_implementation="upscale_in_train")
+    np.testing.assert_allclose(np.asarray(res_out), np.asarray(residual),
+                               atol=1e-6)
+
+
+def test_max_pool2d_v2_indices_and_nhwc():
+    x = _r((1, 2, 4, 4), 42)
+    out, idx = fc.max_pool2d_v2(x, (2, 2), strides=(2, 2))
+    assert out.shape == (1, 2, 2, 2) and idx.shape == (1, 2, 2, 2)
+    # indices are flat positions within each channel's HW plane
+    xn = np.asarray(x)
+    flat = xn.reshape(1, 2, 16)
+    got = np.take_along_axis(flat, np.asarray(idx).reshape(1, 2, 4),
+                             axis=-1).reshape(out.shape)
+    np.testing.assert_allclose(got, np.asarray(out), atol=1e-6)
+    xh = jnp.moveaxis(x, 1, -1)
+    outh, idxh = fc.max_pool2d_v2(xh, (2, 2), strides=(2, 2),
+                                  data_format="NHWC")
+    np.testing.assert_allclose(np.asarray(jnp.moveaxis(outh, -1, 1)),
+                               np.asarray(out), atol=1e-6)
