@@ -57,8 +57,6 @@ EXCLUSIONS: Dict[str, str] = {
     "yolo_box_post": "TensorRT-deploy companion op",
     "yolo_loss": "training loss kept in model zoo, not op registry",
     "detection_map": "mAP metric with LoD inputs; metric-layer concern",
-    "generate_proposals": "dynamic-shape RPN proposal generation; "
-                          "multiclass_nms3-style static variant planned",
     "flash_attn_unpadded": None,          # implemented (incubate varlen)
     "flash_attn_varlen_qkvpacked": None,  # implemented (incubate varlen)
     "flash_attn_with_sparse_mask": "sparse-mask CUDA layout; dense mask "

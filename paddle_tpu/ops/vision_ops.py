@@ -533,3 +533,75 @@ def _multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
 
     outs, counts = jax.vmap(per_image)(b, s)
     return outs, counts, counts
+
+
+@_reg("generate_proposals", differentiable=False)
+def _generate_proposals(scores, bbox_deltas, im_shape, anchors,
+                        variances=None, pre_nms_top_n=6000,
+                        post_nms_top_n=1000, nms_thresh=0.5, min_size=0.1,
+                        eta=1.0, pixel_offset=True):
+    """RPN proposal generation (reference generate_proposals,
+    phi/kernels/gpu/generate_proposals_kernel.cu). TPU-native static-shape
+    variant: fixed pre/post top-N; outputs rpn_rois [N, post_nms_top_n, 4],
+    rpn_roi_probs [N, post_nms_top_n, 1] and valid counts rpn_rois_num [N]
+    (counts replace the reference's LoD — empty slots are zeroed).
+    Divergence: `eta` (adaptive-NMS threshold decay when eta < 1) is
+    accepted for signature parity but not implemented — NMS runs at the
+    fixed nms_thresh."""
+    s = jnp.asarray(scores, jnp.float32)          # [N, A, H, W]
+    d = jnp.asarray(bbox_deltas, jnp.float32)     # [N, A*4, H, W]
+    N, A, H, W = s.shape
+    anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 4)   # [H*W*A, 4]
+    var = (None if variances is None
+           else jnp.asarray(variances, jnp.float32).reshape(-1, 4))
+    offset = 1.0 if pixel_offset else 0.0
+    bbox_clip = _pymath.log(1000.0 / 16.0)
+    total = A * H * W
+    k_pre = min(pre_nms_top_n if pre_nms_top_n > 0 else total, total)
+    k_post = min(post_nms_top_n if post_nms_top_n > 0 else k_pre, k_pre)
+    msize = max(float(min_size), 1.0)
+
+    def per_image(sc, dl, ims):
+        # [A,H,W] -> [H,W,A] flat to match the anchors' [H,W,A,4] order
+        scf = jnp.transpose(sc, (1, 2, 0)).reshape(-1)
+        dlf = jnp.transpose(dl.reshape(A, 4, H, W),
+                            (2, 3, 0, 1)).reshape(-1, 4)
+        top_sc, top_i = jax.lax.top_k(scf, k_pre)
+        a = anc[top_i]
+        dd = dlf[top_i]
+        if var is not None:
+            dd = dd * var[top_i]
+        w = a[:, 2] - a[:, 0] + offset
+        h = a[:, 3] - a[:, 1] + offset
+        cx = a[:, 0] + 0.5 * w
+        cy = a[:, 1] + 0.5 * h
+        ncx = dd[:, 0] * w + cx
+        ncy = dd[:, 1] * h + cy
+        nw = jnp.exp(jnp.minimum(dd[:, 2], bbox_clip)) * w
+        nh = jnp.exp(jnp.minimum(dd[:, 3], bbox_clip)) * h
+        x1 = ncx - 0.5 * nw
+        y1 = ncy - 0.5 * nh
+        x2 = ncx + 0.5 * nw - offset
+        y2 = ncy + 0.5 * nh - offset
+        imh, imw = ims[0], ims[1]
+        x1 = jnp.clip(x1, 0.0, imw - offset)
+        x2 = jnp.clip(x2, 0.0, imw - offset)
+        y1 = jnp.clip(y1, 0.0, imh - offset)
+        y2 = jnp.clip(y2, 0.0, imh - offset)
+        valid = ((x2 - x1 + offset) >= msize) & ((y2 - y1 + offset) >= msize)
+        sc2 = jnp.where(valid, top_sc, -jnp.inf)
+        order = jnp.argsort(-sc2)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=1)[order]
+        sc3 = sc2[order]
+        keep = _nms(boxes, nms_thresh)[:k_post]    # left-packed, -1 pad
+        sel = jnp.where(keep >= 0, keep, 0)
+        roi = boxes[sel]
+        prob = sc3[sel]
+        ok = (keep >= 0) & jnp.isfinite(prob)
+        roi = jnp.where(ok[:, None], roi, 0.0)
+        prob = jnp.where(ok, prob, 0.0)
+        return roi, prob[:, None], jnp.sum(ok.astype(jnp.int32))
+
+    rois, probs, nums = jax.vmap(per_image)(
+        s, d, jnp.asarray(im_shape, jnp.float32))
+    return rois, probs, nums

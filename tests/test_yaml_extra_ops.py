@@ -293,3 +293,63 @@ def test_edit_gru_unit_and_gru():
     ru, cand, h2 = K("gru_unit")(x, h, w)
     assert np.asarray(h2).shape == (B, H)
     assert np.isfinite(np.asarray(h2)).all()
+
+
+def test_generate_proposals_static_semantics():
+    """RPN proposals (reference generate_proposals): zero deltas must
+    return clipped anchors ranked by score with NMS suppression; tiny
+    anchors are filtered by min_size; counts replace LoD."""
+    A, H, W = 2, 2, 2
+    # anchors laid out [H, W, A, 4]; one tiny anchor (filtered by min_size)
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    step = 10.0
+    for hh in range(H):
+        for ww in range(W):
+            for aa in range(A):
+                x0, y0 = ww * step, hh * step
+                size = 8.0 if not (hh == 1 and ww == 1 and aa == 1) else 0.2
+                anchors[hh, ww, aa] = [x0, y0, x0 + size, y0 + size]
+    scores = np.linspace(0.1, 0.9, A * H * W).astype(np.float32) \
+        .reshape(1, A, H, W)
+    deltas = np.zeros((1, A * 4, H, W), np.float32)
+    im_shape = np.array([[40.0, 40.0]], np.float32)
+    variances = np.ones((H, W, A, 4), np.float32)
+
+    rois, probs, nums = K("generate_proposals")(
+        scores, deltas, im_shape, anchors, variances,
+        pre_nms_top_n=8, post_nms_top_n=8, nms_thresh=0.5, min_size=1.0,
+        pixel_offset=False)
+    rois, probs, nums = (np.asarray(rois), np.asarray(probs),
+                         np.asarray(nums))
+    assert rois.shape == (1, 8, 4) and probs.shape == (1, 8, 1)
+    n = int(nums[0])
+    # per grid cell the two anchors are identical (IoU=1) so NMS keeps one;
+    # the tiny anchor was already dropped by min_size -> 4 cells, 4 rois
+    assert n == 4
+    # ranked by score descending
+    p = probs[0, :n, 0]
+    assert (np.diff(p) <= 1e-6).all()
+    # best proposal = highest-scoring anchor that passes min_size
+    # (zero deltas -> the anchor itself)
+    flat_scores = np.transpose(scores[0], (1, 2, 0)).reshape(-1)
+    flat_anchors = anchors.reshape(-1, 4)
+    sizes = flat_anchors[:, 2] - flat_anchors[:, 0]
+    flat_scores = np.where(sizes >= 1.0, flat_scores, -np.inf)
+    best = flat_anchors[np.argmax(flat_scores)]
+    np.testing.assert_allclose(rois[0, 0], best, atol=1e-5)
+    # padded tail zeroed
+    np.testing.assert_allclose(rois[0, n:], 0.0)
+
+    # overlapping anchors: NMS keeps only the higher-scoring one
+    anchors2 = np.zeros((1, 1, 2, 4), np.float32)
+    anchors2[0, 0, 0] = [0, 0, 10, 10]
+    anchors2[0, 0, 1] = [1, 1, 10, 10]      # IoU ~0.8 with the first
+    sc2 = np.array([0.9, 0.5], np.float32).reshape(1, 2, 1, 1)
+    d2 = np.zeros((1, 8, 1, 1), np.float32)
+    rois2, probs2, nums2 = K("generate_proposals")(
+        sc2, d2, np.array([[20.0, 20.0]], np.float32), anchors2,
+        np.ones_like(anchors2), pre_nms_top_n=2, post_nms_top_n=2,
+        nms_thresh=0.5, min_size=1.0, pixel_offset=False)
+    assert int(np.asarray(nums2)[0]) == 1
+    np.testing.assert_allclose(np.asarray(rois2)[0, 0], [0, 0, 10, 10],
+                               atol=1e-5)
