@@ -44,18 +44,21 @@ def model_flops_per_token(cfg, n_params, seq):
 
 
 def bench_resnet50(on_tpu):
-    """ResNet-50 DP images/sec (BASELINE row 'ResNet-50 ImageNet')."""
+    """ResNet-50 DP images/sec (BASELINE row 'ResNet-50 ImageNet'),
+    amp O2 bf16 regime (conv/matmul on the MXU in bf16, norms fp32)."""
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     if on_tpu:
-        batch, size, steps = 128, 224, 8
+        batch, size, steps = 256, 224, 8
     else:
         batch, size, steps = 4, 64, 2
     paddle.seed(0)
     model = resnet50(num_classes=1000)
+    if on_tpu:
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     opt = paddle.optimizer.Momentum(parameters=model.parameters(),
                                     learning_rate=0.1, momentum=0.9)
     step = TrainStep(model, nn.CrossEntropyLoss(), opt)
@@ -65,11 +68,19 @@ def bench_resnet50(on_tpu):
     x = paddle.to_tensor(rng.randn(batch, 3, size, size)
                          .astype(np.float32))
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
-    loss = step(x, y)
+
+    def call():
+        if on_tpu:
+            with paddle.amp.auto_cast(True, level="O1",
+                                      dtype="bfloat16"):
+                return step(x, y)
+        return step(x, y)
+
+    loss = call()
     jax.device_get(loss._value)
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = step(x, y)
+        loss = call()
     jax.device_get(loss._value)
     dt = time.perf_counter() - t0
     return {"images_per_sec": round(batch * steps / dt, 1),

@@ -272,15 +272,15 @@ def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
         tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
 
     def fn(a, w, *bs):
+        # bf16 operands: the TPU MXU accumulates in f32 internally; an
+        # explicit preferred_element_type=f32 here would make the conv
+        # transpose mix f32 cotangents with bf16 operands (strict-dtype
+        # error under autodiff)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=strides, padding=pad,
             rhs_dilation=dilations, dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if a.dtype == jnp.bfloat16 else None,
         )
-        if a.dtype == jnp.bfloat16:
-            out = out.astype(a.dtype)
         if bs:
             b = bs[0]
             shape = [1] * out.ndim
