@@ -1,2 +1,5 @@
 from . import functional
-from .layer import FusedMultiHeadAttention, FusedFeedForward
+from .layer import (FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
+                    FusedEcMoe, FusedFeedForward, FusedLinear,
+                    FusedMultiHeadAttention, FusedMultiTransformer,
+                    FusedTransformerEncoderLayer)

@@ -300,3 +300,516 @@ def variable_length_memory_efficient_attention(
         return apply(fn, query, key, value, lens,
                      op_name="varlen_attention")
     return apply(fn, query, key, value, op_name="varlen_attention")
+
+
+# ---------------------------------------------------------------------------
+# transformer-block fusions (reference: incubate/nn/functional/
+# fused_transformer.py) — on TPU each is one jnp composition XLA fuses
+# ---------------------------------------------------------------------------
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """layernorm(residual + dropout(x + bias)) — reference
+    incubate/nn/functional/fused_transformer.py:fused_bias_dropout_residual_layer_norm."""
+    from ....ops.registry import get as _get
+
+    kern = _get("fused_bias_dropout_residual_layer_norm").fn
+
+    def fn(xa, ra, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        s = next(it) if ln_scale is not None else None
+        bb = next(it) if ln_bias is not None else None
+        out, _, _, _, _ = kern(xa, ra, bias=b, ln_scale=s, ln_bias=bb,
+                               dropout_rate=dropout_rate,
+                               is_test=not training,
+                               dropout_implementation=mode,
+                               ln_epsilon=ln_epsilon)
+        return out
+
+    args = [x, residual] + [t for t in (bias, ln_scale, ln_bias)
+                            if t is not None]
+    return apply(fn, *args,
+                 op_name="fused_bias_dropout_residual_layer_norm")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1,
+                      add_residual=True, name=None):
+    """linear2(dropout1(act(linear1(maybe_ln(x))))) (+ residual, post-LN) —
+    reference fused_transformer.py:fused_feedforward pseudocode."""
+    from ....nn import functional as F
+
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln1_scale, ln1_bias,
+                           ln1_epsilon)
+    out = F.linear(out, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training, mode=mode)
+    out = F.linear(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type):
+    """Expert-computation MoE: out = sum_e softmax(gate)_e * ffn_e(x)
+    (reference incubate/nn/functional/fused_ec_moe.py; the CUDA kernel's
+    grouped-GEMM becomes one batched einsum the MXU executes directly).
+    bmm0_weight [E, H, I], bmm1_weight [E, I, H]."""
+    assert act_type in ("gelu", "relu")
+
+    def fn(xa, ga, w0, b0, w1, b1):
+        probs = jax.nn.softmax(ga.astype(jnp.float32), axis=-1) \
+            .astype(xa.dtype)                              # [B, S, E]
+        h = jnp.einsum("bsh,ehi->bsei", xa, w0) + b0.reshape(
+            1, 1, w0.shape[0], -1)                         # [B, S, E, I]
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("bsei,eih->bseh", h, w1) + b1.reshape(
+            1, 1, w1.shape[0], -1)                         # [B, S, E, H]
+        return jnp.einsum("bseh,bse->bsh", o, probs)
+
+    return apply(fn, x, gate, bmm0_weight, bmm0_bias, bmm1_weight,
+                 bmm1_bias, op_name="fused_ec_moe")
+
+
+# ---------------------------------------------------------------------------
+# decode-time attention (reference: masked_multihead_attention.py,
+# block_multihead_attention.py, blha_get_max_len.py). TPU-native stance:
+# static-shape dense/paged caches updated by scatter; the CUDA kernels'
+# int8-cache quant knobs are not applicable and must be left None.
+# ---------------------------------------------------------------------------
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """(max encoder len, max decoder len) — reference blha_get_max_len."""
+    def fn(e, d):
+        return jnp.max(e).reshape(1), jnp.max(d).reshape(1)
+
+    return apply(fn, seq_lens_encoder, seq_lens_decoder,
+                 op_name="blha_get_max_len")
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """One decode step of cached self-attention: x is the packed qkv of the
+    new token [B, 3*H*D]; cache_kv [2, B, H, max_seq, D] holds past keys/
+    values; the new k/v are written at each batch row's current length and
+    q attends the filled prefix. Returns (out [B, H*D], cache_kv_out).
+    Quant args (qkv_out_scale/out_shift/out_smooth/out_scale) are the CUDA
+    int8 path and must be None/-1 here."""
+    if qkv_out_scale is not None or out_shift is not None \
+            or out_smooth is not None or (out_scale or -1) > 0:
+        raise NotImplementedError("masked_multihead_attention: int8 cache "
+                                  "quantization is CUDA-specific")
+    if cache_kv is None:
+        raise ValueError("cache_kv is required")
+
+    def fn(xa, cache, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        m = next(it) if src_mask is not None else None
+        lens = next(it) if sequence_lengths is not None else None
+        rot = next(it) if rotary_tensor is not None else None
+        B = xa.shape[0]
+        _, _, H, S, D = cache.shape
+        qkv = xa.reshape(B, 3, H, D)
+        if b is not None:
+            qkv = qkv + b.reshape(1, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]      # [B, H, D]
+        pos = (lens.reshape(B).astype(jnp.int32) if lens is not None
+               else jnp.full((B,), seq_len - 1, jnp.int32))
+        if rot is not None:
+            # rotary_tensor [B, 1, 1, max_seq, D] holds per-position
+            # angles; index each row at ITS write position
+            rr_all = rot.reshape(B, -1, rot.shape[-1])      # [B, max, D]
+            rr = jnp.take_along_axis(
+                rr_all, pos[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]                                       # [B, D]
+            cos, sin = jnp.cos(rr), jnp.sin(rr)
+            def rope(t):
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                rotv = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+                return t * cos[:, None, :] + rotv * sin[:, None, :]
+            q, k = rope(q), rope(k)
+        bi = jnp.arange(B)
+        cache = cache.at[0, bi, :, pos, :].set(k)
+        cache = cache.at[1, bi, :, pos, :].set(v)
+        keys, vals = cache[0], cache[1]                # [B, H, S, D]
+        logits = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                            keys.astype(jnp.float32)) \
+            / jnp.sqrt(jnp.float32(D))
+        valid = jnp.arange(S)[None, :] <= pos[:, None]      # [B, S]
+        logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+        if m is not None:
+            mm = m.reshape(B, 1, -1)[:, :, :S].astype(jnp.float32)
+            if mm.shape[-1] < S:
+                # reference masks cover only the filled prefix; padding
+                # with 0 is safe (tail slots are already -inf-masked)
+                mm = jnp.pad(mm, ((0, 0), (0, 0), (0, S - mm.shape[-1])))
+            logits = logits + mm
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhs,bhsd->bhd", probs,
+                         vals.astype(jnp.float32)).astype(xa.dtype)
+        return out.reshape(B, H * D), cache
+
+    args = [x, cache_kv] + [t for t in (bias, src_mask, sequence_lengths,
+                                        rotary_tensor) if t is not None]
+    return apply(fn, *args, op_name="masked_multihead_attention")
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets,
+                              cum_offsets, cu_seqlens_q, cu_seqlens_k,
+                              block_tables, pre_key_cache=None,
+                              pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              max_enc_len_this_time=None,
+                              max_dec_len_this_time=None, rope_emb=None,
+                              mask=None, tgt_mask=None, max_seq_len=-1,
+                              block_size=64, use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1,
+                              compute_dtype="default"):
+    """Paged-KV-cache attention (reference block_multihead_attention):
+    qkv [token_num, 3*H*D] packs each batch row's tokens this step
+    (prefill rows contribute seq_lens_encoder[b] tokens at positions
+    0..n-1; decode rows one token at position seq_lens_decoder[b]);
+    key_cache/value_cache [num_blocks, H, block_size, D] are page pools
+    indexed by block_tables [B, max_blocks]. New k/v are scattered into
+    their pages, then each token attends its row's filled prefix
+    (causal). Returns (out [token_num, H*D], qkv, key_cache, value_cache).
+    int8 cache quant and pre_caches are CUDA-path-only (must be None)."""
+    if cache_k_quant_scales is not None or use_dynamic_cachekv_quant:
+        raise NotImplementedError("block_multihead_attention: int8 cache "
+                                  "quantization is CUDA-specific")
+    if pre_key_cache is not None:
+        raise NotImplementedError("pre_caches not supported")
+    if mask is not None or tgt_mask is not None:
+        raise NotImplementedError("block_multihead_attention: explicit "
+                                  "masks beyond the built-in causal/"
+                                  "length masking are not supported")
+
+    def fn(qkva, kc, vc, enc, dec, this, cu_q, bt, *rest):
+        it = iter(rest)
+        b = next(it) if qkv_bias is not None else None
+        rope = next(it) if rope_emb is not None else None
+        T = qkva.shape[0]
+        num_blocks, H, bs, D = kc.shape
+        B, max_blocks = bt.shape
+        max_seq = max_blocks * bs
+        if b is not None:
+            qkva = qkva + b.reshape(1, -1)
+        qkv3 = qkva.reshape(T, 3, H, D)
+        q, k, v = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]        # [T, H, D]
+        # token -> (batch, position)
+        tok = jnp.arange(T)
+        t2b = jnp.searchsorted(cu_q[1:], tok, side="right")  # [T]
+        tok_in_seq = tok - cu_q[t2b]
+        start = jnp.where(enc.reshape(-1) > 0, 0, dec.reshape(-1))  # [B]
+        pos = start[t2b] + tok_in_seq                        # [T]
+        if rope is not None:
+            # rope_emb [2, B, 1, max_seq, D] (cos, sin): rotate q/k at
+            # each token's absolute position
+            re = rope.reshape(2, B, -1, rope.shape[-1])
+            cos = re[0][t2b, pos]                            # [T, D]
+            sin = re[1][t2b, pos]
+            half = D // 2
+            cos_h = (cos[..., :half] if cos.shape[-1] == D else cos) \
+                [:, None, :]
+            sin_h = (sin[..., :half] if sin.shape[-1] == D else sin) \
+                [:, None, :]
+
+            def rope_t(t):
+                if use_neox_style:
+                    t1, t2 = t[..., :half], t[..., half:]
+                    return jnp.concatenate(
+                        [t1 * cos_h - t2 * sin_h,
+                         t2 * cos_h + t1 * sin_h], axis=-1)
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                return jnp.stack([t1 * cos_h - t2 * sin_h,
+                                  t2 * cos_h + t1 * sin_h],
+                                 axis=-1).reshape(t.shape)
+
+            q, k = rope_t(q), rope_t(k)
+        # scatter new k/v into pages
+        page = bt[t2b, pos // bs]                            # [T]
+        slot = pos % bs
+        kc = kc.at[page, :, slot, :].set(k)
+        vc = vc.at[page, :, slot, :].set(v)
+        # dense view of each row's cache
+        seqpos = jnp.arange(max_seq)
+        page_of = bt[:, seqpos // bs]                        # [B, max_seq]
+        kd = kc[page_of, :, seqpos[None, :] % bs, :]         # [B, S, H, D]
+        vd = vc[page_of, :, seqpos[None, :] % bs, :]
+        kd = jnp.swapaxes(kd, 1, 2)                          # [B, H, S, D]
+        vd = jnp.swapaxes(vd, 1, 2)
+        logits = jnp.einsum("thd,thsd->ths", q.astype(jnp.float32),
+                            kd[t2b].astype(jnp.float32)) \
+            / jnp.sqrt(jnp.float32(D))
+        valid = seqpos[None, :] <= pos[:, None]              # [T, S]
+        logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("ths,thsd->thd", probs,
+                         vd[t2b].astype(jnp.float32)).astype(qkva.dtype)
+        return out.reshape(T, H * D), qkva, kc, vc
+
+    args = [qkv, key_cache, value_cache, seq_lens_encoder,
+            seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,
+            block_tables] + [t for t in (qkv_bias, rope_emb)
+                             if t is not None]
+    return apply(fn, *args, op_name="block_multihead_attention")
+
+
+def _rope_cos_sin(positions, head_dim):
+    """Default rope angles at absolute `positions` ([S] or [B, S]) ->
+    (cos, sin) of shape [..., head_dim//2]."""
+    half = head_dim // 2
+    inv = 1.0 / (10000.0 ** (
+        jnp.arange(half, dtype=jnp.float32) * 2.0 / head_dim))
+    ang = positions[..., None].astype(jnp.float32) * inv
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope_apply(t, cos, sin, neox):
+    """t [B, S, H, D]; cos/sin [S, D/2] or [B, S, D/2]."""
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos = cos[:, :, None, :].astype(t.dtype)
+    sin = sin[:, :, None, :].astype(t.dtype)
+    d2 = t.shape[-1] // 2
+    if neox:
+        t1, t2 = t[..., :d2], t[..., d2:]
+        return jnp.concatenate([t1 * cos - t2 * sin,
+                                t2 * cos + t1 * sin], axis=-1)
+    t1, t2 = t[..., 0::2], t[..., 1::2]
+    return jnp.stack([t1 * cos - t2 * sin, t2 * cos + t1 * sin],
+                     axis=-1).reshape(t.shape)
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            residual_alpha=1.0, cache_kvs=None,
+                            beam_offset=None, pre_caches=None,
+                            seq_lens=None, rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            rotary_emb_dims=0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1,
+                            norm_type="layernorm",
+                            use_neox_rotary_style=False, gqa_group_size=-1,
+                            name=None):
+    """The whole decoder stack as one call (reference
+    fused_transformer.py:fused_multi_transformer pseudocode): per layer
+    pre/post-LN self-attention (+dense KV cache for decode when
+    `time_step` is given) and the FFN. x: [B, S, H*D]; qkv_weights[i]
+    [3, n_head, D, embed] when trans_qkvw else [embed, 3, n_head, D];
+    cache_kvs[i] [2, B, n_head, max_seq, D]. rotary_embs (optional)
+    [2, B, 1, max_seq, D] (cos, sin) indexed at each token's absolute
+    position; when absent and rotary_emb_dims > 0 the default 10000-base
+    angles are computed at the true positions (time_step offset in
+    decode). seq_lens [B(,1)] gives per-row positions: prefill rows mask
+    keys >= seq_lens[b]; decode rows write/attend at seq_lens[b] instead
+    of the global time_step. Returns out or (out, cache_kvs_out).
+    GQA (gqa_group_size>0), pre_caches and beam_offset are not
+    supported."""
+    from ....nn import functional as F
+
+    if gqa_group_size not in (-1, None):
+        raise NotImplementedError("gqa_group_size: use the model-zoo GQA "
+                                  "attention path")
+    if pre_caches is not None or beam_offset is not None:
+        raise NotImplementedError("pre_caches / beam_offset are not "
+                                  "supported")
+    num_layers = len(qkv_weights)
+
+    def norm(t, scale, bias_):
+        if norm_type == "rmsnorm":
+            return fused_rms_norm(t, scale, bias_, epsilon)
+        return F.layer_norm(t, t.shape[-1], scale, bias_, epsilon)
+
+    B, S, E = x.shape
+    decode = time_step is not None
+    lens = None
+    if seq_lens is not None:
+        lens = (seq_lens._value if isinstance(seq_lens, Tensor)
+                else jnp.asarray(seq_lens)).reshape(-1).astype(jnp.int32)
+
+    # absolute positions of this call's tokens, per row: [B, S]
+    if decode:
+        if lens is not None:
+            base = lens
+        else:
+            ts = (time_step._value.reshape(()).astype(jnp.int32)
+                  if hasattr(time_step, "_value")
+                  else jnp.int32(int(time_step)))
+            base = jnp.full((B,), 1, jnp.int32) * ts
+        positions = base[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    else:
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+
+    # prefill padding mask from seq_lens: additive [B, 1, 1, S]
+    pad_mask = None
+    if not decode and lens is not None:
+        valid = jnp.arange(S, dtype=jnp.int32)[None, :] < lens[:, None]
+        pad_mask = jnp.where(valid, 0.0, -1e30).astype(
+            jnp.float32)[:, None, None, :]
+
+    out = x
+    new_caches = [] if cache_kvs is not None else None
+    for i in range(num_layers):
+        residual = out
+        h = norm(out, ln_scales[i],
+                 ln_biases[i] if ln_biases else None) \
+            if pre_layer_norm else out
+        qw = qkv_weights[i]
+        if len(qw.shape) == 4:
+            n_head = qw.shape[1] if trans_qkvw else qw.shape[2]
+            D = qw.shape[2] if trans_qkvw else qw.shape[3]
+        elif cache_kvs is not None:
+            n_head = cache_kvs[i].shape[2]
+            D = cache_kvs[i].shape[4]
+        else:
+            raise ValueError("pass 4-D qkv weights ([3, n_head, D, E] when "
+                             "trans_qkvw) or cache_kvs to carry the head "
+                             "count")
+        nhd = n_head * D
+        qw3 = qw.reshape([3 * nhd, E]) if trans_qkvw \
+            else qw.reshape([E, 3 * nhd]).transpose([1, 0])
+        qkv = F.linear(h.reshape([B * S, E]), qw3.transpose([1, 0]))
+        qkv = qkv.reshape([B, S, 3, nhd])
+        if qkv_biases:
+            qkv = qkv + qkv_biases[i].reshape([1, 1, 3, nhd])
+        q = qkv[:, :, 0].reshape([B, S, n_head, D])
+        k = qkv[:, :, 1].reshape([B, S, n_head, D])
+        v = qkv[:, :, 2].reshape([B, S, n_head, D])
+        if rotary_embs is not None or rotary_emb_dims > 0:
+            qa, ka = q._value, k._value
+            if rotary_embs is not None:
+                re = (rotary_embs._value
+                      if isinstance(rotary_embs, Tensor) else rotary_embs)
+                re = re.reshape(2, B, -1, re.shape[-1])      # [2,B,max,D]
+                cos = jnp.take_along_axis(
+                    re[0], positions[:, :, None], axis=1)    # [B,S,D]
+                sin = jnp.take_along_axis(
+                    re[1], positions[:, :, None], axis=1)
+                # caller supplies full-D cos/sin; halve for _rope_apply
+                cos = cos[..., : D // 2] if cos.shape[-1] == D else cos
+                sin = sin[..., : D // 2] if sin.shape[-1] == D else sin
+            else:
+                cos, sin = _rope_cos_sin(positions, D)       # [B,S,D/2]
+            qa = _rope_apply(qa, cos, sin, use_neox_rotary_style)
+            ka = _rope_apply(ka, cos, sin, use_neox_rotary_style)
+            q, k = Tensor(qa), Tensor(ka)
+        if decode and cache_kvs is not None:
+            # masked attention over the dense cache, one new token per row
+            cache = cache_kvs[i]
+            ca = cache._value if isinstance(cache, Tensor) else cache
+            pos_rows = positions[:, 0]                       # [B]
+            bi = jnp.arange(B)
+            ca = ca.at[0, bi, :, pos_rows, :].set(
+                jnp.swapaxes(k._value, 1, 2)[:, :, 0])
+            ca = ca.at[1, bi, :, pos_rows, :].set(
+                jnp.swapaxes(v._value, 1, 2)[:, :, 0])
+            keys, vals = ca[0], ca[1]              # [B, H, max_seq, D]
+            qv = jnp.swapaxes(q._value, 1, 2)[:, :, 0]   # [B, H, D]
+            logits = jnp.einsum("bhd,bhsd->bhs", qv.astype(jnp.float32),
+                                keys.astype(jnp.float32)) \
+                / jnp.sqrt(jnp.float32(D))
+            maxs = keys.shape[2]
+            valid = jnp.arange(maxs)[None, :] <= pos_rows[:, None]
+            logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+            if attn_mask is not None:
+                m = (attn_mask._value if isinstance(attn_mask, Tensor)
+                     else jnp.asarray(attn_mask)).astype(jnp.float32)
+                m = m.reshape(m.shape[0], -1)[:, :maxs]
+                if m.shape[-1] < maxs:        # pad: tail already invalid
+                    m = jnp.pad(m, ((0, 0), (0, maxs - m.shape[-1])))
+                logits = logits + m[:, None, :]
+            probs = jax.nn.softmax(logits, axis=-1)
+            att = jnp.einsum("bhs,bhsd->bhd", probs,
+                             vals.astype(jnp.float32))
+            attn_out = Tensor(att.astype(qv.dtype).reshape(B, 1, nhd))
+            new_caches.append(Tensor(ca))
+        else:
+            mask_arg = attn_mask
+            if pad_mask is not None:
+                mask_arg = (Tensor(pad_mask) if mask_arg is None
+                            else mask_arg + Tensor(pad_mask))
+            attn = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask_arg, is_causal=mask_arg is None,
+                dropout_p=dropout_rate, training=training)
+            attn_out = attn.reshape([B, S, nhd])
+            if cache_kvs is not None:
+                ca = cache_kvs[i]._value if isinstance(cache_kvs[i], Tensor) \
+                    else cache_kvs[i]
+                kk = jnp.swapaxes(k._value, 1, 2)    # [B, H, S, D]
+                vv = jnp.swapaxes(v._value, 1, 2)
+                ca = ca.at[0, :, :, :S, :].set(kk)
+                ca = ca.at[1, :, :, :S, :].set(vv)
+                new_caches.append(Tensor(ca))
+        out_w = linear_weights[i]
+        proj = F.linear(attn_out, out_w,
+                        linear_biases[i] if linear_biases else None)
+        proj = F.dropout(proj, dropout_rate, training=training, mode=mode)
+        out = residual * residual_alpha + proj
+        if not pre_layer_norm:
+            out = norm(out, ln_scales[i],
+                       ln_biases[i] if ln_biases else None)
+        residual = out
+        h = norm(out, ffn_ln_scales[i],
+                 ffn_ln_biases[i] if ffn_ln_biases else None) \
+            if pre_layer_norm else out
+        h = F.linear(h, ffn1_weights[i],
+                     ffn1_biases[i] if ffn1_biases else None)
+        h = getattr(F, activation)(h)
+        h = F.dropout(h, dropout_rate, training=training, mode=mode)
+        h = F.linear(h, ffn2_weights[i],
+                     ffn2_biases[i] if ffn2_biases else None)
+        out = residual + h
+        if not pre_layer_norm:
+            out = norm(out, ffn_ln_scales[i],
+                       ffn_ln_biases[i] if ffn_ln_biases else None)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
+
+
+__all__ += ["fused_bias_dropout_residual_layer_norm", "fused_feedforward",
+            "fused_ec_moe", "blha_get_max_len",
+            "masked_multihead_attention", "block_multihead_attention",
+            "fused_multi_transformer"]

@@ -37,3 +37,200 @@ class FusedFeedForward(nn.Layer):
         if not self.normalize_before:
             src = self.norm(src)
         return src
+
+
+class FusedLinear(nn.Layer):
+    """matmul+bias as one fusion — on TPU nn.Linear already is; with
+    transpose_weight=True the weight is held [out, in] and transposed in
+    forward (reference: incubate/nn/layer/fused_linear.py semantics, so
+    converted reference checkpoints load with matching shapes)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        w = self.weight.t() if self.transpose_weight else self.weight
+        return F.linear(x, w, self.bias)
+
+
+class FusedDropoutAdd(nn.Layer):
+    """dropout(x) + y (reference: incubate/nn/layer/fused_dropout_add.py)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as F
+
+        return F.dropout(x, self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(nn.Layer):
+    """layernorm(residual + dropout(x + bias)) (reference:
+    incubate/nn/layer/fused_transformer.py)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self.ln_epsilon = epsilon
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.linear_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, residual):
+        from . import functional as IF
+
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.ln_epsilon,
+            training=self.training)
+
+
+class FusedEcMoe(nn.Layer):
+    """Expert-computation MoE block (reference:
+    incubate/nn/layer/fused_ec_moe.py)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError("act_type must be gelu or relu")
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size])
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], is_bias=True)
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size])
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], is_bias=True)
+
+    def forward(self, x, gate):
+        from . import functional as IF
+
+        return IF.fused_ec_moe(x, gate, self.bmm0_weight, self.bmm0_bias,
+                               self.bmm1_weight, self.bmm1_bias,
+                               self.act_type)
+
+
+class FusedTransformerEncoderLayer(nn.TransformerEncoderLayer):
+    """On TPU the standard encoder layer already runs as one fused XLA
+    computation under jit (reference: incubate/nn/layer/fused_transformer.py
+    FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__(d_model, nhead, dim_feedforward,
+                         dropout=dropout_rate, activation=activation,
+                         attn_dropout=attn_dropout_rate,
+                         act_dropout=act_dropout_rate,
+                         normalize_before=normalize_before,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Whole decoder stack with per-layer weights held as lists
+    (reference: incubate/nn/layer/fused_transformer.py
+    FusedMultiTransformer); forward delegates to
+    functional.fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None,
+                 qkv_bias_attrs=None, linear_weight_attrs=None,
+                 linear_bias_attrs=None, ffn_ln_scale_attrs=None,
+                 ffn_ln_bias_attrs=None, ffn1_weight_attrs=None,
+                 ffn1_bias_attrs=None, ffn2_weight_attrs=None,
+                 ffn2_bias_attrs=None, epsilon=1e-5, residual_alpha=1.0,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1,
+                 norm_type="layernorm", name=None):
+        super().__init__()
+        if num_layers < 0:
+            num_layers = len(qkv_weight_attrs) if qkv_weight_attrs else 1
+        self.num_layers = num_layers
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.residual_alpha = residual_alpha
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.trans_qkvw = trans_qkvw
+        self.norm_type = norm_type
+        mk = self.create_parameter
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        ones = nn.initializer.Constant(1.0)
+        for i in range(num_layers):
+            self.ln_scales.append(mk([embed_dim],
+                                     default_initializer=ones))
+            self.ln_biases.append(mk([embed_dim], is_bias=True))
+            self.qkv_weights.append(mk(
+                [3, num_heads, self.head_dim, embed_dim] if trans_qkvw
+                else [embed_dim, 3, num_heads, self.head_dim]))
+            self.qkv_biases.append(mk([3 * embed_dim], is_bias=True))
+            self.linear_weights.append(mk([embed_dim, embed_dim]))
+            self.linear_biases.append(mk([embed_dim], is_bias=True))
+            self.ffn_ln_scales.append(mk([embed_dim],
+                                         default_initializer=ones))
+            self.ffn_ln_biases.append(mk([embed_dim], is_bias=True))
+            self.ffn1_weights.append(mk([embed_dim, dim_feedforward]))
+            self.ffn1_biases.append(mk([dim_feedforward], is_bias=True))
+            self.ffn2_weights.append(mk([dim_feedforward, embed_dim]))
+            self.ffn2_biases.append(mk([embed_dim], is_bias=True))
+        for name_, lst in [("ln_scales", self.ln_scales),
+                           ("ln_biases", self.ln_biases),
+                           ("qkv_weights", self.qkv_weights),
+                           ("qkv_biases", self.qkv_biases),
+                           ("linear_weights", self.linear_weights),
+                           ("linear_biases", self.linear_biases),
+                           ("ffn_ln_scales", self.ffn_ln_scales),
+                           ("ffn_ln_biases", self.ffn_ln_biases),
+                           ("ffn1_weights", self.ffn1_weights),
+                           ("ffn1_biases", self.ffn1_biases),
+                           ("ffn2_weights", self.ffn2_weights),
+                           ("ffn2_biases", self.ffn2_biases)]:
+            for j, p in enumerate(lst):
+                self.add_parameter(f"{name_}_{j}", p)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        from . import functional as IF
+
+        return IF.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            residual_alpha=self.residual_alpha, cache_kvs=caches,
+            pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, time_step=time_step,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            rotary_emb_dims=rotary_emb_dims, activation=self.activation,
+            training=self.training, trans_qkvw=self.trans_qkvw,
+            norm_type=self.norm_type)

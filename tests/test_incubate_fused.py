@@ -1,0 +1,312 @@
+"""incubate.nn fused transformer / serving surface (reference:
+python/paddle/incubate/nn/{functional,layer}) — numeric checks against
+unfused compositions, and prefill/decode cache-consistency for the
+decode-time attention ops."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.incubate.nn as inn
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale) \
+        .astype(np.float32)
+
+
+def test_fused_feedforward_matches_composition():
+    B, S, E, Ff = 2, 4, 8, 16
+    x = _r((B, S, E), 1)
+    w1, b1 = _r((E, Ff), 2), _r((Ff,), 3)
+    w2, b2 = _r((Ff, E), 4), _r((E,), 5)
+    s1, sb1 = np.ones(E, np.float32), np.zeros(E, np.float32)
+    out = IF.fused_feedforward(
+        _t(x), _t(w1), _t(w2), _t(b1), _t(b2), _t(s1), _t(sb1),
+        _t(s1), _t(sb1), dropout1_rate=0.0, dropout2_rate=0.0,
+        activation="relu", pre_layer_norm=True).numpy()
+    # manual: pre-LN -> ffn -> +residual
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    h = (x - m) / np.sqrt(v + 1e-5)
+    ref = x + np.maximum(h @ w1 + b1, 0) @ w2 + b2
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_fused_ec_moe_matches_loop():
+    B, S, H, I, E = 2, 3, 4, 8, 3
+    x = _r((B, S, H), 6)
+    gate = _r((B, S, E), 7)
+    w0, b0 = _r((E, H, I), 8), _r((E, 1, I), 9)
+    w1, b1 = _r((E, I, H), 10), _r((E, 1, H), 11)
+    out = IF.fused_ec_moe(_t(x), _t(gate), _t(w0), _t(b0), _t(w1), _t(b1),
+                          "relu").numpy()
+    probs = np.exp(gate) / np.exp(gate).sum(-1, keepdims=True)
+    ref = np.zeros_like(x)
+    for e in range(E):
+        h = np.maximum(x @ w0[e] + b0[e], 0)
+        ref += (h @ w1[e] + b1[e]) * probs[..., e:e + 1]
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_masked_multihead_attention_matches_full():
+    """Decode step over a cache must equal the last row of full
+    attention over the same sequence."""
+    B, H, D, prefill = 2, 2, 4, 5
+    max_seq = 8
+    rng = np.random.RandomState(12)
+    ks = rng.randn(B, H, prefill, D).astype(np.float32)
+    vs = rng.randn(B, H, prefill, D).astype(np.float32)
+    cache = np.zeros((2, B, H, max_seq, D), np.float32)
+    cache[0, :, :, :prefill] = ks
+    cache[1, :, :, :prefill] = vs
+    qkv_new = rng.randn(B, 3 * H * D).astype(np.float32)
+    lens = np.full((B, 1), prefill, np.int32)   # write at position 5
+    out, cache_out = IF.masked_multihead_attention(
+        _t(qkv_new), _t(cache), sequence_lengths=_t(lens))
+    out = out.numpy()
+    # reference: full attention over 6 positions
+    new = qkv_new.reshape(B, 3, H, D)
+    kfull = np.concatenate([ks, new[:, 1][:, :, None]], axis=2)
+    vfull = np.concatenate([vs, new[:, 2][:, :, None]], axis=2)
+    q = new[:, 0]
+    logits = np.einsum("bhd,bhsd->bhs", q, kfull) / np.sqrt(D)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhs,bhsd->bhd", p, vfull).reshape(B, H * D)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+    # cache updated in the right slot
+    np.testing.assert_allclose(np.asarray(cache_out.numpy())
+                               [0, :, :, prefill], new[:, 1], atol=1e-6)
+
+
+def test_block_multihead_attention_prefill_then_decode():
+    B, H, D = 2, 2, 4
+    block_size, max_blocks = 4, 3
+    num_blocks = B * max_blocks
+    rng = np.random.RandomState(13)
+    prefill = 5
+
+    key_cache = np.zeros((num_blocks, H, block_size, D), np.float32)
+    value_cache = np.zeros_like(key_cache)
+    block_tables = np.arange(num_blocks, dtype=np.int32) \
+        .reshape(B, max_blocks)
+
+    # ---- prefill phase: each row has `prefill` tokens
+    T = B * prefill
+    qkv = rng.randn(T, 3 * H * D).astype(np.float32)
+    cu = np.arange(B + 1, dtype=np.int32) * prefill
+    enc = np.full((B, 1), prefill, np.int32)
+    dec = np.zeros((B, 1), np.int32)
+    this = np.full((B, 1), prefill, np.int32)
+    out, _, kc, vc = IF.block_multihead_attention(
+        _t(qkv), _t(key_cache), _t(value_cache), _t(enc), _t(dec),
+        _t(this), None, None, _t(cu), _t(cu), _t(block_tables),
+        block_size=block_size)
+    out = out.numpy()
+    kc, vc = kc.numpy(), vc.numpy()
+
+    # numpy reference: causal attention within each row
+    q3 = qkv.reshape(B, prefill, 3, H, D)
+    for b in range(B):
+        q, k, v = q3[b, :, 0], q3[b, :, 1], q3[b, :, 2]   # [S, H, D]
+        logits = np.einsum("shd,thd->hst", q, k) / np.sqrt(D)
+        causal = np.tril(np.ones((prefill, prefill), bool))
+        logits = np.where(causal[None], logits, -np.inf)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hst,thd->shd", p, v).reshape(prefill, H * D)
+        np.testing.assert_allclose(out[b * prefill:(b + 1) * prefill],
+                                   ref, atol=1e-4)
+        # pages hold the keys
+        for pos in range(prefill):
+            pg = block_tables[b, pos // block_size]
+            np.testing.assert_allclose(kc[pg, :, pos % block_size],
+                                       k[pos], atol=1e-6)
+
+    # ---- decode phase: one new token per row at position `prefill`
+    qkv_d = rng.randn(B, 3 * H * D).astype(np.float32)
+    cu_d = np.arange(B + 1, dtype=np.int32)
+    enc_d = np.zeros((B, 1), np.int32)
+    dec_d = np.full((B, 1), prefill, np.int32)
+    out_d, _, kc2, vc2 = IF.block_multihead_attention(
+        _t(qkv_d), _t(kc), _t(vc), _t(enc_d), _t(dec_d),
+        _t(np.ones((B, 1), np.int32)), None, None, _t(cu_d), _t(cu_d),
+        _t(block_tables), block_size=block_size)
+    out_d = out_d.numpy()
+    new = qkv_d.reshape(B, 3, H, D)
+    for b in range(B):
+        kfull = np.concatenate([q3[b, :, 1],
+                                new[b, 1][None]], axis=0)   # [S+1, H, D]
+        vfull = np.concatenate([q3[b, :, 2], new[b, 2][None]], axis=0)
+        q = new[b, 0]
+        logits = np.einsum("hd,thd->ht", q, kfull) / np.sqrt(D)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("ht,thd->hd", p, vfull).reshape(H * D)
+        np.testing.assert_allclose(out_d[b], ref, atol=1e-4)
+
+
+def test_blha_get_max_len():
+    e = np.array([3, 7, 2], np.int32)
+    d = np.array([1, 0, 9], np.int32)
+    me, md = IF.blha_get_max_len(_t(e), _t(d), 3)
+    assert int(me.numpy()[0]) == 7 and int(md.numpy()[0]) == 9
+
+
+def test_fused_multi_transformer_prefill_decode_consistency():
+    """Running S tokens through the stack, then decoding token S+1 with
+    the cache, must match running S+1 tokens stateless."""
+    paddle.seed(0)
+    B, E, heads, Ff, L = 2, 16, 2, 32, 2
+    S, max_seq = 4, 8
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=L)
+    layer.eval()
+    rng = np.random.RandomState(14)
+    x_all = rng.randn(B, S + 1, E).astype(np.float32)
+
+    caches = [paddle.to_tensor(
+        np.zeros((2, B, heads, max_seq, E // heads), np.float32))
+        for _ in range(L)]
+    out_prefill, caches = layer(_t(x_all[:, :S]), caches=caches)
+    out_dec, caches = layer(_t(x_all[:, S:S + 1]), caches=caches,
+                            time_step=S)
+    out_full = layer(_t(x_all))
+    np.testing.assert_allclose(out_dec.numpy()[:, 0],
+                               out_full.numpy()[:, S], atol=2e-4)
+    np.testing.assert_allclose(out_prefill.numpy(),
+                               out_full.numpy()[:, :S], atol=2e-4)
+
+
+def test_fused_layer_classes():
+    paddle.seed(0)
+    x = _t(_r((2, 4, 8), 15))
+    lin = inn.FusedLinear(8, 8)
+    assert tuple(lin(x).shape) == (2, 4, 8)
+    da = inn.FusedDropoutAdd(p=0.0)
+    np.testing.assert_allclose(da(x, x).numpy(), 2 * x.numpy(), atol=1e-6)
+    bdr = inn.FusedBiasDropoutResidualLayerNorm(8, dropout_rate=0.0)
+    out = bdr(x, x)
+    assert tuple(out.shape) == (2, 4, 8)
+    assert abs(float(out.numpy().mean())) < 0.2   # layernormed
+    moe = inn.FusedEcMoe(8, 16, 4, act_type="gelu")
+    gate = _t(_r((2, 4, 4), 16))
+    assert tuple(moe(x, gate).shape) == (2, 4, 8)
+    enc = inn.FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+    assert tuple(enc(x).shape) == (2, 4, 8)
+
+
+def test_fused_bias_dropout_residual_layer_norm_functional():
+    x, res = _r((2, 4, 8), 17), _r((2, 4, 8), 18)
+    bias = _r((8,), 19)
+    scale = np.ones(8, np.float32)
+    out = IF.fused_bias_dropout_residual_layer_norm(
+        _t(x), _t(res), _t(bias), _t(scale),
+        _t(np.zeros(8, np.float32)), dropout_rate=0.0).numpy()
+    h = x + bias + res
+    m = h.mean(-1, keepdims=True)
+    v = h.var(-1, keepdims=True)
+    ref = (h - m) / np.sqrt(v + 1e-5)
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_fused_multi_transformer_rope_positions_consistent():
+    """With rotary enabled, prefill-then-decode must still match the
+    stateless forward — i.e. decode tokens get position time_step
+    rotations, not position 0."""
+    paddle.seed(1)
+    B, E, heads, Ff, L = 1, 16, 2, 32, 1
+    S, max_seq = 4, 8
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=L)
+    layer.eval()
+    rng = np.random.RandomState(20)
+    x_all = rng.randn(B, S + 1, E).astype(np.float32)
+
+    def fwd(x, caches=None, time_step=None):
+        return IF.fused_multi_transformer(
+            x, layer.ln_scales, layer.ln_biases, layer.qkv_weights,
+            layer.qkv_biases, layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases, layer.ffn1_weights,
+            layer.ffn1_biases, layer.ffn2_weights, layer.ffn2_biases,
+            cache_kvs=caches, time_step=time_step, rotary_emb_dims=1,
+            training=False)
+
+    caches = [paddle.to_tensor(
+        np.zeros((2, B, heads, max_seq, E // heads), np.float32))]
+    _, caches = fwd(_t(x_all[:, :S]), caches=caches)
+    out_dec, _ = fwd(_t(x_all[:, S:S + 1]), caches=caches, time_step=S)
+    out_full = fwd(_t(x_all))
+    np.testing.assert_allclose(out_dec.numpy()[:, 0],
+                               out_full.numpy()[:, S], atol=2e-4)
+
+
+def test_fused_multi_transformer_seq_lens_masks_padding():
+    """Prefill with per-row seq_lens: a row's output at valid positions
+    must not change when the pad tail's contents change."""
+    paddle.seed(2)
+    B, E, heads, Ff = 2, 16, 2, 32
+    S = 6
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=1)
+    layer.eval()
+    rng = np.random.RandomState(21)
+    x = rng.randn(B, S, E).astype(np.float32)
+    lens = np.array([4, 6], np.int32)
+    x2 = x.copy()
+    x2[0, 4:] = rng.randn(2, E)          # change row 0's pad tail
+
+    def fwd(a):
+        return IF.fused_multi_transformer(
+            _t(a), layer.ln_scales, layer.ln_biases, layer.qkv_weights,
+            layer.qkv_biases, layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases, layer.ffn1_weights,
+            layer.ffn1_biases, layer.ffn2_weights, layer.ffn2_biases,
+            seq_lens=_t(lens), training=False).numpy()
+
+    np.testing.assert_allclose(fwd(x)[0, :4], fwd(x2)[0, :4], atol=1e-5)
+
+
+def test_mmha_short_src_mask_and_rowwise_rotary():
+    B, H, D, max_seq = 2, 2, 4, 8
+    rng = np.random.RandomState(22)
+    cache = np.zeros((2, B, H, max_seq, D), np.float32)
+    cache[0, :, :, :3] = rng.randn(B, H, 3, D)
+    cache[1, :, :, :3] = rng.randn(B, H, 3, D)
+    qkv = rng.randn(B, 3 * H * D).astype(np.float32)
+    lens = np.full((B, 1), 3, np.int32)
+    # reference-shaped mask covering only the filled prefix (4 < max_seq)
+    m = np.zeros((B, 1, 1, 4), np.float32)
+    out, _ = IF.masked_multihead_attention(
+        _t(qkv), _t(cache), src_mask=_t(m), sequence_lengths=_t(lens))
+    assert np.isfinite(out.numpy()).all()
+    # per-row rotary: rows with different positions get different rotations
+    rot = np.tile(np.linspace(0, 1, max_seq)[None, None, None, :, None],
+                  (B, 1, 1, 1, D)).astype(np.float32)
+    lens2 = np.array([[2], [5]], np.int32)
+    out2, _ = IF.masked_multihead_attention(
+        _t(qkv), _t(cache), sequence_lengths=_t(lens2),
+        rotary_tensor=_t(rot))
+    assert np.isfinite(out2.numpy()).all()
+
+
+def test_fused_linear_transpose_weight():
+    paddle.seed(3)
+    lin = inn.FusedLinear(8, 4, transpose_weight=True)
+    assert tuple(lin.weight.shape) == (4, 8)
+    x = _t(_r((2, 8), 23))
+    ref = x.numpy() @ lin.weight.numpy().T + lin.bias.numpy()
+    np.testing.assert_allclose(lin(x).numpy(), ref, atol=1e-5)
+
+
+def test_block_mha_raises_on_unsupported():
+    with pytest.raises(NotImplementedError):
+        IF.block_multihead_attention(
+            None, None, None, None, None, None, None, None, None, None,
+            None, mask=_t(np.zeros((1, 1), np.float32)))
