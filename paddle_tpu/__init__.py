@@ -99,7 +99,36 @@ def is_compiled_with_custom_device(device_type: str = "tpu"):
 def in_dynamic_mode():
     from .jit import api as _jit_api
 
-    return not _jit_api.in_to_static_tracing()
+    return not (_static_mode or _jit_api.in_to_static_tracing())
+
+
+# reference enable_static/disable_static: this framework is always-eager
+# (define-by-run over XLA); the static.Program record-replay subsystem
+# provides the static-graph capability without a global mode switch, so
+# the mode flips only affect what in_dynamic_mode() reports for
+# compat-gated user code.
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+from .nn import ParamAttr  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+from .core.place import CUDAPinnedPlace  # noqa: E402,F401
+from . import base  # noqa: E402,F401
+from . import tensor  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401
+from . import pir  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
 
 
 def grad(*args, **kwargs):

@@ -1,0 +1,48 @@
+"""paddle.base compat namespace (reference: python/paddle/base/ — the
+renamed fluid package). Legacy scripts reach here for core handles,
+dygraph guards and executor plumbing; everything maps onto the eager
+runtime."""
+from . import framework
+from .core import dispatch as _dispatch
+from .core.place import CPUPlace, CUDAPlace, Place
+from .core.tensor import Tensor
+from .framework import random as _random
+from .static import (Executor, Program, default_main_program,
+                     default_startup_program, global_scope, program_guard,
+                     scope_guard)
+
+
+class core:
+    """base.core shim: the symbols legacy code most commonly touches."""
+
+    CPUPlace = CPUPlace
+    CUDAPlace = CUDAPlace
+    Place = Place
+
+    class VarDesc:
+        class VarType:
+            FP32 = "float32"
+            FP16 = "float16"
+            BF16 = "bfloat16"
+            INT32 = "int32"
+            INT64 = "int64"
+            BOOL = "bool"
+
+
+def dygraph_guard(place=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield
+
+    return guard()
+
+
+guard = dygraph_guard
+
+
+def in_dygraph_mode():
+    from . import in_dynamic_mode
+
+    return in_dynamic_mode()

@@ -60,6 +60,12 @@ def TPUPlace(device_id: int = 0) -> Place:
 
 
 # Accept Fleet-style scripts that ask for an accelerator by its CUDA name.
+def CUDAPinnedPlace() -> Place:
+    """Pinned-host-memory place (reference CUDAPinnedPlace): on TPU, host
+    staging buffers are managed by PJRT; maps to the host place."""
+    return Place("cpu", 0)
+
+
 def CUDAPlace(device_id: int = 0) -> Place:
     return TPUPlace(device_id)
 
@@ -135,3 +141,22 @@ def place_of(array) -> Place:
     if dev.platform == "cpu":
         return Place("cpu", dev.id)
     return Place("tpu", dev.id)
+
+
+_RELAY_LIMITED = None
+
+
+def backend_lacks_complex() -> bool:
+    """True on backends with no complex-dtype/FFT support (the axon TPU
+    relay). Single cached probe shared by tensor placement and the fft
+    host fallback."""
+    global _RELAY_LIMITED
+    if _RELAY_LIMITED is None:
+        try:
+            import jax as _jax
+
+            ver = _jax.devices()[0].client.platform_version
+        except Exception:
+            ver = ""
+        _RELAY_LIMITED = "axon" in ver.lower()
+    return _RELAY_LIMITED

@@ -51,7 +51,16 @@ def _to_array(data, dtype=None, place: Optional[Place] = None):
             npdata = npdata.astype(np.float32)
         elif npdata.dtype == np.int64:
             npdata = npdata.astype(np.int32)
-        arr = jnp.asarray(npdata)
+        from .place import backend_lacks_complex
+
+        if np.issubdtype(npdata.dtype, np.complexfloating) \
+                and backend_lacks_complex():
+            # the axon TPU relay has no complex support at all: complex
+            # tensors live host-side (same policy as the fft fallback);
+            # device_put straight from numpy so no axon array is created
+            arr = jax.device_put(npdata, jax.devices("cpu")[0])
+        else:
+            arr = jnp.asarray(npdata)
     if place is not None:
         dev = to_jax_device(place)
         if not isinstance(arr, jax.core.Tracer) and dev is not None:

@@ -1,0 +1,60 @@
+"""paddle.dataset compat (reference: python/paddle/dataset/ — the legacy
+downloadable-dataset readers). Thin reader-style adapters over the io/
+vision/text dataset classes; network downloads are out (no egress), so
+each reader synthesizes deterministic data with the documented shapes
+when the on-disk files are absent — the same contract the tests use."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _synthetic_reader(make, n):
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(n):
+            yield make(rng)
+
+    return reader
+
+
+class uci_housing:
+    feature_num = 13
+
+    @staticmethod
+    def train(n=404):
+        return _synthetic_reader(
+            lambda rng: (rng.randn(13).astype(np.float32),
+                         rng.randn(1).astype(np.float32)), n)
+
+    @staticmethod
+    def test(n=102):
+        return uci_housing.train(n)
+
+
+class mnist:
+    @staticmethod
+    def train(n=256):
+        return _synthetic_reader(
+            lambda rng: (rng.rand(784).astype(np.float32) * 2 - 1,
+                         int(rng.randint(0, 10))), n)
+
+    @staticmethod
+    def test(n=64):
+        return mnist.train(n)
+
+
+class imdb:
+    @staticmethod
+    def word_dict():
+        return {f"w{i}": i for i in range(128)}
+
+    @staticmethod
+    def train(word_idx, n=128):
+        v = len(word_idx)
+        return _synthetic_reader(
+            lambda rng: (rng.randint(0, v, rng.randint(5, 40)).tolist(),
+                         int(rng.randint(0, 2))), n)
+
+    @staticmethod
+    def test(word_idx, n=32):
+        return imdb.train(word_idx, n)

@@ -51,3 +51,280 @@ def get_trainer_endpoints():
 
 def get_current_endpoint():
     return ParallelEnv().current_endpoint
+
+
+# ---------------------------------------------------------------------------
+# remaining reference distributed/__init__.py surface
+# ---------------------------------------------------------------------------
+
+alltoall = all_to_all
+alltoall_single = all_to_all_single
+
+
+def is_available():
+    """reference distributed.is_available: collectives are always built
+    into this framework (XLA collectives + TCP transport)."""
+    return True
+
+
+class ParallelMode:
+    """reference fleet ParallelMode enum."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """reference auto_parallel ReduceType (partial-placement reduce kind)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ShardingStage1:
+    """Marker for dist.to_static sharding optimization level (reference
+    distributed/auto_parallel/strategy.py ShardingStage1)."""
+
+    def __init__(self, mesh_dim=None):
+        self.mesh_dim = mesh_dim
+        self.stage = 1
+
+
+class ShardingStage2(ShardingStage1):
+    def __init__(self, mesh_dim=None):
+        super().__init__(mesh_dim)
+        self.stage = 2
+
+
+class ShardingStage3(ShardingStage1):
+    def __init__(self, mesh_dim=None):
+        super().__init__(mesh_dim)
+        self.stage = 3
+
+
+class Strategy:
+    """reference auto_parallel Strategy: config bag for dist.to_static
+    (sharding/gradient_merge/pipeline sub-configs as attribute bags)."""
+
+    class _Bag:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        cfg = config or {}
+
+        def bag(key, **defaults):
+            merged = dict(defaults)
+            merged.update(cfg.get(key, {}))
+            return Strategy._Bag(**merged)
+
+        self.sharding = bag("sharding", enable=False, degree=1, stage=1)
+        self.gradient_merge = bag("gradient_merge", enable=False,
+                                  k_steps=1, avg=True)
+        self.pipeline = bag("pipeline", enable=False,
+                            schedule_mode="1F1B", micro_batch_size=1,
+                            accumulate_steps=1)
+        self.amp = bag("amp", enable=False, dtype="bfloat16", level="O1")
+
+
+class DistAttr:
+    """reference DistAttr(mesh, sharding_specs): legacy spec form mapped
+    onto the Placement API."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        out = []
+        for dim_name in getattr(self.process_mesh, "dim_names",
+                                [None] * 1):
+            try:
+                idx = self.sharding_specs.index(dim_name)
+                out.append(Shard(idx))
+            except ValueError:
+                out.append(Replicate())
+        return out
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference distributed.split: build a row/column-parallel linear or
+    parallel embedding over the model-parallel group."""
+    from .meta_parallel import mp_layers as _mp
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = _mp.RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                input_is_parallel=False, has_bias=bias_attr is not False)
+        else:
+            layer = _mp.ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                gather_output=gather_out,
+                has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        n, dim = size
+        layer = _mp.VocabParallelEmbedding(n, dim, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unsupported operation {operation}")
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset=False):
+    """reference auto_parallel shard_dataloader: on the single-controller
+    runtime every process sees the global loader; batches are sharded by
+    the step function's input placements, so the loader passes through."""
+    return dataloader
+
+
+def shard_scaler(scaler):
+    """reference auto_parallel shard_scaler: GradScaler state is replicated
+    under GSPMD, no transformation needed."""
+    return scaler
+
+
+from .auto_parallel.api import to_static as _ap_to_static  # noqa: E402
+
+
+def _dist_model(*args, **kwargs):
+    return _ap_to_static(*args, **kwargs)
+
+
+DistModel = _dist_model
+
+
+class _EntryBase:
+    """PS sparse-table entry configs (reference distributed/entry_attr.py):
+    admission rules for sparse feature rows."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_EntryBase):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ShowClickEntry(_EntryBase):
+    def __init__(self, show_name, click_name):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+class ProbabilityEntry(_EntryBase):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class InMemoryDataset:
+    """reference fleet InMemoryDataset (PS data feed): an in-memory sample
+    store with shuffle, backed by the io layer."""
+
+    def __init__(self):
+        self._samples = []
+        self._parse_fn = None
+        self._batch_size = 1
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             **kwargs):
+        self._batch_size = batch_size
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._samples = []
+        for f in getattr(self, "_files", []):
+            with open(f) as fh:
+                self._samples.extend(line.rstrip("\n") for line in fh)
+
+    def local_shuffle(self):
+        import random
+
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+
+class QueueDataset(InMemoryDataset):
+    """reference QueueDataset: streaming variant — file-backed iteration
+    without load_into_memory."""
+
+    def load_into_memory(self):
+        raise RuntimeError("QueueDataset streams from files; use "
+                           "set_filelist + iteration")
+
+    def __iter__(self):
+        for f in getattr(self, "_files", []):
+            with open(f) as fh:
+                yield from (line.rstrip("\n") for line in fh)
+
+
+from . import io  # noqa: E402,F401
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_* trio: CPU-barrier service for PS heterogenous
+    jobs. The TCPStore provides the same rendezvous+barrier contract."""
+    global _gloo_store
+    from .store import TCPStore
+
+    host, port = server_endpoint.split(":")
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+    _gloo_store._gloo_rank = rank_id
+    _gloo_store._gloo_world = rank_num
+
+
+def gloo_barrier():
+    global _gloo_generation
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_generation += 1
+    _gloo_store.barrier(f"gloo_barrier_{_gloo_generation}",
+                        _gloo_store._gloo_world)
+
+
+_gloo_generation = 0
+
+
+def gloo_release():
+    global _gloo_store
+    if _gloo_store is not None:
+        close = getattr(_gloo_store, "close", None)
+        if close:
+            close()
+        _gloo_store = None
+
+
+_gloo_store = None

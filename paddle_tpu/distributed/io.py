@@ -1,0 +1,33 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistables save/load for the PS/static path)."""
+from __future__ import annotations
+
+import os
+
+
+def is_persistable(var):
+    return getattr(var, "persistable", True)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a program's parameters (reference io.save_persistables)."""
+    from ..framework import io as _io
+    from ..static import default_main_program
+
+    prog = main_program or default_main_program()
+    params = getattr(prog, "_params", {})
+    os.makedirs(dirname, exist_ok=True)
+    _io.save(dict(params), os.path.join(dirname,
+                                        filename or "__params__.pdparams"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..framework import io as _io
+    from ..static import default_main_program
+
+    path = os.path.join(dirname, filename or "__params__.pdparams")
+    state = _io.load(path)
+    prog = main_program or default_main_program()
+    if hasattr(prog, "_params"):
+        prog._params.update(state)
+    return state

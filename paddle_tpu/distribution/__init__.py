@@ -513,3 +513,245 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+class Chi2(Gamma):
+    """Chi-squared (reference distribution/chi2.py): Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _arr(df)
+        super().__init__(self.df / 2.0, 0.5 * jnp.ones_like(_arr(df)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.asarray(self.df))
+
+    @property
+    def variance(self):
+        return Tensor(2.0 * jnp.asarray(self.df))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference distribution/continuous_bernoulli.py: the [0,1]-supported
+    exponential-family relaxation of Bernoulli with natural parameter
+    logit(probability)."""
+
+    def __init__(self, probability, lims=(0.499, 0.501), name=None):
+        self.probs_ = jnp.clip(_arr(probability), 1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(np.shape(self.probs_))
+
+    def _cont_bern_log_norm(self):
+        p = self.probs_
+        cut_lo, cut_hi = self._lims
+        safe = jnp.where((p < cut_lo) | (p > cut_hi), p, 0.4)
+        log_norm = jnp.log(jnp.abs(
+            jnp.log1p(-safe) - jnp.log(safe))) \
+            - jnp.log(jnp.abs(1 - 2 * safe))
+        # taylor expansion around p = 1/2
+        x = p - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where((p < cut_lo) | (p > cut_hi), log_norm, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs_
+        cut_lo, cut_hi = self._lims
+        safe = jnp.where((p < cut_lo) | (p > cut_hi), p, 0.4)
+        m = safe / (2.0 * safe - 1.0) \
+            + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * safe))
+        x = p - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return Tensor(jnp.where((p < cut_lo) | (p > cut_hi), m, taylor))
+
+    def sample(self, shape=()):
+        # inverse-CDF sampling
+        u = jax.random.uniform(next_key(),
+                               tuple(shape) + self._batch_shape)
+        p = self.probs_
+        cut_lo, cut_hi = self._lims
+        safe = jnp.where((p < cut_lo) | (p > cut_hi), p, 0.4)
+        icdf = (jnp.log1p(u * (2.0 * safe - 1.0) / (1.0 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where((p < cut_lo) | (p > cut_hi), icdf, u))
+
+    def log_prob(self, value):
+        def fn(v):
+            p = self.probs_
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._cont_bern_log_norm())
+
+        return apply(fn, value, op_name="cont_bernoulli_log_prob")
+
+    def entropy(self):
+        m = self.mean._value
+        p = self.probs_
+        return Tensor(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                        + self._cont_bern_log_norm()))
+
+
+class MultivariateNormal(Distribution):
+    """reference distribution/multivariate_normal.py: parameterized by loc
+    and one of covariance_matrix / precision_matrix / scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _arr(loc)
+        if scale_tril is not None:
+            self._scale_tril = _arr(scale_tril)
+            self.covariance_matrix = self._scale_tril @ jnp.swapaxes(
+                self._scale_tril, -1, -2)
+        elif covariance_matrix is not None:
+            self.covariance_matrix = _arr(covariance_matrix)
+            self._scale_tril = jnp.linalg.cholesky(self.covariance_matrix)
+        elif precision_matrix is not None:
+            self.precision_matrix = _arr(precision_matrix)
+            self.covariance_matrix = jnp.linalg.inv(self.precision_matrix)
+            self._scale_tril = jnp.linalg.cholesky(self.covariance_matrix)
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix "
+                             "/ scale_tril is required")
+        super().__init__(np.broadcast_shapes(
+            np.shape(self.loc)[:-1], np.shape(self._scale_tril)[:-2]),
+            np.shape(self.loc)[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(jnp.asarray(self.loc))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.diagonal(self.covariance_matrix, axis1=-2,
+                                   axis2=-1) + 0 * self.loc)
+
+    def sample(self, shape=()):
+        d = self.loc.shape[-1]
+        eps = jax.random.normal(
+            next_key(), tuple(shape) + self._batch_shape + (d,))
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._scale_tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(v):
+            d = self.loc.shape[-1]
+            diff = v - self.loc
+            sol = jax.scipy.linalg.solve_triangular(
+                self._scale_tril, diff[..., None], lower=True)[..., 0]
+            maha = jnp.sum(sol * sol, -1)
+            logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(
+                self._scale_tril, axis1=-2, axis2=-1)), -1)
+            return -0.5 * (d * jnp.log(2 * jnp.pi) + logdet + maha)
+
+        return apply(fn, value, op_name="mvn_log_prob")
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(
+            self._scale_tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * d * (1.0 + jnp.log(2 * jnp.pi)) + 0.5 * logdet)
+
+
+class Independent(Distribution):
+    """reference distribution/independent.py: reinterpret the last
+    `reinterpreted_batch_rank` batch dims of `base` as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = tuple(base.batch_shape)
+        if self.rank > len(bs):
+            raise ValueError("reinterpreted_batch_rank exceeds the base "
+                             "batch rank")
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:]
+                         + tuple(getattr(base, "event_shape", ()) or ()))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        def fn(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - self.rank, a.ndim))) \
+                if self.rank else a
+        return apply(fn, lp, op_name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        def fn(a):
+            return jnp.sum(a, axis=tuple(range(a.ndim - self.rank, a.ndim))) \
+                if self.rank else a
+        return apply(fn, ent, op_name="independent_entropy")
+
+
+class LKJCholesky(Distribution):
+    """reference distribution/lkj_cholesky.py: distribution over Cholesky
+    factors of correlation matrices; onion-method sampling."""
+
+    def __init__(self, dim=2, concentration=1.0,
+                 sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = float(np.asarray(concentration).reshape(()))
+        self.sample_method = sample_method
+        super().__init__((), (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        shape = tuple(shape)
+        # onion method (Lewandowski et al. 2009)
+        beta0 = eta + (d - 2) / 2.0
+        L = jnp.zeros(shape + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        if d > 1:
+            r2 = 2.0 * jax.random.beta(next_key(), beta0, beta0, shape) - 1.0
+            L = L.at[..., 1, 0].set(r2)
+            L = L.at[..., 1, 1].set(jnp.sqrt(
+                jnp.maximum(1.0 - r2 * r2, 1e-12)))
+        beta = beta0
+        for i in range(2, d):
+            beta = beta - 0.5
+            y = jax.random.beta(next_key(), i / 2.0, beta, shape)
+            u = jax.random.normal(next_key(), shape + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(y)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(
+                jnp.maximum(1.0 - y, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        def fn(L):
+            d = self.dim
+            eta = self.concentration
+            diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+            orders = jnp.arange(2, d + 1, dtype=jnp.float32)
+            unnorm = jnp.sum(
+                (d - orders + 2.0 * eta - 2.0) * jnp.log(diag), -1)
+            # normalization (reference lkj_cholesky.py closed form)
+            alpha = eta + (d - 2.0) / 2.0
+            lognorm = 0.0
+            for k in range(1, d):
+                lognorm = lognorm + (
+                    0.5 * k * jnp.log(jnp.pi)
+                    + jax.scipy.special.gammaln(alpha - k / 2.0 + 0.5)
+                    - jax.scipy.special.gammaln(alpha + 0.5))
+            return unnorm - lognorm
+
+        return apply(fn, value, op_name="lkj_log_prob")
+
+
+__all__ += ["Chi2", "ContinuousBernoulli", "MultivariateNormal",
+            "Independent", "LKJCholesky"]

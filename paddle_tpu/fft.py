@@ -1,9 +1,66 @@
-"""paddle_tpu.fft (reference: python/paddle/fft.py) — jnp.fft backed."""
+"""paddle_tpu.fft (reference: python/paddle/fft.py) — jnp.fft backed.
+
+Backend note: some TPU runtimes (the axon relay among them) report
+UNIMPLEMENTED for complex FFT. A one-time probe detects this and routes
+the transforms through the host CPU backend with a device round-trip —
+differentiable (device_put has a transpose) and transparent to callers;
+the native path is used whenever the attached backend supports FFT.
+"""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .core.dispatch import apply
+
+_FFT_MODE = None  # None=unprobed | "native" | "cpu"
+
+
+def _fft_mode():
+    global _FFT_MODE
+    if _FFT_MODE is None:
+        # NO execution probe: a failed FFT poisons the relay's device
+        # stream (every subsequent op errors), and compile-only probes
+        # succeed — detection is by backend capability flag.
+        from .core.place import backend_lacks_complex
+
+        _FFT_MODE = "cpu" if backend_lacks_complex() else "native"
+    return _FFT_MODE
+
+
+def _hostable(f):
+    """Run `f` on the host backend (with a differentiable device
+    round-trip) when the attached device lacks FFT support."""
+
+    def g(a, *args, **kw):
+        if isinstance(a, jax.core.Tracer) or _fft_mode() == "native":
+            return f(a, *args, **kw)
+        dev = next(iter(a.devices())) if hasattr(a, "devices") else None
+        cpu = jax.devices("cpu")[0]
+        # default_device too: jnp.fft's norm path runs an internally
+        # jitted scaling helper that otherwise lands on the (FFT-less)
+        # default backend
+        with jax.default_device(cpu):
+            out = f(jax.device_put(a, cpu), *args, **kw)
+        if dev is None or dev.platform == "cpu" \
+                or jnp.issubdtype(out.dtype, jnp.complexfloating):
+            # complex stays host-resident: backends that lack FFT lack
+            # complex arrays altogether
+            return out
+        return jax.device_put(out, dev)
+
+    return g
+
+
+class _F:
+    """jnp.fft with the host fallback applied per-function."""
+
+    def __getattr__(self, name):
+        return _hostable(getattr(jnp.fft, name))
+
+
+_F = _F()
 
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
            "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft", "fftfreq",
@@ -23,55 +80,107 @@ def _mk(name, fn, has_n=True):
     return op
 
 
-fft = _mk("fft", jnp.fft.fft)
-ifft = _mk("ifft", jnp.fft.ifft)
-rfft = _mk("rfft", jnp.fft.rfft)
-irfft = _mk("irfft", jnp.fft.irfft)
-hfft = _mk("hfft", jnp.fft.hfft)
-ihfft = _mk("ihfft", jnp.fft.ihfft)
-fftn = _mk("fftn", jnp.fft.fftn, has_n=False)
-ifftn = _mk("ifftn", jnp.fft.ifftn, has_n=False)
-rfftn = _mk("rfftn", jnp.fft.rfftn, has_n=False)
-irfftn = _mk("irfftn", jnp.fft.irfftn, has_n=False)
+fft = _mk("fft", _F.fft)
+ifft = _mk("ifft", _F.ifft)
+rfft = _mk("rfft", _F.rfft)
+irfft = _mk("irfft", _F.irfft)
+hfft = _mk("hfft", _F.hfft)
+ihfft = _mk("ihfft", _F.ihfft)
+fftn = _mk("fftn", _F.fftn, has_n=False)
+ifftn = _mk("ifftn", _F.ifftn, has_n=False)
+rfftn = _mk("rfftn", _F.rfftn, has_n=False)
+irfftn = _mk("irfftn", _F.irfftn, has_n=False)
 
 
 def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=norm), x,
+    return apply(lambda a: _F.fft2(a, s=s, axes=axes, norm=norm), x,
                  op_name="fft2")
 
 
 def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.ifft2(a, s=s, axes=axes, norm=norm), x,
+    return apply(lambda a: _F.ifft2(a, s=s, axes=axes, norm=norm), x,
                  op_name="ifft2")
 
 
 def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.rfft2(a, s=s, axes=axes, norm=norm), x,
+    return apply(lambda a: _F.rfft2(a, s=s, axes=axes, norm=norm), x,
                  op_name="rfft2")
 
 
 def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
-    return apply(lambda a: jnp.fft.irfft2(a, s=s, axes=axes, norm=norm), x,
+    return apply(lambda a: _F.irfft2(a, s=s, axes=axes, norm=norm), x,
                  op_name="irfft2")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):
     from .core.tensor import Tensor
 
-    return Tensor(jnp.fft.fftfreq(int(n), d))
+    return Tensor(_F.fftfreq(int(n), d))
 
 
 def rfftfreq(n, d=1.0, dtype=None, name=None):
     from .core.tensor import Tensor
 
-    return Tensor(jnp.fft.rfftfreq(int(n), d))
+    return Tensor(_F.rfftfreq(int(n), d))
 
 
 def fftshift(x, axes=None, name=None):
-    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+    return apply(lambda a: _F.fftshift(a, axes=axes), x,
                  op_name="fftshift")
 
 
 def ifftshift(x, axes=None, name=None):
-    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+    return apply(lambda a: _F.ifftshift(a, axes=axes), x,
                  op_name="ifftshift")
+
+
+def _resolve_axes(ndim, s, axes):
+    """numpy rule: axes default to the last len(s) axes (all axes when s
+    is also None)."""
+    if axes is not None:
+        return list(axes)
+    if s is not None:
+        return list(range(ndim - len(s), ndim))
+    return list(range(ndim))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D Hermitian FFT (reference fftn_c2r semantics): FORWARD fft over
+    the leading axes, hfft over the last."""
+    def fn(a):
+        ax = _resolve_axes(a.ndim, s, axes)
+        o = a
+        for i, axis in enumerate(ax[:-1]):
+            o = _F.fft(o, n=None if s is None else s[i], axis=axis,
+                       norm=norm)
+        return _F.hfft(o, n=None if s is None else s[-1],
+                       axis=ax[-1], norm=norm)
+
+    return apply(fn, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D inverse Hermitian FFT (reference fftn_r2c-conjugate semantics,
+    ihfftn(x) == ifftn(x) truncated to the half spectrum): INVERSE fft
+    over the leading axes, ihfft over the last."""
+    def fn(a):
+        ax = _resolve_axes(a.ndim, s, axes)
+        o = _F.ihfft(a, n=None if s is None else s[-1], axis=ax[-1],
+                     norm=norm)
+        for i, axis in enumerate(ax[:-1]):
+            o = _F.ifft(o, n=None if s is None else s[i], axis=axis,
+                        norm=norm)
+        return o
+
+    return apply(fn, x, op_name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

@@ -22,7 +22,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "Subset", "random_split", "Sampler",
            "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
            "BatchSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info"]
+           "get_worker_info", "SubsetRandomSampler", "ConcatDataset"]
 
 
 class Dataset:
@@ -450,3 +450,50 @@ class DataLoader:
                 fut = pending.pop(next_yield)
                 next_yield += 1
                 yield fut.result(timeout=self.timeout or None)
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        if len(indices) == 0:
+            raise ValueError("indices must not be empty")
+        self.indices = list(indices)
+        self.generator = generator
+
+    def __iter__(self):
+        perm = np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of map-style datasets (reference io/dataset.py
+    ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("datasets should not be an empty iterable")
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            if idx < -len(self):
+                raise ValueError("index out of range")
+            idx += len(self)
+        import bisect
+
+        ds = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[ds - 1] if ds > 0 else 0
+        return self.datasets[ds][idx - prev]

@@ -1,4 +1,5 @@
 from . import api
 from . import functional
-from .api import (InputSpec, StaticFunction, TrainStep, enable_to_static,
+from .api import (InputSpec, StaticFunction, TrainStep, TranslatedLayer,
+                  set_code_level, set_verbosity, enable_to_static,
                   ignore_module, load, not_to_static, save, to_static)

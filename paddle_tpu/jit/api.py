@@ -390,3 +390,28 @@ def load(path, **configs):
     from ..framework.io import load as fload
 
     return fload(path + ".pdparams")
+
+
+class TranslatedLayer:
+    """reference jit/translated_layer.py: the callable returned by
+    jit.load for a saved-inference artifact. Here jit.load already
+    returns a callable Layer-like object; this class is its public
+    type alias for isinstance checks."""
+
+    def __new__(cls, *args, **kwargs):
+        raise TypeError("TranslatedLayer is constructed by paddle.jit.load")
+
+
+def set_code_level(level=100):
+    """reference jit/sot: dump generated code at the given log level —
+    trace-based capture has no generated bytecode, kept as a no-op."""
+    return None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference jit/dy2static logging verbosity — routed to the
+    framework logger."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level and level > 0 else logging.WARNING)

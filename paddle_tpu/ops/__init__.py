@@ -20,6 +20,7 @@ from . import nn_compat  # registers the nn yaml op surface
 from . import yaml_extra  # framework/signal/sequence/moe/quant/... surface
 from . import vision_ops  # detection/roi/yolo surface
 from . import fused_compat  # fused_ops.yaml surface as XLA-fused compositions
+from .compat_extra import *  # noqa: F401,F403  (namespace completion)
 from ..core.tensor import Tensor
 
 _METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search,
@@ -84,16 +85,8 @@ def patch_tensor_methods():
     Tensor.__hash__ = object.__hash__
 
     # inplace arithmetic (reference add_/subtract_/scale_ semantics):
-    # functional compute + handle swap
-    def _make_inplace(fn):
-        def op(self, *args, **kwargs):
-            out = fn(self, *args, **kwargs)
-            self._value = out._value
-            self._grad_node = out._grad_node
-            self._out_index = out._out_index
-            self.stop_gradient = out.stop_gradient
-            return self
-        return op
+    # functional compute + handle swap (the one shared implementation)
+    from .compat_extra import make_inplace as _make_inplace
 
     for base_name in ("add", "subtract", "multiply", "divide", "clip",
                       "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
@@ -104,6 +97,28 @@ def patch_tensor_methods():
             setattr(Tensor, base_name + "_", _make_inplace(base))
     Tensor.masked_fill_ = _make_inplace(manipulation.masked_fill)
     Tensor.index_put_ = _make_inplace(manipulation.index_put)
+
+    # namespace-completion surface (compat_extra): everything tensor-first
+    # becomes a method too (reference tensor_method_func patching)
+    from . import compat_extra as _ce
+
+    _NON_METHODS = {"finfo", "iinfo", "dtype", "batch", "LazyGuard",
+                    "check_shape", "get_cuda_rng_state",
+                    "set_cuda_rng_state", "disable_signal_handler",
+                    "hstack", "vstack", "dstack", "column_stack",
+                    "row_stack", "log_normal"}
+    for name in _ce.__all__:
+        if name in _NON_METHODS or name in _SKIP_METHODS:
+            continue
+        fn = getattr(_ce, name)
+        if callable(fn) and not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # signal ops as methods (reference patches stft/istft too)
+    from .. import signal as _signal
+
+    for name in ("stft", "istft"):
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(_signal, name))
 
 
 patch_tensor_methods()

@@ -1,0 +1,647 @@
+"""Top-level paddle.* namespace completion (reference:
+python/paddle/__init__.py __all__): the remaining tensor utilities, numpy-
+style stack/split aliases, dtype/introspection helpers, and the full set of
+in-place (`op_`) function variants — eager in-place = functional compute +
+handle swap, the same mechanism as the Tensor method variants."""
+from __future__ import annotations
+
+import itertools
+import math as _pymath
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import apply
+from ..core import dtype as _dt
+from ..core.tensor import Tensor
+from .registry import get as _registry_get
+
+__all__ = []
+
+
+def _export(fn, name=None):
+    name = name or fn.__name__
+    fn.__name__ = name
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+def _from_registry(name):
+    info = _registry_get(name)
+
+    def f(*args, **kwargs):
+        kwargs.pop("name", None)
+        return apply(info.fn, *args, op_name=name, **kwargs)
+
+    return _export(f, name)
+
+
+# public Tensor-level wrappers for registry-only kernels
+for _n in ("diag_embed", "gammaincc", "gammaln", "reduce_as", "shard_index",
+           "renorm", "as_strided", "top_p_sampling"):
+    _from_registry(_n)
+
+
+@_export
+def cast(x, dtype):
+    """reference paddle.cast."""
+    return x.astype(dtype) if isinstance(x, Tensor) else \
+        Tensor(jnp.asarray(x)).astype(dtype)
+
+
+@_export
+def shape(x):
+    """Runtime shape as a 1-D int32 Tensor (reference paddle.shape)."""
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.asarray(a.shape, jnp.int32))
+
+
+@_export
+def numel(x):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.asarray(int(np.prod(a.shape) if a.ndim else 1),
+                              jnp.int64))
+
+
+@_export
+def rank(x):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.asarray(a.ndim, jnp.int32))
+
+
+@_export
+def reverse(x, axis):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+@_export
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@_export
+def is_floating_point(x):
+    return _dt.is_floating_point(x.dtype if isinstance(x, Tensor) else x)
+
+
+@_export
+def is_complex(x):
+    return _dt.is_complex(x.dtype if isinstance(x, Tensor) else x)
+
+
+@_export
+def is_integer(x):
+    return _dt.is_integer(x.dtype if isinstance(x, Tensor) else x)
+
+
+class _FInfo:
+    def __init__(self, dt):
+        import ml_dtypes
+
+        # ml_dtypes.finfo handles bfloat16/float8 in addition to numpy's
+        i = ml_dtypes.finfo(np.dtype(_dt.convert_dtype(dt)))
+        self.dtype = str(i.dtype)
+        self.bits = i.bits
+        self.eps = float(i.eps)
+        self.min = float(i.min)
+        self.max = float(i.max)
+        self.tiny = float(i.tiny)
+        self.smallest_normal = float(i.tiny)
+        self.resolution = float(i.resolution)
+
+
+class _IInfo:
+    def __init__(self, dt):
+        i = np.iinfo(np.dtype(_dt.convert_dtype(dt)))
+        self.dtype = str(i.dtype)
+        self.bits = i.bits
+        self.min = int(i.min)
+        self.max = int(i.max)
+
+
+@_export
+def finfo(dtype):
+    return _FInfo(dtype)
+
+
+@_export
+def iinfo(dtype):
+    return _IInfo(dtype)
+
+
+@_export
+def dtype(name):
+    """paddle.dtype: the framework dtype constructor (numpy-compatible)."""
+    return np.dtype(_dt.convert_dtype(name))
+
+
+# ---------------------------------------------------------------------------
+# numpy-parity tensor utilities
+# ---------------------------------------------------------------------------
+
+@_export
+def block_diag(inputs, name=None):
+    def fn(*mats):
+        mats = [m.reshape(1, -1) if m.ndim <= 1 else m for m in mats]
+        rows = sum(m.shape[0] for m in mats)
+        cols = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((rows, cols), mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype),
+                                               (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply(fn, *inputs, op_name="block_diag")
+
+
+@_export
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    def fn(a, t):
+        hit = jnp.isin(a, t.ravel())
+        return ~hit if invert else hit
+
+    return apply(fn, x, test_x, op_name="isin", differentiable=False)
+
+
+@_export
+def sinc(x, name=None):
+    return apply(jnp.sinc, x, op_name="sinc")
+
+
+@_export
+def signbit(x, name=None):
+    return apply(jnp.signbit, x, op_name="signbit", differentiable=False)
+
+
+@_export
+def sgn(x, name=None):
+    def fn(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.maximum(
+                mag, jnp.finfo(mag.dtype).tiny)).astype(a.dtype)
+        return jnp.sign(a)
+
+    return apply(fn, x, op_name="sgn")
+
+
+@_export
+def take(x, index, mode="raise", name=None):
+    def fn(a, i):
+        flat = a.ravel()
+        n = flat.shape[0]
+        ii = i.astype(jnp.int64)
+        if mode == "wrap":
+            ii = ii % n
+        elif mode == "clip":
+            ii = jnp.clip(ii, 0, n - 1)
+        else:
+            ii = jnp.where(ii < 0, ii + n, ii)
+        return flat[ii]
+
+    return apply(fn, x, index, op_name="take")
+
+
+@_export
+def frexp(x, name=None):
+    def fn(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+
+    return apply(fn, x, op_name="frexp", differentiable=False)
+
+
+@_export
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(ya, *rest):
+        if x is not None:
+            return jnp.trapezoid(ya, rest[0], axis=axis)
+        return jnp.trapezoid(ya, dx=1.0 if dx is None else dx, axis=axis)
+
+    args = (y, x) if x is not None else (y,)
+    return apply(fn, *args, op_name="trapezoid")
+
+
+@_export
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(ya, *rest):
+        ya = jnp.moveaxis(ya, axis, -1)
+        if x is not None:
+            xa = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim == ya.ndim \
+                else rest[0]
+            d = jnp.diff(xa, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        avg = (ya[..., 1:] + ya[..., :-1]) / 2.0
+        return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+
+    args = (y, x) if x is not None else (y,)
+    return apply(fn, *args, op_name="cumulative_trapezoid")
+
+
+@_export
+def polar(abs, angle, name=None):
+    def fn(r, t):
+        rf = r.astype(jnp.float32)
+        tf = t.astype(jnp.float32)
+        return (rf * jnp.cos(tf) + 1j * rf * jnp.sin(tf)).astype(
+            jnp.complex64)
+
+    return apply(fn, abs, angle, op_name="polar")
+
+
+@_export
+def combinations(x, r=2, with_replacement=False, name=None):
+    def fn(a):
+        n = a.shape[0]
+        gen = (itertools.combinations_with_replacement(range(n), r)
+               if with_replacement else itertools.combinations(range(n), r))
+        idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+        return a[idx]
+
+    return apply(fn, x, op_name="combinations")
+
+
+@_export
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 0.0))
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return apply(fn, x, y, op_name="cdist")
+
+
+@_export
+def pdist(x, p=2.0, name=None):
+    def fn(a):
+        n = a.shape[0]
+        iu = np.triu_indices(n, k=1)
+        diff = a[iu[0]] - a[iu[1]]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, -1), 0.0))
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(diff), -1)
+        return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+    return apply(fn, x, op_name="pdist")
+
+
+@_export
+def multigammaln(x, p, name=None):
+    def fn(a):
+        af = a.astype(jnp.float32)
+        out = jnp.full_like(af, 0.25 * p * (p - 1)
+                            * _pymath.log(_pymath.pi))
+        for i in range(1, p + 1):
+            out = out + jax.scipy.special.gammaln(af + (1 - i) / 2.0)
+        return out
+
+    return apply(fn, x, op_name="multigammaln")
+
+
+@_export
+def gammainc(x, y, name=None):
+    # paddle.gammainc(x, y) = regularized lower incomplete gamma P(x, y)
+    def fn(a, b):
+        return jax.scipy.special.gammainc(a.astype(jnp.float32),
+                                          b.astype(jnp.float32))
+
+    return apply(fn, x, y, op_name="gammainc")
+
+
+@_export
+def masked_scatter(x, mask, value, name=None):
+    def fn(a, m, v):
+        m = jnp.broadcast_to(m, a.shape)
+        if v.size == 0:
+            raise ValueError("masked_scatter: empty value tensor")
+        if isinstance(m, jax.Array) and not isinstance(
+                m, jax.core.Tracer):
+            needed = int(jnp.sum(m))
+            if v.size < needed:
+                raise ValueError(
+                    f"masked_scatter: value has {v.size} elements but the "
+                    f"mask selects {needed}")
+        # traced path keeps the clip (count is data-dependent there)
+        order = jnp.cumsum(m.ravel().astype(jnp.int32)) - 1
+        picked = v.ravel()[jnp.clip(order, 0, v.size - 1)]
+        return jnp.where(m.ravel(), picked.astype(a.dtype),
+                         a.ravel()).reshape(a.shape)
+
+    return apply(fn, x, mask, value, op_name="masked_scatter")
+
+
+@_export
+def index_fill(x, index, axis, value, name=None):
+    def fn(a, i, *rest):
+        v = rest[0] if rest else value
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[i].set(v)
+        return jnp.moveaxis(am, 0, axis)
+
+    args = (x, index, value) if isinstance(value, Tensor) else (x, index)
+    return apply(fn, *args, op_name="index_fill")
+
+
+# ---------------------------------------------------------------------------
+# stack / split aliases
+# ---------------------------------------------------------------------------
+
+@_export
+def hstack(x, name=None):
+    def fn(*ts):
+        return jnp.hstack(ts)
+
+    return apply(fn, *x, op_name="hstack")
+
+
+@_export
+def vstack(x, name=None):
+    def fn(*ts):
+        return jnp.vstack(ts)
+
+    return apply(fn, *x, op_name="vstack")
+
+
+@_export
+def dstack(x, name=None):
+    def fn(*ts):
+        return jnp.dstack(ts)
+
+    return apply(fn, *x, op_name="dstack")
+
+
+@_export
+def column_stack(x, name=None):
+    def fn(*ts):
+        return jnp.column_stack(ts)
+
+    return apply(fn, *x, op_name="column_stack")
+
+
+@_export
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def _nsplit(x, num_or_indices, axis):
+    from .manipulation import split
+
+    a_ndim = len(x.shape)
+    if isinstance(num_or_indices, int):
+        if x.shape[axis] % num_or_indices != 0:
+            raise ValueError(
+                f"axis size {x.shape[axis]} is not divisible into "
+                f"{num_or_indices} equal sections")
+        n = x.shape[axis] // num_or_indices
+        return split(x, [n] * num_or_indices, axis=axis)
+    # indices -> section sizes
+    idx = list(num_or_indices)
+    sizes, prev = [], 0
+    for i in idx:
+        sizes.append(i - prev)
+        prev = i
+    sizes.append(x.shape[axis] - prev)
+    return split(x, sizes, axis=axis)
+
+
+@_export
+def hsplit(x, num_or_indices, name=None):
+    axis = 0 if len(x.shape) == 1 else 1
+    return _nsplit(x, num_or_indices, axis)
+
+
+@_export
+def vsplit(x, num_or_indices, name=None):
+    return _nsplit(x, num_or_indices, 0)
+
+
+@_export
+def dsplit(x, num_or_indices, name=None):
+    return _nsplit(x, num_or_indices, 2)
+
+
+# ---------------------------------------------------------------------------
+# framework shims
+# ---------------------------------------------------------------------------
+
+@_export
+def floor_mod(x, y, name=None):
+    from .math import mod
+
+    return mod(x, y)
+
+
+@_export
+def inverse(x, name=None):
+    from .linalg import inv
+
+    return inv(x)
+
+
+@_export
+def create_tensor(dtype, name=None, persistable=False):
+    """reference paddle.create_tensor: an empty typed tensor."""
+    return Tensor(jnp.zeros((0,), _dt.convert_dtype(dtype)))
+
+
+class LazyGuard:
+    """reference nn/initializer/lazy_init.py LazyGuard: defers parameter
+    materialization. Eager XLA init is cheap (one fused program per
+    initializer), so this guard is a no-op context kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+globals()["LazyGuard"] = LazyGuard
+__all__.append("LazyGuard")
+
+
+@_export
+def batch(reader, batch_size, drop_last=False):
+    """reference paddle/batch.py: wrap a sample reader into a mini-batch
+    reader."""
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+@_export
+def disable_signal_handler():
+    return None
+
+
+@_export
+def check_shape(shape):
+    """reference utils/layers_utils.py check_shape: validate a shape
+    argument."""
+    if isinstance(shape, Tensor):
+        return
+    for s in shape:
+        if s is not None and not isinstance(s, Tensor) and int(s) < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+@_export
+def get_cuda_rng_state():
+    from ..framework.random import get_rng_state
+
+    return [get_rng_state()]
+
+
+@_export
+def set_cuda_rng_state(state_list):
+    from ..framework.random import set_rng_state
+
+    set_rng_state(state_list[0] if isinstance(state_list, (list, tuple))
+                  else state_list)
+
+
+@_export
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference paddle.create_parameter (static helper): a free-standing
+    Parameter."""
+    from ..nn.layer.layers import Layer
+
+    holder = Layer()
+    return holder.create_parameter(
+        list(shape), attr=attr, dtype=dtype, is_bias=is_bias,
+        default_initializer=default_initializer)
+
+
+# ---------------------------------------------------------------------------
+# top-level in-place function variants (reference paddle.abs_ etc.):
+# functional compute + handle swap — identical semantics to the Tensor
+# method variants installed in ops/__init__.patch_tensor_methods
+# ---------------------------------------------------------------------------
+
+def make_inplace(fn):
+    """Eager in-place wrapper: functional compute + handle swap. The ONE
+    shared implementation — ops/__init__ installs the Tensor method
+    variants from this same helper."""
+
+    def op(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
+        return x
+
+    return op
+
+
+def _inplace_from(base_fn, name):
+    return _export(make_inplace(base_fn), name)
+
+
+def _install_inplace_variants():
+    from . import math as _m, manipulation as _mp, logic as _lg, \
+        creation as _cr, random as _rnd
+    from ..ops import registry as _r
+
+    bases = {}
+    for mod in (_m, _mp, _lg, _cr, _rnd):
+        for k in dir(mod):
+            if not k.startswith("_") and callable(getattr(mod, k)):
+                bases.setdefault(k, getattr(mod, k))
+    for k, v in list(globals().items()):
+        if callable(v) and not k.startswith("_"):
+            bases.setdefault(k, v)
+
+    names = [
+        "abs", "acos", "asin", "atan", "acosh", "asinh", "atanh", "cos",
+        "cosh", "sin", "sinh", "tan", "tanh", "exp", "expm1", "log",
+        "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "reciprocal",
+        "ceil", "floor", "round", "trunc", "frac", "erf", "erfinv",
+        "lgamma", "digamma", "sigmoid", "logit", "i0", "neg", "sinc",
+        "polygamma", "gammaln", "gammainc", "gammaincc", "multigammaln",
+        "add", "subtract", "multiply", "divide", "floor_divide",
+        "remainder", "mod", "floor_mod", "pow", "gcd", "lcm", "hypot",
+        "ldexp", "copysign", "nan_to_num", "renorm",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_left_shift", "bitwise_right_shift",
+        "logical_and", "logical_or", "logical_xor", "logical_not",
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal",
+        "clip", "scale", "cast", "cumsum", "cumprod",
+        "t", "transpose", "triu", "tril", "addmm", "index_add",
+        "index_put", "masked_fill", "masked_scatter", "index_fill",
+        "lerp", "put_along_axis",
+    ]
+    for n in names:
+        base = bases.get(n)
+        if base is None and _r.get(n) is not None:
+            info = _r.get(n)
+            base = (lambda fn, nm: lambda *a, **kw: apply(
+                fn, *a, op_name=nm, **kw))(info.fn, n)
+        if base is not None and (n + "_") not in globals():
+            _inplace_from(base, n + "_")
+
+
+_install_inplace_variants()
+
+
+@_export
+def bernoulli_(x, p=0.5, name=None):
+    """Fill x in place with Bernoulli(p) samples (reference
+    paddle.bernoulli_ — note: fills with probability p, it does NOT read
+    x's values as probabilities)."""
+    from ..framework.random import next_key
+
+    key = next_key()
+    out = jax.random.bernoulli(key, p, tuple(x.shape)).astype(x.dtype)
+    x._value = out
+    x._grad_node = None
+    return x
+
+
+@_export
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x in place with LogNormal(mean, std) samples (reference
+    paddle.log_normal_)."""
+    from ..framework.random import next_key
+
+    key = next_key()
+    out = jnp.exp(mean + std * jax.random.normal(
+        key, tuple(x.shape), jnp.float32)).astype(x.dtype)
+    x._value = out
+    x._grad_node = None
+    return x
+
+
+@_export
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from ..framework.random import next_key
+
+    key = next_key()
+    return Tensor(jnp.exp(mean + std * jax.random.normal(
+        key, tuple(shape or ()), jnp.float32)))

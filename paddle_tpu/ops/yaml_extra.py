@@ -20,6 +20,7 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..framework.random import next_key
 from .registry import register
+from ..fft import _F as _jfft
 
 __all__ = []
 
@@ -298,15 +299,15 @@ _reg("uniform_random_batch_size_like", lambda input, shape, min=-1.0,
 # ---------------------------------------------------------------------------
 
 _reg("fft_c2c", lambda x, axes, normalization="backward", forward=True:
-     (jnp.fft.fftn if forward else jnp.fft.ifftn)(
+     (_jfft.fftn if forward else _jfft.ifftn)(
          jnp.asarray(x), axes=tuple(axes), norm=normalization))
 _reg("fft_r2c", lambda x, axes, normalization="backward", forward=True,
-     onesided=True: jnp.fft.rfftn(jnp.asarray(x), axes=tuple(axes),
+     onesided=True: _jfft.rfftn(jnp.asarray(x), axes=tuple(axes),
                                   norm=normalization) if onesided
-     else jnp.fft.fftn(jnp.asarray(x).astype(jnp.complex64),
+     else _jfft.fftn(jnp.asarray(x).astype(jnp.complex64),
                        axes=tuple(axes), norm=normalization))
 _reg("fft_c2r", lambda x, axes, normalization="backward", forward=False,
-     last_dim_size=0: jnp.fft.irfftn(
+     last_dim_size=0: _jfft.irfftn(
          jnp.asarray(x), s=None if not last_dim_size
          else tuple([last_dim_size]), axes=tuple(axes),
          norm=normalization))
@@ -353,8 +354,8 @@ def _stft(x, window, n_fft, hop_length, normalized=False, onesided=True):
     x = jnp.asarray(x)
     frames = _frame(x, n_fft, hop_length, axis=-1)       # [..., n_fft, F]
     frames = jnp.swapaxes(frames, -1, -2) * jnp.asarray(window)
-    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1) if onesided \
-        else jnp.fft.fft(frames, n=n_fft, axis=-1)
+    spec = _jfft.rfft(frames, n=n_fft, axis=-1) if onesided \
+        else _jfft.fft(frames, n=n_fft, axis=-1)
     if normalized:
         spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
     return jnp.swapaxes(spec, -1, -2)

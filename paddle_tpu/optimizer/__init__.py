@@ -1,5 +1,5 @@
 from . import lr
-from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
+from .optimizer import (ASGD, SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,
                         ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                         Lamb, LBFGS, Momentum, NAdam, Optimizer, RAdam,
-                        RMSProp)
+                        RMSProp, Rprop)

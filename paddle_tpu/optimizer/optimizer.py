@@ -583,3 +583,69 @@ class LBFGS(Optimizer):
         self._prev_flat_grad = flat_g
         self._scatter(ps, new_p)
         self._step_count += 1
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference: python/paddle/optimizer/asgd.py): keeps a
+    running average of recent gradients (window `d`) and of the parameter
+    trajectory."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._n = max(int(batch_num), 1)
+
+    def _init_state(self, p):
+        return {"d": jnp.zeros(p._value.shape, jnp.float32),
+                "ys": jnp.zeros((self._n,) + tuple(p._value.shape),
+                                jnp.float32),
+                "m": jnp.zeros((), jnp.int32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * p.astype(jnp.float32)
+        m = state["m"]
+        idx = (m % self._n).astype(jnp.int32)
+        old = state["ys"][idx]
+        d = state["d"] - old + g
+        ys = state["ys"].at[idx].set(g)
+        count = jnp.minimum(m + 1, self._n).astype(jnp.float32)
+        upd = lr * d / count
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"d": d, "ys": ys, "m": m + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference: python/paddle/optimizer/rprop.py):
+    per-element step sizes grown/shrunk by gradient sign agreement; only
+    the sign of the gradient is used."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _init_state(self, p):
+        init_lr = self._learning_rate
+        if not isinstance(init_lr, (int, float)):   # LRScheduler
+            init_lr = float(init_lr())
+        return {"prev_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "step_size": jnp.full(p._value.shape, float(init_lr),
+                                      jnp.float32)}
+
+    def _update(self, p, g, state, lr, wd):
+        g = g.astype(jnp.float32)
+        sign = jnp.sign(g * state["prev_grad"])
+        step = jnp.where(sign > 0, state["step_size"] * self._eta_pos,
+                         jnp.where(sign < 0,
+                                   state["step_size"] * self._eta_neg,
+                                   state["step_size"]))
+        step = jnp.clip(step, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p.astype(jnp.float32) - jnp.sign(g_eff) * step
+        return new_p.astype(p.dtype), {"prev_grad": g_eff,
+                                       "step_size": step}

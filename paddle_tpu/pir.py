@@ -1,0 +1,19 @@
+"""paddle.pir namespace (reference: python/paddle/pir/ — the new IR's
+python surface). Here the IR is jaxpr/StableHLO: Program wraps the
+captured static Program and exposes its module text; translate_to_pir is
+identity (one IR)."""
+from .static import Program  # noqa: F401
+
+
+def core_version():
+    import jax
+
+    return f"stablehlo (jax {jax.__version__})"
+
+
+def translate_to_pir(program_desc):
+    return program_desc
+
+
+def check_unregistered_ops(program_desc):
+    return []

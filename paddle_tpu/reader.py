@@ -1,0 +1,84 @@
+"""paddle.reader compat (reference: python/paddle/reader/decorator.py —
+the legacy reader-composition toolkit)."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+
+def shuffle(reader, buf_size):
+    def reader_():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return reader_
+
+
+def chain(*readers):
+    def reader_():
+        for r in readers:
+            yield from r()
+
+    return reader_
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.get("check_alignment", True)
+
+    def reader_():
+        for outs in itertools.zip_longest(*[r() for r in readers]):
+            if check_alignment and any(o is None for o in outs):
+                raise RuntimeError("readers are not aligned")
+            yield tuple(o if isinstance(o, tuple) else (o,)
+                        for o in outs)
+
+    return reader_
+
+
+def map_readers(func, *readers):
+    def reader_():
+        for args in zip(*[r() for r in readers]):
+            yield func(*args)
+
+    return reader_
+
+
+def buffered(reader, size):
+    def reader_():
+        yield from reader()
+
+    return reader_
+
+
+def firstn(reader, n):
+    def reader_():
+        yield from itertools.islice(reader(), n)
+
+    return reader_
+
+
+def cache(reader):
+    memo = []
+
+    def reader_():
+        if memo:
+            yield from memo
+            return
+        for e in reader():
+            memo.append(e)
+            yield e
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    return map_readers(mapper, reader)

@@ -4,6 +4,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .core.dispatch import apply
+from .fft import _F as _jfft
 from .core.tensor import Tensor
 
 __all__ = ["stft", "istft", "frame", "overlap_add"]
@@ -59,8 +60,8 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None,
         idx = (jnp.arange(n_fft)[None, :]
                + hop_length * jnp.arange(num)[:, None])
         frames = sig[..., idx] * w  # [..., num, n_fft]
-        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
-            jnp.fft.fft(frames, axis=-1)
+        spec = _jfft.rfft(frames, axis=-1) if onesided else \
+            _jfft.fft(frames, axis=-1)
         if normalized:
             spec = spec / jnp.sqrt(float(n_fft))
         # [..., freq, num_frames]
@@ -83,8 +84,8 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         s = jnp.swapaxes(spec, -1, -2)  # [..., num, freq]
         if normalized:
             s = s * jnp.sqrt(float(n_fft))
-        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided else \
-            jnp.fft.ifft(s, axis=-1).real
+        frames = _jfft.irfft(s, n=n_fft, axis=-1) if onesided else \
+            _jfft.ifft(s, axis=-1).real
         frames = frames * w
         num = frames.shape[-2]
         n = (num - 1) * hop_length + n_fft

@@ -31,7 +31,18 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "coalesce", "cast", "reshape", "transpose", "sum", "slice",
            "mask_as", "full_like", "abs", "sin", "sinh", "asin", "asinh",
            "tan", "tanh", "atan", "atanh", "sqrt", "square", "log1p",
-           "expm1", "pow", "scale", "isnan", "nn"]
+           "expm1", "pow", "scale", "isnan", "nn", "neg", "deg2rad",
+           "rad2deg", "pca_lowrank"]
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference paddle.sparse.pca_lowrank: PCA of a sparse matrix —
+    densify (the factorization result is dense anyway) and reuse the
+    dense linalg implementation."""
+    from ..ops.linalg import pca_lowrank as _dense
+
+    dense = x.to_dense() if hasattr(x, "to_dense") else x
+    return _dense(dense, q=q, center=center, niter=niter)
 
 
 class SparseCooTensor:
@@ -215,6 +226,9 @@ def _value_op(fn):
 
 
 abs = _value_op(jnp.abs)
+neg = _value_op(jnp.negative)
+deg2rad = _value_op(jnp.deg2rad)
+rad2deg = _value_op(jnp.rad2deg)
 sin = _value_op(jnp.sin)
 sinh = _value_op(jnp.sinh)
 asin = _value_op(jnp.arcsin)

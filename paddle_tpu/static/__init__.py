@@ -11,6 +11,9 @@ compiled programs.
 from __future__ import annotations
 
 import contextlib
+import contextlib as _contextlib
+
+import numpy as np
 
 from ..core.place import CPUPlace, Place, TPUPlace
 from ..core.tensor import Tensor
@@ -372,3 +375,320 @@ class nn:
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         raise NotImplementedError("use paddle_tpu.nn.Linear in 2.x style")
+
+
+# ---------------------------------------------------------------------------
+# remaining reference static/__init__.py surface: scope/serialization/
+# place-list helpers over the record-replay Program (the deep machinery —
+# scheduling, memory, passes — is XLA's; these are its user-facing shims)
+# ---------------------------------------------------------------------------
+
+class Variable:
+    """reference static Variable handle: here every static-capture value
+    is an eager Tensor, so Variable is its public type alias."""
+
+    def __new__(cls, *a, **k):
+        raise TypeError("Variable handles are produced by static.data / "
+                        "Program capture")
+
+
+_SCOPES = [{}]
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+def global_scope():
+    if not isinstance(_SCOPES[0], _Scope):
+        _SCOPES[0] = _Scope()
+    return _SCOPES[0]
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    _SCOPES.insert(0, scope)
+    try:
+        yield
+    finally:
+        _SCOPES.pop(0)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference static append_backward: in the record-replay model the
+    backward is built by the eager tape at Executor.run time; this
+    registers the intent on the captured Program."""
+    prog = default_main_program()
+    prog._loss = loss
+    params = parameter_list or []
+    return [(p, None) for p in params]
+
+
+class BuildStrategy:
+    """Compat knobs (reference BuildStrategy): XLA owns fusion/scheduling,
+    every knob is accepted and recorded."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        return self.__dict__.get("_opts", {}).get(k, False)
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["program"], k)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError("IPU is not a TPU-framework target")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not a TPU-framework target")
+
+
+@_contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a TPU-framework target")
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=False, print_phase="both"):
+    """reference static.Print: debug-print a tensor in-graph; eager
+    capture prints via host callback at replay."""
+    import jax
+
+    def _cb(a):
+        head = message or ""
+        print(f"{head} {a.shape} {a.dtype}\n{a}")
+        return a
+
+    from ..core.tensor import Tensor as _T
+
+    v = input._value if isinstance(input, _T) else input
+    jax.debug.callback(lambda a: _cb(a), v)
+    return input
+
+
+class WeightNormParamAttr:
+    """reference WeightNormParamAttr: weight-norm reparameterization
+    request; here nn.utils.weight_norm applies it at the layer level."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """reference static ExponentialMovingAverage over program variables;
+    the eager incubate ModelAverage/EMA covers dygraph — this one tracks
+    named parameters of a Layer or list."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self, parameters=None):
+        for p in parameters or []:
+            k = id(p)
+            cur = p._value
+            if k not in self._shadow:
+                self._shadow[k] = (p, cur)
+            else:
+                _, s = self._shadow[k]
+                self._shadow[k] = (p, self.decay * s
+                                   + (1.0 - self.decay) * cur)
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for k, (p, s) in self._shadow.items():
+            self._backup[k] = p._value
+            p._value = s
+        try:
+            yield
+        finally:
+            if need_restore:
+                for k, (p, _) in self._shadow.items():
+                    p._value = self._backup[k]
+                self._backup = {}
+
+    def restore(self, executor=None):
+        for k, (p, _) in self._shadow.items():
+            if k in self._backup:
+                p._value = self._backup[k]
+        self._backup = {}
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    return pickle.dumps(default_main_program())
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor, **kwargs):
+    import pickle
+
+    prog = default_main_program()
+    return pickle.dumps({k: np.asarray(v._value)
+                         for k, v in getattr(prog, "_params", {}).items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data, executor):
+    import pickle
+
+    from ..core.tensor import Tensor as _T
+
+    vals = pickle.loads(data)
+    params = getattr(program, "_params", None)
+    if params is None:
+        params = program._params = {}
+    for k, v in vals.items():
+        cur = params.get(k)
+        if cur is not None and hasattr(cur, "_value"):
+            cur._value = _T(v)._value      # restore in place
+        else:
+            params[k] = _T(v)
+    return vals
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework import io as _io
+
+    return _io.load(model_path + ".pdparams") \
+        if not model_path.endswith(".pdparams") else _io.load(model_path)
+
+
+def set_program_state(program, state):
+    program._params = dict(state)
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import CUDAPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..core.tensor import Tensor as _T
+
+    return _T(np.full(tuple(shape), value, np.dtype(dtype)))
+
+
+__all__ += ["append_backward", "global_scope", "scope_guard",
+            "BuildStrategy", "CompiledProgram", "ipu_shard_guard",
+            "IpuCompiledProgram", "IpuStrategy", "Print",
+            "WeightNormParamAttr", "ExponentialMovingAverage",
+            "serialize_program", "serialize_persistables", "save_to_file",
+            "deserialize_program", "deserialize_persistables",
+            "load_from_file", "normalize_program", "load_program_state",
+            "set_program_state", "cpu_places", "cuda_places", "xpu_places",
+            "Variable", "create_global_var"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference static.accuracy — delegates to the metric op."""
+    from ..ops.registry import get as _g
+    from ..core.dispatch import apply as _apply
+
+    def fn(logits, lab):
+        import jax.numpy as jnp
+
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = jnp.any(topk == lab.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return _apply(fn, input, label, op_name="static_accuracy",
+                  differentiable=False)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    from ..ops.registry import get as _g
+    from ..core.dispatch import apply as _apply
+    import numpy as _np
+
+    info = _g("auc")
+    stat_pos = _np.zeros(num_thresholds + 1, _np.int64)
+    stat_neg = _np.zeros(num_thresholds + 1, _np.int64)
+    out = _apply(info.fn, input, label, stat_pos, stat_neg,
+                 op_name="auc", num_thresholds=num_thresholds)
+    return out
+
+
+def set_ipu_shard(layer, index=-1, stage=-1):
+    raise NotImplementedError("IPU is not a TPU-framework target")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static ctr_metric_bundle: (auc, batch_auc, ...) for CTR
+    models; here the single AUC covers the bundle."""
+    return auc(input, label)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..ops.compat_extra import create_parameter as _cp
+
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+__all__ += ["accuracy", "auc", "create_parameter", "set_ipu_shard",
+            "ctr_metric_bundle"]
