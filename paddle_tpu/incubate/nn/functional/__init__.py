@@ -327,6 +327,7 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
         out, _, _, _, _ = kern(xa, ra, bias=b, ln_scale=s, ln_bias=bb,
                                dropout_rate=dropout_rate,
                                is_test=not training,
+                               dropout_fix_seed=False,
                                dropout_implementation=mode,
                                ln_epsilon=ln_epsilon)
         return out
@@ -465,6 +466,8 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
         valid = jnp.arange(S)[None, :] <= pos[:, None]      # [B, S]
         logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
         if m is not None:
+            if m.dtype == jnp.bool_:       # True = keep → additive float
+                m = jnp.where(m, 0.0, -1e30)
             mm = m.reshape(B, 1, -1)[:, :, :S].astype(jnp.float32)
             if mm.shape[-1] < S:
                 # reference masks cover only the filled prefix; padding
@@ -689,6 +692,16 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
         pad_mask = jnp.where(valid, 0.0, -1e30).astype(
             jnp.float32)[:, None, None, :]
 
+    # a boolean attn_mask (True = keep) must become an additive float mask
+    # before it is summed with pad_mask below — summing 0/1 logit offsets
+    # would silently be a no-op mask
+    if attn_mask is not None:
+        _mv = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        if _mv.dtype == jnp.bool_:
+            attn_mask = Tensor(
+                jnp.where(_mv, 0.0, -1e30).astype(jnp.float32))
+
     out = x
     new_caches = [] if cache_kvs is not None else None
     for i in range(num_layers):
@@ -770,8 +783,11 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             if pad_mask is not None:
                 mask_arg = (Tensor(pad_mask) if mask_arg is None
                             else mask_arg + Tensor(pad_mask))
+            # the seq_lens-derived pad_mask only masks padding keys; it
+            # must not switch prefill off the causal regime — only an
+            # explicit user attn_mask overrides causality
             attn = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=mask_arg, is_causal=mask_arg is None,
+                q, k, v, attn_mask=mask_arg, is_causal=attn_mask is None,
                 dropout_p=dropout_rate, training=training)
             attn_out = attn.reshape([B, S, nhd])
             if cache_kvs is not None:

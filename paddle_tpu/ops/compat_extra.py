@@ -197,12 +197,19 @@ def take(x, index, mode="raise", name=None):
     def fn(a, i):
         flat = a.ravel()
         n = flat.shape[0]
-        ii = i.astype(jnp.int64)
+        ii = jnp.asarray(i).astype(jnp.int32)
         if mode == "wrap":
             ii = ii % n
         elif mode == "clip":
             ii = jnp.clip(ii, 0, n - 1)
         else:
+            if isinstance(ii, jax.Array) and not isinstance(
+                    ii, jax.core.Tracer):
+                if bool(jnp.any((ii < -n) | (ii >= n))):
+                    raise IndexError(
+                        f"take: index out of range for a tensor of "
+                        f"{n} elements (mode='raise')")
+            # traced path cannot raise; wrap negatives like the eager path
             ii = jnp.where(ii < 0, ii + n, ii)
         return flat[ii]
 

@@ -310,3 +310,99 @@ def test_block_mha_raises_on_unsupported():
         IF.block_multihead_attention(
             None, None, None, None, None, None, None, None, None, None,
             None, mask=_t(np.zeros((1, 1), np.float32)))
+
+
+def test_fused_multi_transformer_seq_lens_keeps_causality():
+    """ADVICE r2 (medium): full-length seq_lens (no actual padding) must
+    give the same output as no seq_lens at all — i.e. the pad mask must
+    not switch prefill attention from causal to bidirectional."""
+    paddle.seed(4)
+    B, E, heads, Ff = 2, 16, 2, 32
+    S = 6
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=1)
+    layer.eval()
+    rng = np.random.RandomState(24)
+    x = rng.randn(B, S, E).astype(np.float32)
+
+    def fwd(a, lens=None):
+        return IF.fused_multi_transformer(
+            _t(a), layer.ln_scales, layer.ln_biases, layer.qkv_weights,
+            layer.qkv_biases, layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases, layer.ffn1_weights,
+            layer.ffn1_biases, layer.ffn2_weights, layer.ffn2_biases,
+            seq_lens=None if lens is None else _t(lens),
+            training=False).numpy()
+
+    full = np.full((B,), S, np.int32)
+    np.testing.assert_allclose(fwd(x, full), fwd(x), atol=1e-5)
+
+
+def test_fused_multi_transformer_prefill_decode_with_seq_lens():
+    """Prefill with seq_lens then decode must match the stateless causal
+    forward at the decode position (cache-conditioned consistency)."""
+    paddle.seed(5)
+    B, E, heads, Ff = 2, 16, 2, 32
+    S, max_seq = 4, 8
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=1)
+    layer.eval()
+    rng = np.random.RandomState(25)
+    x_all = rng.randn(B, S + 1, E).astype(np.float32)
+
+    def fwd(a, caches=None, lens=None, time_step=None):
+        return IF.fused_multi_transformer(
+            _t(a), layer.ln_scales, layer.ln_biases, layer.qkv_weights,
+            layer.qkv_biases, layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases, layer.ffn1_weights,
+            layer.ffn1_biases, layer.ffn2_weights, layer.ffn2_biases,
+            cache_kvs=caches, time_step=time_step,
+            seq_lens=None if lens is None else _t(lens), training=False)
+
+    caches = [paddle.to_tensor(
+        np.zeros((2, B, heads, max_seq, E // heads), np.float32))]
+    _, caches = fwd(x_all[:, :S], caches=caches,
+                    lens=np.full((B,), S, np.int32))
+    out_dec, _ = fwd(x_all[:, S:S + 1], caches=caches, time_step=S)
+    out_full = fwd(x_all)
+    np.testing.assert_allclose(out_dec.numpy()[:, 0],
+                               out_full.numpy()[:, S], atol=2e-4)
+
+
+def test_fused_multi_transformer_bool_attn_mask():
+    """A boolean attn_mask (True = keep) must actually mask — not be
+    summed as 0/1 logit offsets (ADVICE r2 low)."""
+    paddle.seed(6)
+    B, E, heads, Ff = 1, 16, 2, 32
+    S = 4
+    layer = inn.FusedMultiTransformer(E, heads, Ff, num_layers=1)
+    layer.eval()
+    rng = np.random.RandomState(26)
+    x = rng.randn(B, S, E).astype(np.float32)
+
+    causal = np.tril(np.ones((S, S), bool))[None, None]
+
+    def fwd(mask):
+        return IF.fused_multi_transformer(
+            _t(x), layer.ln_scales, layer.ln_biases, layer.qkv_weights,
+            layer.qkv_biases, layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases, layer.ffn1_weights,
+            layer.ffn1_biases, layer.ffn2_weights, layer.ffn2_biases,
+            attn_mask=None if mask is None else _t(mask),
+            training=False).numpy()
+
+    # bool causal mask == additive causal mask == implicit causal
+    add = np.where(causal, 0.0, -1e30).astype(np.float32)
+    np.testing.assert_allclose(fwd(causal), fwd(add), atol=1e-5)
+    np.testing.assert_allclose(fwd(causal), fwd(None), atol=1e-5)
+
+
+def test_fused_bias_dropout_residual_ln_fresh_mask_per_call():
+    """ADVICE r2 (high): training-mode dropout must draw a fresh mask per
+    call, not reuse jax.random.key(0) forever."""
+    x, res = _r((4, 8, 32), 27), _r((4, 8, 32), 28)
+    a = IF.fused_bias_dropout_residual_layer_norm(
+        _t(x), _t(res), dropout_rate=0.5, training=True).numpy()
+    b = IF.fused_bias_dropout_residual_layer_norm(
+        _t(x), _t(res), dropout_rate=0.5, training=True).numpy()
+    assert np.abs(a - b).max() > 1e-3, \
+        "two independent training calls returned identical outputs " \
+        "(constant dropout mask)"

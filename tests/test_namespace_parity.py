@@ -126,6 +126,12 @@ def test_isin_take_gamma():
     tk = paddle.take(_t(np.arange(6.0, dtype=np.float32).reshape(2, 3)),
                      _t(np.array([0, 5, -1]))).numpy()
     np.testing.assert_allclose(tk, [0.0, 5.0, 5.0])
+    with pytest.raises(IndexError):        # mode='raise' raises on OOB
+        paddle.take(_t(np.arange(6.0, dtype=np.float32)),
+                    _t(np.array([0, 6])))
+    wrapped = paddle.take(_t(np.arange(6.0, dtype=np.float32)),
+                          _t(np.array([0, 7])), mode="wrap").numpy()
+    np.testing.assert_allclose(wrapped, [0.0, 1.0])
     g = paddle.gammainc(_t(np.array([2.0], np.float32)),
                         _t(np.array([1.0], np.float32))).numpy()
     np.testing.assert_allclose(g, [1.0 - 2.0 / np.e], atol=1e-5)
