@@ -3,26 +3,35 @@
 Reference analog: the generated `*_ad_func` + phi-API dispatch chain
 (/root/reference/paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:301,
 paddle/phi/core/kernel_factory.cc:230 SelectKernelOrThrowError). There, every
-op call selects a hand-written CUDA kernel and a hand-written GradNode. Here,
-every op is a pure jax function: dispatch just unwraps Tensors, runs the
-function (XLA compiles+caches per shape under the hood), and — when autograd
-is recording — obtains the pullback with jax.vjp and records one GradNode.
+op call selects a hand-written CUDA kernel and a hand-written GradNode; the
+whole hot path is C++ (python_c_gen.py:111). Here, every op is a pure jax
+function and the hot path is a **per-signature jit cache**: the first call
+runs the op eagerly (and probes whether it draws RNG), the second call traces
+it under `jax.jit`, and every call after that is one cached-executable
+dispatch — including the autograd path, where `jax.vjp` runs *inside* the
+jitted function and the pullback flows out as a jax `Partial` that the
+backward engine re-enters through a jitted trampoline.
 
 `apply(fn, *args, **kwargs)` is the single entry point all ops go through,
 the analog of the phi kernel-dispatch funnel.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+import functools
+import types
+import weakref
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import autograd
 from .dtype import FLOATING, COMPLEX
 from .tensor import Tensor
 
-__all__ = ["apply", "defop", "param_capture"]
+__all__ = ["apply", "defop", "param_capture", "clear_op_cache",
+           "op_cache_stats"]
 
 
 def _is_tensor(x):
@@ -61,19 +70,209 @@ class param_capture:
 
 
 def _differentiable_dtype(arr) -> bool:
-    import numpy as np
-
     d = np.dtype(arr.dtype)
     return d in FLOATING or d in COMPLEX
 
 
+# ---------------------------------------------------------------------------
+# per-signature jit cache (the fast eager path)
+#
+# Key = (function fingerprint, args treedef, static leaf values,
+#        dynamic-leaf positions, differentiated positions, record?).
+# The fingerprint digs into closures so two inline `fn`s with the same code
+# but different closed-over config (e.g. take(mode=...)) never collide; any
+# closed-over array/Tensor (or other unhashable) makes the op uncacheable
+# and it stays on the legacy eager path.
+# ---------------------------------------------------------------------------
+
+class _Uncacheable(Exception):
+    pass
+
+
+_fp_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_SCALARS = (bool, int, float, complex)
+
+
+def _fp_value(v, depth):
+    if depth > 5:
+        raise _Uncacheable
+    if v is None or isinstance(v, (str, bytes)):
+        return v
+    if isinstance(v, _SCALARS):
+        # type-tag scalars: 1 / 1.0 / True hash-collide but trace differently
+        return (type(v).__name__, v)
+    if isinstance(v, (Tensor, jax.Array, np.ndarray)):
+        raise _Uncacheable
+    if isinstance(v, (list, tuple)):
+        return (type(v).__name__,
+                tuple(_fp_value(u, depth + 1) for u in v))
+    if isinstance(v, dict):
+        return tuple(sorted(
+            ((str(k), _fp_value(u, depth + 1)) for k, u in v.items())))
+    if isinstance(v, types.FunctionType):
+        return _fp_fn(v, depth + 1)
+    if isinstance(v, functools.partial):
+        return ("partial", _fp_value(v.func, depth + 1),
+                _fp_value(tuple(v.args), depth + 1),
+                _fp_value(v.keywords, depth + 1))
+    if isinstance(v, types.MethodType):
+        raise _Uncacheable  # bound self may hold arrays
+    try:
+        hash(v)
+    except TypeError:
+        raise _Uncacheable from None
+    return v
+
+
+def _fp_fn(fn, depth=0):
+    cached = _fp_cache.get(fn)
+    if cached is not None:
+        return cached
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # C-level callable (e.g. a numpy/jax builtin): identity is the key
+        try:
+            hash(fn)
+        except TypeError:
+            raise _Uncacheable from None
+        return fn
+    cells = fn.__closure__ or ()
+    fp = (code,
+          tuple(_fp_value(c.cell_contents, depth + 1) for c in cells),
+          tuple(_fp_value(d, depth + 1) for d in (fn.__defaults__ or ())))
+    if not cells:
+        try:
+            _fp_cache[fn] = fp
+        except TypeError:
+            pass
+    return fp
+
+
+class _Entry:
+    __slots__ = ("uses_rng", "disabled", "fwd", "vjp", "calls")
+
+    def __init__(self, uses_rng):
+        self.uses_rng = uses_rng
+        self.disabled = False
+        self.fwd = None
+        self.vjp = None
+        self.calls = 1
+
+
+_op_cache: dict = {}
+_MAX_ENTRIES = 4096
+_cache_enabled = True
+
+
+def clear_op_cache():
+    _op_cache.clear()
+
+
+def op_cache_stats():
+    ready = sum(1 for e in _op_cache.values()
+                if e.fwd is not None or e.vjp is not None)
+    disabled = sum(1 for e in _op_cache.values() if e.disabled)
+    return {"entries": len(_op_cache), "ready": ready, "disabled": disabled}
+
+
+def set_op_cache_enabled(on: bool):
+    global _cache_enabled
+    _cache_enabled = bool(on)
+
+
+_rand_mod = None
+
+
+def _rand():
+    global _rand_mod
+    if _rand_mod is None:
+        from ..framework import random as _r
+
+        _rand_mod = _r
+    return _rand_mod
+
+
+# the backward trampoline: re-enters a jit-produced pullback (a jax Partial
+# pytree — its residual arrays are dynamic inputs, its structure is the jit
+# key) so the backward of a cached op is itself one cached executable.
+@jax.jit
+def _pullback_call(pull, ct):
+    return pull(ct)
+
+
+class _CachedPullback:
+    __slots__ = ("pull",)
+
+    def __init__(self, pull):
+        self.pull = pull
+
+    def __call__(self, ct):
+        return _pullback_call(self.pull, ct)
+
+
+def _evict_cold_entries():
+    """Drop the half of the cache with the fewest calls (keeps hot
+    steady-state executables alive instead of a full flush)."""
+    by_heat = sorted(_op_cache.items(), key=lambda kv: kv[1].calls)
+    for k, _ in by_heat[: len(by_heat) // 2 or 1]:
+        del _op_cache[k]
+
+
+def _build_fwd(fn, treedef, static_vals, dyn_pos, uses_rng):
+    n_leaves = treedef.num_leaves
+
+    def rebuild(dyn_list):
+        merged = [None] * n_leaves
+        for i, v in static_vals:
+            merged[i] = v
+        for p, v in zip(dyn_pos, dyn_list):
+            merged[p] = v
+        a2, k2 = jax.tree.unflatten(treedef, merged)
+        return fn(*a2, **k2)
+
+    if uses_rng:
+        def fwd(rng_key, rng_ctr, dyn_list):
+            rnd = _rand()
+            with rnd.rng_guard(jax.random.fold_in(rng_key, rng_ctr)):
+                return rebuild(dyn_list)
+    else:
+        def fwd(dyn_list):
+            return rebuild(dyn_list)
+
+    return jax.jit(fwd), rebuild
+
+
+def _build_vjp(rebuild, diff_mask, uses_rng):
+    def split_run(nondiff, diff):
+        def g(*dv):
+            it_d = iter(dv)
+            it_n = iter(nondiff)
+            dyn = [next(it_d) if m else next(it_n) for m in diff_mask]
+            return rebuild(dyn)
+
+        return jax.vjp(g, *diff)
+
+    if uses_rng:
+        def vjp(rng_key, rng_ctr, nondiff, diff):
+            rnd = _rand()
+            with rnd.rng_guard(jax.random.fold_in(rng_key, rng_ctr)):
+                return split_run(nondiff, diff)
+    else:
+        def vjp(nondiff, diff):
+            return split_run(nondiff, diff)
+
+    return jax.jit(vjp)
+
+
 def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
-          **kwargs):
+          cacheable: bool = True, **kwargs):
     """Run `fn` (a pure jax function) on Tensor/array args.
 
     Tensors anywhere in the (args, kwargs) pytree are unwrapped; if any of
     them requires grad and grad mode is on, a GradNode with the jax.vjp
     pullback is recorded. Output arrays are wrapped back into Tensors.
+    Set cacheable=False for ops that do host-side validation of concrete
+    values (the jit cache would silently skip those checks).
     """
     name = op_name or getattr(fn, "__name__", "op")
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
@@ -88,26 +287,187 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
     # AMP autocast hook (reference: amp_auto_cast.h in every *_ad_func)
     from . import amp_state
 
-    target = amp_state.cast_policy(name)
-    if target is not None:
-        import numpy as np
-
+    if amp_state.amp_enabled():
+        target = amp_state.cast_policy(name)
+        if target is not None:
+            for i in tensor_pos:
+                t = flat[i]
+                d = np.dtype(t._value.dtype)
+                if d != target and d in (np.dtype(np.float32),
+                                         np.dtype(jnp.bfloat16),
+                                         np.dtype(np.float16)):
+                    flat[i] = t.astype(target)
+    diff_pos = []
+    if differentiable and autograd.is_grad_enabled():
         for i in tensor_pos:
             t = flat[i]
-            d = np.dtype(t._value.dtype)
-            if d != target and d in (np.dtype(np.float32),
-                                     np.dtype(jnp.bfloat16),
-                                     np.dtype(np.float16)):
-                flat[i] = t.astype(target)
-    record = (
-        differentiable
-        and autograd.is_grad_enabled()
-        and any(
-            not flat[i].stop_gradient and _differentiable_dtype(flat[i]._value)
-            for i in tensor_pos
-        )
-    )
+            if not t.stop_gradient and _differentiable_dtype(t._value):
+                diff_pos.append(i)
+    record = bool(diff_pos)
 
+    if (_cache_enabled and cacheable
+            and _ProgramRecorder.active is None):
+        result = _apply_cached(fn, name, flat, treedef, tensor_pos,
+                               diff_pos, record)
+        if result is not _MISS:
+            return result
+    return _apply_legacy(fn, name, flat, treedef, diff_pos, record)
+
+
+_MISS = object()
+
+
+def _next_rng_inputs(rnd):
+    """Fresh (key, counter) for a cached RNG op, honoring an active
+    rng_guard exactly like next_key() does (guard draws must stay
+    deterministic per guard key and must not advance the global state)."""
+    st = rnd._state
+    if st.guard_key is not None:
+        st.guard_counter += 1
+        return st.guard_key, np.int32(st.guard_counter)
+    st.counter += 1
+    return st.key, np.int32(st.counter)
+
+
+def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
+    # one pass: partition leaves into static (key material) and dynamic
+    static_items = []   # (index, type-name, key-fingerprint)
+    static_vals = []    # (index, original value) — what rebuild injects
+    dyn_pos = []
+    dyn_vals = []
+    diff_set = set(diff_pos)
+    diff_mask = []
+    for i, x in enumerate(flat):
+        if _is_tensor(x):
+            v = x._value
+        elif isinstance(x, (jax.Array, np.ndarray)):
+            v = x
+        else:
+            if isinstance(x, _SCALARS) or x is None \
+                    or isinstance(x, (str, bytes)):
+                static_items.append((i, type(x).__name__, x))
+            else:
+                try:
+                    static_items.append(
+                        (i, type(x).__name__, _fp_value(x, 0)))
+                except _Uncacheable:
+                    return _MISS
+            static_vals.append((i, x))
+            continue
+        if isinstance(v, jax.core.Tracer):
+            return _MISS  # inside an outer trace: no nested caching
+        dyn_pos.append(i)
+        dyn_vals.append(v)
+        diff_mask.append(i in diff_set)
+    try:
+        fp = _fp_fn(fn)
+    except _Uncacheable:
+        return _MISS
+    key = (fp, treedef, tuple(static_items), tuple(dyn_pos),
+           tuple(diff_mask), record)
+    entry = _op_cache.get(key)
+    rnd = _rand()
+    if entry is None:
+        if len(_op_cache) >= _MAX_ENTRIES:
+            _evict_cold_entries()
+        d0 = rnd.draw_count()
+        result = _apply_legacy(fn, name, flat, treedef, diff_pos, record)
+        _op_cache[key] = _Entry(uses_rng=rnd.draw_count() != d0)
+        return result
+    if entry.disabled:
+        return _MISS
+    entry.calls += 1
+    try:
+        if record:
+            if entry.vjp is None:
+                _, rebuild = _build_fwd(fn, treedef, tuple(static_vals),
+                                        tuple(dyn_pos), entry.uses_rng)
+                entry.vjp = _build_vjp(rebuild, tuple(diff_mask),
+                                       entry.uses_rng)
+            nondiff = [v for v, m in zip(dyn_vals, diff_mask) if not m]
+            diff = [v for v, m in zip(dyn_vals, diff_mask) if m]
+            if entry.uses_rng:
+                rkey, rctr = _next_rng_inputs(rnd)
+                out, pull = entry.vjp(rkey, rctr, nondiff, diff)
+            else:
+                out, pull = entry.vjp(nondiff, diff)
+            return _finish_record(fn, name, flat, treedef, diff_pos, out,
+                                  _CachedPullback(pull))
+        if entry.fwd is None:
+            entry.fwd, _ = _build_fwd(fn, treedef, tuple(static_vals),
+                                      tuple(dyn_pos), entry.uses_rng)
+        if entry.uses_rng:
+            rkey, rctr = _next_rng_inputs(rnd)
+            out = entry.fwd(rkey, rctr, dyn_vals)
+        else:
+            out = entry.fwd(dyn_vals)
+    except Exception:
+        entry.disabled = True
+        try:
+            result = _apply_legacy(fn, name, flat, treedef, diff_pos, record)
+        except Exception:
+            # the op itself fails (shape/dtype error, not a tracing
+            # limitation): surface the real error, keep the cache live
+            entry.disabled = False
+            raise
+        return result
+    from ..utils import flags as _flags
+
+    if _flags.flag("check_nan_inf"):
+        check_nan_inf(name, jax.tree.leaves(out))
+    return _wrap_outputs(out, node=None)
+
+
+def _make_run(fn, flat, treedef, diff_pos):
+    """Pure function of the differentiable inputs, used for jax.vjp on the
+    legacy path and as the GradNode primal for double backward."""
+    base = [x._value if _is_tensor(x) else x for x in flat]
+
+    def run(*diff_arrays):
+        merged = list(base)
+        for i, arr in zip(diff_pos, diff_arrays):
+            merged[i] = arr
+        a2, k2 = jax.tree.unflatten(treedef, merged)
+        return fn(*a2, **k2)
+
+    return run
+
+
+def _finish_record(fn, name, flat, treedef, diff_pos, out, vjp_fn):
+    out_flat, out_treedef = jax.tree.flatten(out)
+    from ..utils import flags as _flags
+
+    if _flags.flag("check_nan_inf"):
+        check_nan_inf(name, out_flat)
+    out_avals = [o.aval if isinstance(o, jax.Array)
+                 else jax.ShapeDtypeStruct(np.shape(o), np.asarray(o).dtype)
+                 for o in out_flat]
+    node = autograd.GradNode(
+        name,
+        vjp_fn,
+        [flat[i] for i in diff_pos],
+        out_treedef,
+        out_avals,
+        primal_fn=_make_run(fn, flat, treedef, diff_pos),
+    )
+    wrapped_flat = [
+        Tensor(o, stop_gradient=False, _grad_node=node, _out_index=i)
+        for i, o in enumerate(out_flat)
+    ]
+    for i, t in enumerate(wrapped_flat):
+        node.set_output(i, t)
+    result = jax.tree.unflatten(out_treedef, wrapped_flat)
+    if _ProgramRecorder.active is not None:
+        tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
+        _ProgramRecorder.active._record(
+            name, fn, flat, tensor_pos, treedef, result)
+    return result
+
+
+def _apply_legacy(fn, name, flat, treedef, diff_pos, record):
+    """The original per-op eager path: run fn (and jax.vjp when recording)
+    directly. First call of every cache entry, uncacheable ops, and
+    everything under an active Program recorder or outer trace."""
     if not record:
         flat2 = [x._value if _is_tensor(x) else x for x in flat]
         a2, k2 = jax.tree.unflatten(treedef, flat2)
@@ -119,54 +479,16 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
             check_nan_inf(name, jax.tree.leaves(out))
         wrapped = _wrap_outputs(out, node=None)
         if _ProgramRecorder.active is not None:
+            tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]
             _ProgramRecorder.active._record(
                 name, fn, flat, tensor_pos, treedef, wrapped)
         return wrapped
 
-    diff_pos = [
-        i
-        for i in tensor_pos
-        if not flat[i].stop_gradient and _differentiable_dtype(flat[i]._value)
-    ]
-    diff_set = set(diff_pos)
-    base = [x._value if _is_tensor(x) else x for x in flat]
-
-    def run(*diff_arrays):
-        merged = list(base)
-        for i, arr in zip(diff_pos, diff_arrays):
-            merged[i] = arr
-        a2, k2 = jax.tree.unflatten(treedef, merged)
-        return fn(*a2, **k2)
-
-    primals = [base[i] for i in diff_pos]
+    run = _make_run(fn, flat, treedef, diff_pos)
+    primals = [flat[i]._value for i in diff_pos]
     with autograd.no_grad():
         out, vjp_fn = jax.vjp(run, *primals)
-
-    out_flat, out_treedef = jax.tree.flatten(out)
-    from ..utils import flags as _flags
-
-    if _flags.flag("check_nan_inf"):
-        check_nan_inf(name, out_flat)
-    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_flat]
-    node = autograd.GradNode(
-        name,
-        vjp_fn,
-        [flat[i] for i in diff_pos],
-        out_treedef,
-        out_avals,
-        primal_fn=run,
-    )
-    wrapped_flat = [
-        Tensor(o, stop_gradient=False, _grad_node=node, _out_index=i)
-        for i, o in enumerate(out_flat)
-    ]
-    for i, t in enumerate(wrapped_flat):
-        node.set_output(i, t)
-    result = jax.tree.unflatten(out_treedef, wrapped_flat)
-    if _ProgramRecorder.active is not None:
-        _ProgramRecorder.active._record(
-            name, fn, flat, tensor_pos, treedef, result)
-    return result
+    return _finish_record(fn, name, flat, treedef, diff_pos, out, vjp_fn)
 
 
 def _wrap_outputs(out, node):
@@ -179,8 +501,6 @@ def check_nan_inf(name, arrays):
     """FLAGS_check_nan_inf debug mode (reference: paddle/common/flags.cc:72,
     nan_inf_utils hooks in eager + new_executor). Eager-only: sync-checks
     every op output; level>=3 reports instead of raising."""
-    import numpy as np
-
     from ..utils import flags as _flags
 
     for a in arrays:
@@ -208,8 +528,6 @@ def defop(name: str = None, differentiable: bool = True):
     """
 
     def deco(fn):
-        import functools
-
         op_name = name or fn.__name__
 
         @functools.wraps(fn)

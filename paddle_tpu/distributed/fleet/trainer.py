@@ -106,8 +106,10 @@ class HybridTrainer:
                 loss_of = lambda p: llama_mod.loss_fn_pipelined(  # noqa: E731
                     p, (input_ids, labels), cfg, mesh, remat=remat)
             else:
+                # sep>1: ring-attention context parallel inside the trunk
+                sep_mesh = mesh if mesh.shape.get("sep", 1) > 1 else None
                 loss_of = lambda p: llama_mod.loss_fn_stacked(  # noqa: E731
-                    p, (input_ids, labels), cfg, remat=remat)
+                    p, (input_ids, labels), cfg, remat=remat, mesh=sep_mesh)
             loss, grads = jax.value_and_grad(loss_of)(params)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             if clip is not None:

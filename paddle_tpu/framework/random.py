@@ -58,6 +58,7 @@ class _RNGState(threading.local):
     def __init__(self):
         self._key = None
         self.counter = 0
+        self.draws = 0
         # when set, draws fold counters into this (possibly traced) key
         self.guard_key = None
         self.guard_counter = 0
@@ -99,11 +100,20 @@ def set_rng_state(state):
 def next_key():
     """Return a fresh PRNG key. Inside rng_guard, derives from the guard key
     (trace-safe); otherwise advances the global eager state."""
+    _state.draws += 1
     if _state.guard_key is not None:
         _state.guard_counter += 1
         return jax.random.fold_in(_state.guard_key, _state.guard_counter)
     _state.counter += 1
     return jax.random.fold_in(_state.key, _state.counter)
+
+
+def draw_count():
+    """Total next_key() draws on this thread — the dispatcher's jit cache
+    probes this around an op's first (eager) run to learn whether the op
+    consumes randomness and therefore needs a key threaded as a traced
+    input (a baked-in constant key would freeze the op's randomness)."""
+    return _state.draws
 
 
 @contextlib.contextmanager

@@ -394,8 +394,15 @@ def _rope(q, k, theta):
     return rot(q), rot(k)
 
 
-def _block(params, x, config: LlamaConfig):
-    """One decoder block on raw arrays (used inside lax.scan)."""
+def _block(params, x, config: LlamaConfig, mesh=None):
+    """One decoder block on raw arrays (used inside lax.scan). When `mesh`
+    is given and its 'sep' axis is >1, attention runs as a ring over the
+    sequence shards (ops/pallas/ring_attention: ppermute of K/V blocks
+    with online-softmax merge and a hand-written ring VJP) inside a
+    shard_map manual over 'sep' ONLY — dp/sharding/mp stay GSPMD-auto.
+    This is the TPU-native SEP/context-parallel engine (SURVEY §2.5
+    segment_parallel.py:26; the reference delegates ring-style attention
+    to fused kernels + sep process groups)."""
     h = config.hidden_size
     nh, kvh, hd = (config.num_attention_heads, config.num_key_value_heads,
                    config.head_dim)
@@ -412,7 +419,20 @@ def _block(params, x, config: LlamaConfig):
         v = jnp.repeat(v, rep, axis=2)
     from jax.ad_checkpoint import checkpoint_name
 
-    attn = fa.flash_attention_bshd(q, k, v, is_causal=True)
+    if mesh is not None and mesh.shape.get("sep", 1) > 1:
+        from ..ops.pallas import ring_attention as ra
+
+        def ring_attn(qq, kk, vv):
+            return ra.ring_attention_bshd(qq, kk, vv, axis_name="sep",
+                                          is_causal=True)
+
+        seq_spec = P(None, "sep")
+        attn = jax.shard_map(
+            ring_attn, mesh=mesh,
+            in_specs=(seq_spec, seq_spec, seq_spec), out_specs=seq_spec,
+            axis_names={"sep"}, check_vma=False)(q, k, v)
+    else:
+        attn = fa.flash_attention_bshd(q, k, v, is_causal=True)
     attn = checkpoint_name(attn, "flash_attn_out")
     x = x + attn.reshape(b, s, h) @ params["wo"]
 
@@ -422,7 +442,8 @@ def _block(params, x, config: LlamaConfig):
     return x
 
 
-def _trunk(params, input_ids, config: LlamaConfig, remat: bool = True):
+def _trunk(params, input_ids, config: LlamaConfig, remat: bool = True,
+           mesh=None):
     """Embedding -> lax.scan over stacked blocks (constant compile time in
     depth; blocks rematerialized in backward when remat=True). The single
     source of the trunk pattern for the stacked forward/loss paths."""
@@ -431,7 +452,7 @@ def _trunk(params, input_ids, config: LlamaConfig, remat: bool = True):
         x = x.astype(jnp.bfloat16)
 
     def body(carry, layer_params):
-        return _block(layer_params, carry, config), None
+        return _block(layer_params, carry, config, mesh=mesh), None
 
     if remat:
         # "save_attn": keep each block's flash-attention output across the
@@ -467,10 +488,12 @@ def _head_loss(params, h, labels, config: LlamaConfig):
     return -jnp.mean(picked)
 
 
-def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True):
-    """Next-token LM loss; batch = (input_ids[B,S], labels[B,S])."""
+def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True,
+                    mesh=None):
+    """Next-token LM loss; batch = (input_ids[B,S], labels[B,S]). Pass
+    `mesh` with a 'sep' axis >1 to run ring-attention context parallel."""
     input_ids, labels = batch
-    x = _trunk(params, input_ids, config, remat)
+    x = _trunk(params, input_ids, config, remat, mesh=mesh)
     return _head_loss(params, x, labels, config)
 
 

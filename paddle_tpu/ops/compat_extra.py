@@ -213,7 +213,9 @@ def take(x, index, mode="raise", name=None):
             ii = jnp.where(ii < 0, ii + n, ii)
         return flat[ii]
 
-    return apply(fn, x, index, op_name="take")
+    # cacheable=False: the mode='raise' OOB check inspects concrete index
+    # values — a cached trace would silently skip it
+    return apply(fn, x, index, op_name="take", cacheable=False)
 
 
 @_export
@@ -349,7 +351,10 @@ def masked_scatter(x, mask, value, name=None):
         return jnp.where(m.ravel(), picked.astype(a.dtype),
                          a.ravel()).reshape(a.shape)
 
-    return apply(fn, x, mask, value, op_name="masked_scatter")
+    # cacheable=False: the value-count check inspects the concrete mask —
+    # a cached trace would silently skip it
+    return apply(fn, x, mask, value, op_name="masked_scatter",
+                 cacheable=False)
 
 
 @_export

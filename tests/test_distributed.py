@@ -450,3 +450,43 @@ def test_flash_attention_causal_cross_window():
     for a, b_ in zip(gp, gr):
         assert np.allclose(np.asarray(a), np.asarray(b_),
                            rtol=1e-3, atol=1e-4)
+
+
+def test_hybrid_trainer_stage3_and_ring_attention_parity():
+    """VERDICT r2 #2: trainer-level ZeRO-3 ('sharding'=2) and ring
+    attention ('sep'=2) configs must produce the same first-step loss as
+    the dense dp-only factorization — the full train step, not just the
+    shard_map unit kernels."""
+    from paddle_tpu.distributed.fleet.trainer import HybridTrainer
+    from paddle_tpu.models import llama
+
+    cfg = llama.LlamaConfig(vocab_size=128, hidden_size=32,
+                            intermediate_size=64, num_hidden_layers=2,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            max_position_embeddings=64, dtype="float32")
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 128, (4, 16)).astype(np.int32)
+    labels = np.roll(ids, -1, 1)
+
+    losses = {}
+    params_after = {}
+    for tag, shape in (("dense", (2, 1, 1, 1, 1)),
+                       ("zero3", (2, 1, 2, 1, 2)),
+                       ("ring_sep", (1, 1, 1, 2, 2))):
+        n = int(np.prod(shape))
+        mesh = _mesh(shape, ("dp", "pp", "sharding", "sep", "mp"))
+        tr = HybridTrainer(cfg, mesh, learning_rate=1e-2)
+        if tag == "zero3":
+            spec = str(tr.params["blocks"]["wq"].sharding.spec)
+            assert "sharding" in spec, spec   # params genuinely ZeRO-sharded
+        losses[tag] = float(jax.device_get(tr.step(ids, labels)))
+        params_after[tag] = jax.device_get(tr.params["blocks"]["wq"])
+    assert np.isfinite(list(losses.values())).all()
+    np.testing.assert_allclose(losses["zero3"], losses["dense"], rtol=2e-4)
+    np.testing.assert_allclose(losses["ring_sep"], losses["dense"],
+                               rtol=2e-4)
+    # one optimizer step under each factorization lands on the same params
+    np.testing.assert_allclose(params_after["zero3"],
+                               params_after["dense"], atol=2e-4)
+    np.testing.assert_allclose(params_after["ring_sep"],
+                               params_after["dense"], atol=2e-4)

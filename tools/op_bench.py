@@ -94,6 +94,7 @@ def main():
         if info is not None:
             results[f"op:{name}"] = _bench(
                 lambda a, _f=info.fn, _e=extra: _f(a, *_e), arr)
+    results.update(_bench_eager_dispatch())
 
     out = {"device": str(jax.devices()[0]),
            "backend": jax.default_backend(),
@@ -106,6 +107,44 @@ def main():
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
+
+
+def _bench_eager_dispatch():
+    """Steady-state eager dispatch through the per-signature jit cache
+    (regression gate for VERDICT r2 #1 — uncached this was 5,447 µs/iter
+    on a v5e for grad-recorded matmul(1024²)+add)."""
+    import paddle_tpu as paddle
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1024, 1024).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(1024, 1024).astype(np.float32))
+    x.stop_gradient = False
+
+    def fwd():
+        return (paddle.matmul(x, y) + x)._value
+
+    def fwdbwd():
+        z = (paddle.matmul(x, y) + x).sum()
+        z.backward()
+        g = x.grad._value
+        x.clear_grad()
+        return g
+
+    out = {}
+    for name, f in (("eager:matmul_add_fwd", fwd),
+                    ("eager:matmul_add_fwd_bwd", fwdbwd)):
+        for _ in range(6):
+            jax.device_get(f())          # legacy + trace + steady warmup
+        n = 50
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                f()
+            jax.device_get(f())
+            best = min(best, (time.perf_counter() - t0) / (n + 1))
+        out[name] = best
+    return out
 
 
 if __name__ == "__main__":
