@@ -215,23 +215,30 @@ def bench_eager_dispatch(on_tpu):
         x.clear_grad()
         return g
 
-    for _ in range(6):
-        jax.device_get(fwd())  # warm: legacy call + trace + steady
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fwd()
-    jax.device_get(fwd())
-    fwd_us = (time.perf_counter() - t0) / (n + 1) * 1e6
+    def measure(f):
+        # dispatch throughput: drain the queue, then time n async enqueues
+        # (min over repeats — the tunneled chip's sync round-trip is ~100ms
+        # and must not be smeared into the per-op dispatch number; the
+        # uncached 5,447 us/iter baseline was measured the same way)
+        for _ in range(6):
+            jax.device_get(f())   # warm: legacy + trace + steady
+        best = float("inf")
+        for _ in range(3):
+            jax.device_get(f())   # drain
+            t0 = time.perf_counter()
+            for _ in range(n):
+                f()
+            best = min(best, (time.perf_counter() - t0) / n)
+        t0 = time.perf_counter()
+        jax.device_get(f())
+        sync_ms = (time.perf_counter() - t0) * 1e3
+        return best * 1e6, sync_ms
 
-    for _ in range(6):
-        jax.device_get(fwdbwd())
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fwdbwd()
-    jax.device_get(fwdbwd())
-    fwdbwd_us = (time.perf_counter() - t0) / (n + 1) * 1e6
+    fwd_us, _ = measure(fwd)
+    fwdbwd_us, sync_ms = measure(fwdbwd)
     return {"matmul_add_fwd_us": round(fwd_us, 1),
             "matmul_add_fwd_bwd_us": round(fwdbwd_us, 1),
+            "queue_drain_ms": round(sync_ms, 1),
             "op_cache": _dispatch.op_cache_stats()}
 
 

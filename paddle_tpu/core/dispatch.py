@@ -371,7 +371,12 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
         if len(_op_cache) >= _MAX_ENTRIES:
             _evict_cold_entries()
         d0 = rnd.draw_count()
-        result = _apply_legacy(fn, name, flat, treedef, diff_pos, record)
+        # probe under a deferred guard: if the op draws, its keys derive
+        # exactly as the cached executable will derive them, so the i-th
+        # post-seed draw is identical cold-cache or warm-cache
+        with rnd.deferred_rng_guard():
+            result = _apply_legacy(fn, name, flat, treedef, diff_pos,
+                                   record)
         _op_cache[key] = _Entry(uses_rng=rnd.draw_count() != d0)
         return result
     if entry.disabled:

@@ -62,6 +62,7 @@ class _RNGState(threading.local):
         # when set, draws fold counters into this (possibly traced) key
         self.guard_key = None
         self.guard_counter = 0
+        self.deferred_prev = None
 
     @property
     def key(self):
@@ -97,15 +98,56 @@ def set_rng_state(state):
     _state.key, _state.counter = state
 
 
+_DEFERRED = object()
+
+
 def next_key():
     """Return a fresh PRNG key. Inside rng_guard, derives from the guard key
     (trace-safe); otherwise advances the global eager state."""
     _state.draws += 1
+    if _state.guard_key is _DEFERRED:
+        _materialize_deferred_guard()
     if _state.guard_key is not None:
         _state.guard_counter += 1
         return jax.random.fold_in(_state.guard_key, _state.guard_counter)
     _state.counter += 1
     return jax.random.fold_in(_state.key, _state.counter)
+
+
+def _materialize_deferred_guard():
+    """First draw under a deferred guard: advance the PARENT stream (the
+    global state or an enclosing guard) by exactly one key and adopt it as
+    this guard's key — the same derivation the dispatcher's cached
+    executables use, so the i-th post-seed draw is identical whether an op
+    runs its first (probe) call or a warm cached call."""
+    prev_guard, prev_counter = _state.deferred_prev
+    _state.guard_key, _state.guard_counter = prev_guard, prev_counter
+    _state.draws -= 1          # the parent advance is not a user draw
+    k = next_key()
+    # propagate the parent's consumed counter back through the restore in
+    # deferred_rng_guard's finally (it restores from deferred_prev)
+    _state.deferred_prev = (_state.guard_key, _state.guard_counter)
+    _state.guard_key = k
+    _state.guard_counter = 0
+
+
+@contextlib.contextmanager
+def deferred_rng_guard():
+    """Guard for a cache entry's first (probe) run: materializes its key
+    lazily on the first draw, so ops that consume no randomness leave the
+    RNG stream untouched while RNG ops derive keys exactly like the
+    dispatcher's cached fast path (fold_in(parent_key, ++parent_counter)
+    then per-draw fold_ins)."""
+    prev = (_state.guard_key, _state.guard_counter)
+    prev_deferred = getattr(_state, "deferred_prev", None)
+    _state.deferred_prev = prev
+    _state.guard_key = _DEFERRED
+    _state.guard_counter = 0
+    try:
+        yield
+    finally:
+        _state.guard_key, _state.guard_counter = _state.deferred_prev
+        _state.deferred_prev = prev_deferred
 
 
 def draw_count():

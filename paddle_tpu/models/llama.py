@@ -482,10 +482,13 @@ def _head_loss(params, h, labels, config: LlamaConfig):
     mean next-token NLL. h: [..., S, H], labels: [..., S]."""
     h = rn.rms_norm(h, params["final_norm"], config.rms_norm_eps)
     logits = h.astype(jnp.float32) @ params["lm_head"].astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # lse - picked, not log_softmax: avoids materializing a second
+    # [.., S, V] fp32 array (reductions fuse into one pass over logits)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    lse = m[..., 0] + jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1))
     picked = jnp.take_along_axis(
-        logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
-    return -jnp.mean(picked)
+        logits, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return jnp.mean(lse - picked)
 
 
 def loss_fn_stacked(params, batch, config: LlamaConfig, remat: bool = True,

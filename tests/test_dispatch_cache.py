@@ -248,3 +248,28 @@ def test_callable_static_arg_cached_correctly():
         for _ in range(4):
             r = apply(op, x, np.sqrt, op_name="apply_act_np").numpy()
             np.testing.assert_allclose(r, 3.0)
+
+
+def test_seed_reproducible_across_cache_states():
+    """The i-th post-seed RNG draw must be identical whether the op's
+    cache entry is cold (probe run) or warm (cached executable)."""
+    x = _t(np.ones((32, 32), np.float32))
+    with paddle.no_grad():
+        paddle.seed(7)
+        cold = [F.dropout(x, 0.5, training=True).numpy()
+                for _ in range(3)]          # call 0 = probe, 1 = trace, 2+
+        paddle.seed(7)
+        warm = [F.dropout(x, 0.5, training=True).numpy()
+                for _ in range(3)]          # all warm
+    for i in range(3):
+        np.testing.assert_array_equal(cold[i], warm[i])
+    # and non-RNG probe calls must not perturb the stream
+    dispatch.clear_op_cache()
+    with paddle.no_grad():
+        paddle.seed(9)
+        _ = paddle.matmul(x, x)             # cold probe, draws nothing
+        a = F.dropout(x, 0.5, training=True).numpy()
+        paddle.seed(9)
+        _ = paddle.matmul(x, x)             # warm, draws nothing
+        b = F.dropout(x, 0.5, training=True).numpy()
+    np.testing.assert_array_equal(a, b)

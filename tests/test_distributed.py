@@ -473,7 +473,6 @@ def test_hybrid_trainer_stage3_and_ring_attention_parity():
     for tag, shape in (("dense", (2, 1, 1, 1, 1)),
                        ("zero3", (2, 1, 2, 1, 2)),
                        ("ring_sep", (1, 1, 1, 2, 2))):
-        n = int(np.prod(shape))
         mesh = _mesh(shape, ("dp", "pp", "sharding", "sep", "mp"))
         tr = HybridTrainer(cfg, mesh, learning_rate=1e-2)
         if tag == "zero3":

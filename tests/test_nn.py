@@ -230,3 +230,20 @@ def test_clip_grad_norm():
     (p * 100).sum().backward()
     nn.utils.clip_grad_norm_([p], max_norm=1.0)
     assert np.linalg.norm(p.grad.numpy()) <= 1.01
+
+
+def test_cross_entropy_use_softmax_false_hard_label():
+    """use_softmax=False + integer labels: inputs are probabilities, the
+    loss is -log(p[label]) (regression: this combo must not route through
+    the soft-label formula)."""
+    probs = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    lab = np.array([[0], [1]], np.int64)
+    got = F.cross_entropy(paddle.to_tensor(probs), paddle.to_tensor(lab),
+                          use_softmax=False).numpy()
+    ref = -np.log([0.7, 0.8]).mean()
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # with ignore_index
+    lab2 = np.array([[0], [-100]], np.int64)
+    got2 = F.cross_entropy(paddle.to_tensor(probs), paddle.to_tensor(lab2),
+                           use_softmax=False).numpy()
+    np.testing.assert_allclose(got2, -np.log(0.7), rtol=1e-5)
