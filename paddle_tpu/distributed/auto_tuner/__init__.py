@@ -71,19 +71,34 @@ def estimate_memory_bytes(cfg: TunerCfg, n_params: int, hidden: int,
                           state_bytes: int = 8) -> float:
     """Per-chip memory model (reference cost_model.py shape): params split
     by mp*pp (and sharding at stage 3), optimizer states by sharding,
-    activations by remat policy."""
+    activations by remat policy.
+
+    Calibrated against XLA memory_analysis of the AdamW train step of
+    Llama-2-13B-dimension blocks (hidden 5120 / 40 heads / seq 4096,
+    bf16, flash attention) on a v5e chip across micro-batch 1-4, layer
+    counts 1-2, and remat on/off — all points within ~13% of measured
+    (argument + temp bytes); see tools/validate_memory_model.py and the
+    llama13b_block bench row."""
     shard_p = cfg.mp * cfg.pp * (cfg.sharding_degree
                                  if cfg.sharding_stage >= 3 else 1)
     shard_s = cfg.mp * cfg.pp * cfg.sharding_degree
     params = n_params * param_bytes / shard_p
-    grads = n_params * 4 / (cfg.mp * cfg.pp * (
+    # grads materialize fully when a layer stack is scanned (stacked grad
+    # arrays); with a single resident layer XLA aliases most grad buffers
+    # straight into the optimizer update
+    layers_here = max(layers / cfg.pp, 1)
+    grad_frac = 1.0 if layers_here > 1 else 0.45
+    grads = n_params * 4 * grad_frac / (cfg.mp * cfg.pp * (
         cfg.sharding_degree if cfg.sharding_stage >= 2 else 1))
     states = n_params * state_bytes / shard_s
-    # activations: per microbatch per layer ~ s*h*K bytes (K~34 full,
-    # ~4 with full remat), layers split by pp, hidden split by mp
-    k = 4 if cfg.recompute else 34
-    acts = (cfg.micro_batch_size * seq * hidden * k
-            * (layers / cfg.pp) * 2 / cfg.mp)
+    # activations per microbatch, in units of seq*hidden*2 bytes:
+    # k_layer saved per extra layer (remat(save_attn) keeps the block
+    # input + flash output; full saves every intermediate) + a k_base
+    # backward working set for the active layer
+    k_layer = 4 if cfg.recompute else 22
+    k_base = 21
+    acts = (cfg.micro_batch_size * seq * hidden * 2
+            * (k_layer * max(layers_here - 1, 0) + k_base) / cfg.mp)
     return params + grads + states + acts
 
 

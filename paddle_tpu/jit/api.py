@@ -29,15 +29,61 @@ __all__ = ["to_static", "TrainStep", "in_to_static_tracing", "save", "load",
 
 def _trace_break_errors():
     """Exceptions that mean 'this Python cannot be traced' — the
-    graph-break condition. Reference: SOT (python/paddle/jit/sot/) exists
-    to eval-frame-capture exactly these cases; the TPU-native 80/20 is to
-    fall back to eager for the offending callable with a warning."""
+    graph-break condition. On the first such error StaticFunction/
+    TrainStep run the dy2static AST converter (jit/dy2static.py — the
+    program_translator/SOT analog) and retry with tensor-dependent
+    if/while/for lowered to lax control flow; only if the retry also
+    breaks do they fall back to eager with a warning."""
     import jax.errors as jerr
+
+    from .dy2static import DynamicControlFlowError
 
     return (jerr.TracerBoolConversionError,
             jerr.TracerArrayConversionError,
             jerr.TracerIntegerConversionError,
-            jerr.ConcretizationTypeError)
+            jerr.ConcretizationTypeError,
+            DynamicControlFlowError)
+
+
+def _reachable_values(fn):
+    """Objects a plain function can see: closure cells, bound self, and
+    the globals it actually LOADs (dis-precise — co_names also holds
+    attribute names, which must not trigger conversion of unrelated
+    same-named module globals)."""
+    values = []
+    for c in getattr(fn, "__closure__", None) or ():
+        try:
+            values.append(c.cell_contents)
+        except ValueError:        # empty cell
+            pass
+    if hasattr(fn, "__self__"):
+        values.append(fn.__self__)
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        import dis
+
+        g = getattr(fn, "__globals__", {})
+        try:
+            loaded = {i.argval for i in dis.get_instructions(code)
+                      if i.opname == "LOAD_GLOBAL"}
+        except Exception:
+            loaded = set()
+        values.extend(g[n] for n in loaded if n in g)
+    return values
+
+
+def _try_convert_target(target) -> bool:
+    """Run the dy2static converter over a Layer tree or plain function.
+    Returns True if anything was converted (caller should retry the
+    trace). Layer forwards are rebound in place (instance-level) — the
+    converted code is semantics-preserving for concrete conditions, so
+    eager execution through the same instance stays correct."""
+    from ..nn.layer.layers import Layer
+    from . import dy2static
+
+    if isinstance(target, Layer):
+        return dy2static.convert_layer_tree(target)
+    return False
 
 
 def _warn_graph_break(name: str, exc: Exception):
@@ -123,23 +169,57 @@ class StaticFunction:
         in_arrays = [a._value if isinstance(a, Tensor) else a for a in args]
         seed = next_key()
         try:
-            if self._is_layer:
-                if self._compiled is None:
-                    self._compiled = self._build_layer_fn()
-                params = FB.current_params(self._target)
-                buffers = FB.current_buffers(self._target)
-                out, new_buf = self._compiled(params, buffers, seed,
-                                              *in_arrays)
-                FB.write_back(self._target, {}, new_buf)
-            else:
-                if self._compiled is None:
-                    self._compiled = self._build_fn()
-                out = self._compiled(seed, *in_arrays, **kwargs)
+            return self._run_compiled(seed, in_arrays, kwargs)
         except _trace_break_errors() as e:
+            # dy2static retry: lower tensor-dependent control flow to
+            # lax.cond/while_loop, then re-trace once
+            if not getattr(self, "_converted", False):
+                self._converted = True
+                converted = self._convert_target()
+                if converted:
+                    self._compiled = None
+                    try:
+                        return self._run_compiled(seed, in_arrays, kwargs)
+                    except _trace_break_errors() as e2:
+                        e = e2
             _warn_graph_break(getattr(self._target, "__name__",
                                       type(self._target).__name__), e)
             self._fallback = True
             return self._eager_call(*args, **kwargs)
+
+    def _convert_target(self):
+        from ..nn.layer.layers import Layer
+        from .dy2static import convert_function, convert_layer_tree
+
+        if self._is_layer:
+            return _try_convert_target(self._target)
+        converted = False
+        new = convert_function(self._target)
+        if new is not None:
+            self._target = new
+            converted = True
+        # a plain-function target (e.g. `lambda x: model(x)`) reaches the
+        # model through its closure, its bound self, or a referenced
+        # global — convert any Layer it can see so sublayer forwards
+        # lower too
+        for v in _reachable_values(self._target):
+            if isinstance(v, Layer):
+                converted = convert_layer_tree(v) or converted
+        return converted
+
+    def _run_compiled(self, seed, in_arrays, kwargs):
+        if self._is_layer:
+            if self._compiled is None:
+                self._compiled = self._build_layer_fn()
+            params = FB.current_params(self._target)
+            buffers = FB.current_buffers(self._target)
+            out, new_buf = self._compiled(params, buffers, seed,
+                                          *in_arrays)
+            FB.write_back(self._target, {}, new_buf)
+        else:
+            if self._compiled is None:
+                self._compiled = self._build_fn()
+            out = self._compiled(seed, *in_arrays, **kwargs)
         return jax.tree.map(lambda x: Tensor(x), out)
 
     def _eager_call(self, *args, **kwargs):
@@ -336,10 +416,23 @@ class TrainStep:
             new_params, new_states, new_buf, loss = self._compiled(
                 params, opt_states, buffers, lr, step_i, seed, *arrays)
         except _trace_break_errors() as e:
-            _warn_graph_break(type(self.model).__name__, e)
-            self._fallback = True
-            self.optimizer._step_count -= 1   # eager step re-counts
-            return self._eager_step(*batch)
+            retried = False
+            if not getattr(self, "_converted", False):
+                self._converted = True
+                if self._convert_model_and_loss():
+                    self._compiled = self._build()
+                    try:
+                        new_params, new_states, new_buf, loss = \
+                            self._compiled(params, opt_states, buffers,
+                                           lr, step_i, seed, *arrays)
+                        retried = True
+                    except _trace_break_errors() as e2:
+                        e = e2
+            if not retried:
+                _warn_graph_break(type(self.model).__name__, e)
+                self._fallback = True
+                self.optimizer._step_count -= 1   # eager step re-counts
+                return self._eager_step(*batch)
         FB.write_back(self.model, new_params, new_buf)
         name_to_param = dict(self.model.named_parameters())
         for k, st in new_states.items():
@@ -347,6 +440,24 @@ class TrainStep:
             if p is not None:
                 self.optimizer._accumulators[id(p)] = st
         return Tensor(loss)
+
+    def _convert_model_and_loss(self):
+        """dy2static both the model tree and the loss function (a branch
+        in a custom loss graph-breaks the whole fused step otherwise)."""
+        from ..nn.layer.layers import Layer
+        from .dy2static import convert_function, convert_layer_tree
+
+        converted = _try_convert_target(self.model)
+        lf = self.loss_fn
+        if lf is not None:
+            if isinstance(lf, Layer):
+                converted = convert_layer_tree(lf) or converted
+            elif callable(lf):
+                new = convert_function(lf)
+                if new is not None:
+                    self.loss_fn = new
+                    converted = True
+        return converted
 
     def _eager_step(self, *batch):
         """Graph-break path: plain eager forward/backward/update — the

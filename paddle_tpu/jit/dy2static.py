@@ -1,0 +1,705 @@
+"""Data-dependent control-flow capture for @to_static.
+
+Reference analog: the dy2static AST transpiler
+(/root/reference/python/paddle/jit/dy2static/program_translator.py and its
+transformers/) and the SOT bytecode tracer (jit/sot/opcode_translator/
+eval_frame_callback.py) — 33.6 kLoC that rewrite Python `if`/`while`/`for`
+over tensor values into graph ops. The TPU-native design is much smaller
+because XLA already has structured control flow: this module rewrites the
+offending constructs into calls to runtime helpers that
+
+  * keep EXACT plain-Python semantics when the condition is concrete
+    (eager mode, or non-tensor conditions under trace), and
+  * lower to `lax.cond` / `lax.while_loop` when the condition is traced,
+
+so one converted function serves both eager and compiled execution, and
+`to_static` compiles a model with tensor-dependent branches/loops into ONE
+XLA executable instead of graph-breaking to eager.
+
+Conversion is attempted lazily: the plain trace runs first (zero overhead
+for trace-friendly code); on a trace-break error `StaticFunction` converts
+the target (and, for Layers, every sublayer forward) and retries. Code the
+transformer cannot prove convertible (early returns inside a branch,
+break/continue, non-range iteration, names not bound before the branch)
+is left untouched — the existing graph-break fallback still applies.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_function", "convert_layer_tree",
+           "DynamicControlFlowError", "HELPERS"]
+
+
+class DynamicControlFlowError(Exception):
+    """A construct reached the traced path but cannot lower to XLA control
+    flow (mismatched branch structures, non-array state, ...). Treated by
+    StaticFunction as a graph-break condition."""
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers (injected into converted functions' globals)
+# ---------------------------------------------------------------------------
+
+def _unwrap(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_unwrap(v), jax.core.Tracer)
+
+
+def _unwrap_state(state):
+    flat = []
+    was_tensor = []
+    for v in state:
+        was_tensor.append(isinstance(v, Tensor))
+        flat.append(_unwrap(v))
+    return flat, was_tensor
+
+
+def _rewrap_state(flat, was_tensor):
+    return tuple(Tensor(v) if t and not isinstance(v, Tensor) else v
+                 for v, t in zip(flat, was_tensor))
+
+
+def _scalar_bool(cv):
+    cv = jnp.asarray(cv)
+    if cv.ndim:
+        cv = cv.reshape(())
+    return cv.astype(bool)
+
+
+def __pt_if__(cond, true_fn, false_fn, state):
+    cv = _unwrap(cond)
+    if not isinstance(cv, jax.core.Tracer):
+        return true_fn(*state) if bool(cv) else false_fn(*state)
+    flat, was_tensor = _unwrap_state(state)
+
+    def mk(branch):
+        def g(*fs):
+            out = branch(*_rewrap_state(fs, was_tensor))
+            return tuple(_unwrap(o) for o in out)
+
+        return g
+
+    try:
+        out = jax.lax.cond(_scalar_bool(cv), mk(true_fn), mk(false_fn),
+                           *flat)
+    except (TypeError, ValueError) as e:
+        raise DynamicControlFlowError(
+            f"if-branch cannot lower to lax.cond: {e}") from e
+    return _rewrap_state(out, was_tensor)
+
+
+def __pt_while__(test_fn, body_fn, state):
+    cv = _unwrap(test_fn(*state))
+    if not isinstance(cv, jax.core.Tracer) \
+            and not any(_is_traced(v) for v in state):
+        while bool(cv):
+            state = body_fn(*state)
+            cv = _unwrap(test_fn(*state))
+        return tuple(state)
+    flat, was_tensor = _unwrap_state(state)
+
+    def cond_fun(fs):
+        return _scalar_bool(_unwrap(test_fn(*_rewrap_state(fs, was_tensor))))
+
+    def body_fun(fs):
+        out = body_fn(*_rewrap_state(fs, was_tensor))
+        return tuple(_unwrap(o) for o in out)
+
+    try:
+        # loop-carried avals must be stable: pre-broadcast weak scalars by
+        # one body application is NOT done — jax reports mismatches, which
+        # we surface as a graph-break condition
+        out = jax.lax.while_loop(cond_fun, body_fun, tuple(flat))
+    except (TypeError, ValueError) as e:
+        raise DynamicControlFlowError(
+            f"while-loop cannot lower to lax.while_loop: {e}") from e
+    return _rewrap_state(out, was_tensor)
+
+
+def __pt_for_range__(rargs, body_fn, state, prior=None, has_prior=False):
+    """prior/has_prior: the loop variable's binding before the loop (when
+    definitely bound) so a zero-trip range preserves it like Python."""
+    rargs = tuple(_unwrap(a) for a in rargs)
+    if len(rargs) == 1:
+        start, stop, step = 0, rargs[0], 1
+    elif len(rargs) == 2:
+        start, stop, step = rargs[0], rargs[1], 1
+    else:
+        start, stop, step = rargs
+    if not any(isinstance(a, jax.core.Tracer)
+               for a in (start, stop, step)):
+        i = prior if has_prior else None
+        for i in range(int(start), int(stop), int(step)):
+            state = body_fn(i, *state)
+        return (i,) + tuple(state)
+    flat, was_tensor = _unwrap_state(state)
+    start = jnp.asarray(start, jnp.int32)
+    stop = jnp.asarray(stop, jnp.int32)
+    step = jnp.asarray(step, jnp.int32)
+
+    def cond_fun(carry):
+        i, _ = carry
+        return jnp.where(step > 0, i < stop, i > stop)
+
+    def body_fun(carry):
+        i, fs = carry
+        out = body_fn(i, *_rewrap_state(fs, was_tensor))
+        return i + step, tuple(_unwrap(o) for o in out)
+
+    try:
+        i_final, out = jax.lax.while_loop(cond_fun, body_fun,
+                                          (start, tuple(flat)))
+    except (TypeError, ValueError) as e:
+        raise DynamicControlFlowError(
+            f"for-range cannot lower to lax.while_loop: {e}") from e
+    # python leaves the target at the last executed index; a zero-trip
+    # loop keeps its prior binding when one exists
+    i_out = i_final - step
+    if has_prior and prior is not None:
+        i_out = jnp.where(i_final != start, i_out,
+                          jnp.asarray(_unwrap(prior), jnp.int32))
+    return (Tensor(i_out),) + _rewrap_state(out, was_tensor)
+
+
+def __pt_and__(left, right_thunk):
+    if not _is_traced(left):
+        return left and right_thunk()
+    right = right_thunk()
+    return Tensor(jnp.logical_and(_scalar_bool(_unwrap(left)),
+                                  _scalar_bool(_unwrap(right))))
+
+
+def __pt_or__(left, right_thunk):
+    if not _is_traced(left):
+        return left or right_thunk()
+    right = right_thunk()
+    return Tensor(jnp.logical_or(_scalar_bool(_unwrap(left)),
+                                 _scalar_bool(_unwrap(right))))
+
+
+def __pt_not__(v):
+    if not _is_traced(v):
+        return not v
+    return Tensor(jnp.logical_not(_scalar_bool(_unwrap(v))))
+
+
+HELPERS = {
+    "__pt_if__": __pt_if__,
+    "__pt_while__": __pt_while__,
+    "__pt_for_range__": __pt_for_range__,
+    "__pt_and__": __pt_and__,
+    "__pt_or__": __pt_or__,
+    "__pt_not__": __pt_not__,
+}
+
+
+# ---------------------------------------------------------------------------
+# the AST transformer
+# ---------------------------------------------------------------------------
+
+class _Unsupported(Exception):
+    pass
+
+
+def _assigned_names(stmts):
+    """Names (re)bound by a statement list, NOT descending into nested
+    function/class definitions (their scopes are separate)."""
+    names = []
+
+    def targets_of(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+        # Attribute/Subscript targets mutate objects, not local bindings
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            names.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.append(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                targets_of(t)
+            self.generic_visit(node.value)
+
+        def visit_AugAssign(self, node):
+            targets_of(node.target)
+            self.generic_visit(node.value)
+
+        def visit_AnnAssign(self, node):
+            targets_of(node.target)
+            if node.value:
+                self.generic_visit(node.value)
+
+        def visit_For(self, node):
+            targets_of(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    targets_of(item.optional_vars)
+            self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):
+            targets_of(node.target)
+            self.generic_visit(node.value)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _contains_escape(stmts):
+    """True if the statement list cannot be lifted into a nested function:
+    return/global/nonlocal/del/yield anywhere (outside nested defs), or
+    break/continue not enclosed in a loop WITHIN the list (they'd target
+    an outer loop and become SyntaxErrors after lifting)."""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self, in_loop):
+            self.in_loop = in_loop
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_Return(self, node):
+            found.append(node)
+
+        def visit_Global(self, node):
+            found.append(node)
+
+        def visit_Nonlocal(self, node):
+            found.append(node)
+
+        def visit_Delete(self, node):
+            found.append(node)
+
+        def visit_Yield(self, node):
+            found.append(node)
+
+        def visit_YieldFrom(self, node):
+            found.append(node)
+
+        def visit_Break(self, node):
+            if not self.in_loop:
+                found.append(node)
+
+        def visit_Continue(self, node):
+            if not self.in_loop:
+                found.append(node)
+
+        def visit_For(self, node):
+            inner = V(True)
+            for s in node.body + node.orelse:
+                inner.visit(s)
+
+        def visit_While(self, node):
+            inner = V(True)
+            for s in node.body + node.orelse:
+                inner.visit(s)
+
+    v = V(False)
+    for s in stmts:
+        v.visit(s)
+    return bool(found)
+
+
+def _def_names(stmts):
+    """Names bound by function/class definitions at this level."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            names.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            names.append(node.name)
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _split_state(body_stmts, extra_stmts=()):
+    """(state_names, ok): assignable loop/branch state, excluding our own
+    generated helper defs; ok=False when USER def/class bindings exist
+    (they cannot be carried through lax control flow)."""
+    names = set(_assigned_names(list(body_stmts))
+                + _assigned_names(list(extra_stmts)))
+    defs = set(_def_names(list(body_stmts)) + _def_names(list(extra_stmts)))
+    gen = {n for n in defs if n.startswith("__pt_") and n.endswith("__")}
+    if defs - gen:
+        return [], False
+    return sorted(names - gen), True
+
+
+class _TestExprTransformer(ast.NodeTransformer):
+    """Inside a condition expression: `a and b` -> __pt_and__(a, lambda: b)
+    etc., so tensor conditions never hit Python's __bool__."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "__pt_and__" if isinstance(node.op, ast.And) else "__pt_or__"
+        expr = node.values[0]
+        for nxt in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Name(id=op, ctx=ast.Load()),
+                args=[expr, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[],
+                                       vararg=None, kwarg=None,
+                                       kwonlyargs=[], kw_defaults=[],
+                                       defaults=[]),
+                    body=nxt)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="__pt_not__", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For-over-range into helper calls. Maintains the
+    set of names bound earlier in the function so branch state is always
+    referencable (the dy2static 'create_undefined_var' machinery is
+    replaced by simply not transforming such code)."""
+
+    def __init__(self, bound_names):
+        self.bound = set(bound_names)
+        self.counter = 0
+        self.changed = False
+
+    _DEFINITE = (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                 ast.FunctionDef, ast.ClassDef, ast.Import,
+                 ast.ImportFrom, ast.With, ast.Expr)
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__pt_{kind}_{self.counter}__"
+
+    def _visit_block(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            if isinstance(r, list):
+                out.extend(r)
+            elif r is not None:
+                out.append(r)
+            # only unconditionally-executed statements make a name
+            # DEFINITELY bound; names from control-flow statements may be
+            # unbound at runtime and would turn the generated state tuple
+            # into an UnboundLocalError the original code didn't have
+            if isinstance(s, self._DEFINITE):
+                self.bound.update(_assigned_names([s]))
+        return out
+
+    def visit_FunctionDef(self, node):
+        # nested defs keep their own scope; record the name, don't descend
+        self.bound.add(node.name)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _state_tuple(self, names, ctx):
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+            ctx=ctx())
+
+    def _branch_fn(self, fname, state, body):
+        """def fname(s0, s1, ...): <body>; return (s0, s1, ...)"""
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in state],
+                vararg=None, kwarg=None,
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=body + [ast.Return(value=self._state_tuple(
+                state, ast.Load))],
+            decorator_list=[])
+
+    def visit_If(self, node):
+        pre = set(self.bound)
+        body = self._visit_block(node.body)
+        self.bound = set(pre)
+        orelse = self._visit_block(node.orelse)
+        self.bound = pre
+        node = ast.If(test=node.test, body=body, orelse=orelse)
+        if _contains_escape(node.body) or _contains_escape(node.orelse):
+            return node
+        state, ok = _split_state(node.body, node.orelse)
+        if not ok or any(n not in self.bound for n in state):
+            return node          # a maybe-unbound name: leave as Python
+        self.changed = True
+        test = _TestExprTransformer().visit(node.test)
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tdef = self._branch_fn(tname, state, node.body or [ast.Pass()])
+        fdef = self._branch_fn(fname, state,
+                               node.orelse or [ast.Pass()])
+        call = ast.Assign(
+            targets=[self._state_tuple(state, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__pt_if__", ctx=ast.Load()),
+                args=[test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      self._state_tuple(state, ast.Load)],
+                keywords=[]))
+        if not state:
+            call = ast.Expr(value=call.value)
+        return [tdef, fdef, call]
+
+    def visit_While(self, node):
+        pre = set(self.bound)
+        body = self._visit_block(node.body)
+        self.bound = pre
+        node = ast.While(test=node.test, body=body, orelse=node.orelse)
+        if node.orelse or _contains_escape(node.body):
+            return node
+        state, ok = _split_state(node.body)
+        if not ok or not state or any(n not in self.bound for n in state):
+            return node
+        self.changed = True
+        test = _TestExprTransformer().visit(node.test)
+        tname, bname = self._fresh("wtest"), self._fresh("wbody")
+        tdef = ast.FunctionDef(
+            name=tname,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(arg=n) for n in state],
+                vararg=None, kwarg=None,
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[ast.Return(value=test)],
+            decorator_list=[])
+        bdef = self._branch_fn(bname, state, node.body)
+        call = ast.Assign(
+            targets=[self._state_tuple(state, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__pt_while__", ctx=ast.Load()),
+                args=[ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      self._state_tuple(state, ast.Load)],
+                keywords=[]))
+        return [tdef, bdef, call]
+
+    def visit_For(self, node):
+        pre = set(self.bound)
+        if isinstance(node.target, ast.Name):
+            self.bound.add(node.target.id)   # bound inside the body
+        body = self._visit_block(node.body)
+        self.bound = pre
+        node = ast.For(target=node.target, iter=node.iter, body=body,
+                       orelse=node.orelse)
+        if node.orelse or _contains_escape(node.body):
+            return node
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3
+                and not any(isinstance(a, ast.Starred)
+                            for a in node.iter.args)):
+            return node
+        if not isinstance(node.target, ast.Name):
+            return node
+        ivar = node.target.id
+        state, ok = _split_state(node.body)
+        state = [n for n in state if n != ivar]
+        if not ok or any(n not in self.bound for n in state):
+            return node
+        self.changed = True
+        bname = self._fresh("fbody")
+        bdef = ast.FunctionDef(
+            name=bname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=ivar)] + [ast.arg(arg=n)
+                                            for n in state],
+                vararg=None, kwarg=None,
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=node.body + [ast.Return(value=self._state_tuple(
+                state, ast.Load))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=ivar, ctx=ast.Store())]
+                + [ast.Name(id=n, ctx=ast.Store()) for n in state],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pt_for_range__", ctx=ast.Load()),
+                args=[ast.Tuple(elts=list(node.iter.args),
+                                ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      self._state_tuple(state, ast.Load)],
+                keywords=[
+                    ast.keyword(arg="prior",
+                                value=(ast.Name(id=ivar, ctx=ast.Load())
+                                       if ivar in self.bound
+                                       else ast.Constant(value=None))),
+                    ast.keyword(arg="has_prior",
+                                value=ast.Constant(
+                                    value=ivar in self.bound)),
+                ]))
+        return [bdef, call]
+
+
+# ---------------------------------------------------------------------------
+# function conversion
+# ---------------------------------------------------------------------------
+
+_convert_cache: dict = {}      # code object -> converted code info or None
+
+
+def _param_names(fn):
+    code = fn.__code__
+    n = code.co_argcount + code.co_kwonlyargcount
+    names = list(code.co_varnames[:n])
+    if code.co_flags & inspect.CO_VARARGS:
+        names.append(code.co_varnames[n])
+        n += 1
+    if code.co_flags & inspect.CO_VARKEYWORDS:
+        names.append(code.co_varnames[n])
+    return names
+
+
+def convert_function(fn) -> Optional[types.FunctionType]:
+    """Return a converted version of `fn` (a plain function), or None when
+    nothing needed conversion / the source is unavailable. The converted
+    function has identical behavior for concrete conditions and lowers
+    tensor-dependent control flow when traced."""
+    if isinstance(fn, types.MethodType):
+        inner = convert_function(fn.__func__)
+        return None if inner is None else types.MethodType(
+            inner, fn.__self__)
+    if not isinstance(fn, types.FunctionType):
+        return None
+    code = fn.__code__
+    if code in _convert_cache:
+        cached = _convert_cache[code]
+        return None if cached is None else _bind(cached, fn)
+    result = None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or isinstance(fdef, ast.AsyncFunctionDef):
+            raise _Unsupported
+        fdef.decorator_list = []
+        bound = set(_param_names(fn))
+        tr = ControlFlowTransformer(bound)
+        fdef.body = tr._visit_block(fdef.body)
+        if tr.changed:
+            freevars = code.co_freevars
+            factory = ast.FunctionDef(
+                name="__pt_factory__",
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in freevars],
+                    vararg=None, kwarg=None,
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=[fdef, ast.Return(
+                    value=ast.Name(id=fdef.name, ctx=ast.Load()))],
+                decorator_list=[])
+            mod = ast.Module(body=[factory], type_ignores=[])
+            ast.fix_missing_locations(mod)
+            compiled = compile(mod, f"<dy2static {code.co_filename}:"
+                                    f"{code.co_firstlineno}>", "exec")
+            result = {"compiled": compiled, "freevars": freevars,
+                      "name": fdef.name}
+    except (_Unsupported, OSError, TypeError, SyntaxError, ValueError):
+        result = None
+    _convert_cache[code] = result
+    if result is None:
+        return None
+    try:
+        return _bind(result, fn)
+    except ValueError:        # e.g. an empty closure cell
+        return None
+
+
+def _bind(info, fn):
+    # execute against the function's LIVE module globals (a snapshot dict
+    # would freeze later rebinding of module-level names); the helper
+    # names are unique dunders, so injecting them is collision-safe
+    g = fn.__globals__
+    for k, v in HELPERS.items():
+        g.setdefault(k, v)
+    ns = {}
+    exec(info["compiled"], g, ns)
+    cells = [c.cell_contents for c in (fn.__closure__ or ())]
+    new_fn = ns["__pt_factory__"](*cells)
+    functools.wraps(fn)(new_fn)
+    new_fn.__pt_converted__ = True
+    return new_fn
+
+
+def convert_layer_tree(layer) -> bool:
+    """Convert the forward of `layer` and every sublayer (instance-level
+    rebind; the underlying function is converted once per code object).
+    Returns True if anything was converted."""
+    converted_any = False
+    seen = set()
+    stack = [layer]
+    while stack:
+        l = stack.pop()
+        if id(l) in seen:
+            continue
+        seen.add(id(l))
+        fwd = getattr(l, "forward", None)
+        if isinstance(fwd, types.MethodType) \
+                and not getattr(fwd.__func__, "__pt_converted__", False):
+            new = convert_function(fwd.__func__)
+            if new is not None:
+                l.forward = types.MethodType(new, l)
+                converted_any = True
+        for child in getattr(l, "_sub_layers", {}).values():
+            stack.append(child)
+    return converted_any
