@@ -53,6 +53,9 @@ def parse_args(argv=None):
     parser.add_argument("--log_dir", default="log")
     parser.add_argument("--max_restart", type=int, default=3)
     parser.add_argument("--elastic_timeout", type=float, default=30.0)
+    parser.add_argument("--elastic_ttl", type=float, default=10.0,
+                        help="heartbeat staleness after which a peer node "
+                             "is considered gone (elastic mode)")
     parser.add_argument("--host", default=None)
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -79,6 +82,9 @@ class Controller:
         self.elastic = bool(hi)
         self.store = None
         self.is_master = False
+        self.generation = 0
+        self._missing_since = {}      # (gen, rank) -> first-seen-missing
+        self._worker_failures = 0     # non-elastic exit codes, cumulative
 
     # -- rendezvous --------------------------------------------------------
     def _connect_store(self):
@@ -98,23 +104,71 @@ class Controller:
                 self.store = TCPStore(host, int(port), is_master=True)
                 self.is_master = True
 
+    def _ns(self):
+        return f"{self.args.job_id}/g{self.generation}"
+
     def build_pod(self) -> Pod:
-        self._connect_store()
-        n = self.min_nodes
-        if n <= 1 and self.args.master is None:
+        if self.store is None:
+            self._connect_store()
+        if self.max_nodes <= 1 and self.args.master is None:
             return Pod(0, [f"{self.host}:{_free_port()}"],
                        self.args.nproc_per_node)
-        # register this node, allgather endpoints through the store
-        my_port = _free_port()
-        endpoint = f"{self.host}:{my_port}"
+        if self.elastic:
+            self.generation = self.store.add(
+                f"{self.args.job_id}/gen_bump", 0)
+        # register this node, allgather endpoints through the store;
+        # keys are generation-namespaced so elastic re-formation gets a
+        # fresh rendezvous with remapped ranks (reference
+        # fleet/elastic/manager.py:124-277 rank re-map on rescale)
+        endpoint = f"{self.host}:{_free_port()}"
         rank = self.args.rank
-        if rank < 0:
-            rank = self.store.add(f"{self.args.job_id}/nodes", 1) - 1
-        self.store.set(f"{self.args.job_id}/ep/{rank}", endpoint)
+        if rank < 0 or self.elastic:
+            rank = self.store.add(f"{self._ns()}/nodes", 1) - 1
+        self.store.set(f"{self._ns()}/ep/{rank}", endpoint)
+        if self.elastic:
+            # wait for membership to settle within [min, max]
+            deadline = time.time() + self.args.elastic_timeout
+            last_n, stable_since = 0, time.time()
+            while True:
+                bump = self.store.add(f"{self.args.job_id}/gen_bump", 0)
+                if bump > self.generation:
+                    # someone re-triggered mid-rendezvous: move up
+                    self.generation = bump
+                    rank = self.store.add(f"{self._ns()}/nodes", 1) - 1
+                    self.store.set(f"{self._ns()}/ep/{rank}", endpoint)
+                    last_n, stable_since = 0, time.time()
+                n = self.store.add(f"{self._ns()}/nodes", 0)
+                if n != last_n:
+                    last_n, stable_since = n, time.time()
+                if n >= self.min_nodes                         and time.time() - stable_since >= 1.0:
+                    break
+                if time.time() > deadline:
+                    if n >= self.min_nodes:
+                        break
+                    raise RuntimeError(
+                        f"elastic rendezvous timeout: {n} nodes < "
+                        f"min {self.min_nodes}")
+                time.sleep(0.2)
+            world_n = min(last_n, self.max_nodes)
+            if rank >= world_n:
+                # pod is full: stand by as a spare until it re-forms
+                # (a member death bumps the generation; we then rejoin)
+                print(f"[launch] node rank {rank} standing by (pod full "
+                      f"at {world_n})", file=sys.stderr)
+                cur = self.store.add(f"{self.args.job_id}/gen_bump", 0)
+                while self.store.add(f"{self.args.job_id}/gen_bump",
+                                     0) == cur:
+                    time.sleep(1.0)
+                self.generation = self.store.add(
+                    f"{self.args.job_id}/gen_bump", 0)
+                return self.build_pod()
+        else:
+            world_n = self.min_nodes
         world = []
-        for r in range(n):
+        for r in range(world_n):
             world.append(self.store.get(
-                f"{self.args.job_id}/ep/{r}").decode())
+                f"{self._ns()}/ep/{r}").decode())
+        self._heartbeat_now(rank)
         return Pod(rank, world, self.args.nproc_per_node)
 
     # -- spawn -------------------------------------------------------------
@@ -130,6 +184,7 @@ class Controller:
             "PADDLE_JOB_ID": self.args.job_id,
             "PADDLE_MASTER": self.args.master
             or f"127.0.0.1:{self.store.port}",
+            "PADDLE_ELASTIC_GENERATION": str(self.generation),
             "FLAGS_selected_tpus": "all",
         })
         return env
@@ -148,52 +203,139 @@ class Controller:
             pod.procs.append(p)
 
     # -- watch loop --------------------------------------------------------
-    def watch(self, pod: Pod) -> int:
+    # reference manager.py:32 — single source of truth in elastic.py
+    from ..elastic import ELASTIC_EXIT_CODE
+
+    def watch(self, pod: Pod):
+        """Returns ("done", 0) | ("exit", code) | ("reform", generation).
+
+        Elastic (reference fleet/elastic/manager.py:124-277): a worker
+        exiting with ELASTIC_EXIT_CODE, a stale peer heartbeat, or a
+        generation bump by another controller all trigger pod
+        re-formation (fresh rendezvous, remapped ranks)."""
         restarts = 0
         while True:
             if self.elastic:
                 self._heartbeat(pod)
+                bump = self.store.add(f"{self.args.job_id}/gen_bump", 0)
+                if bump > self.generation:
+                    self._kill(pod)
+                    return ("reform", bump)
+                stale = self._stale_peer(pod)
+                if stale is not None:
+                    print(f"[launch] elastic: node {stale} heartbeat "
+                          f"stale; re-forming pod", file=sys.stderr)
+                    self._kill(pod)
+                    return ("reform",
+                            self.store.add(f"{self.args.job_id}/gen_bump",
+                                           1))
+                # scale-out: a node joined this generation after we
+                # settled — re-form so it gets a rank
+                n_now = self.store.add(f"{self._ns()}/nodes", 0)
+                if n_now > len(pod.world) \
+                        and len(pod.world) < self.max_nodes:
+                    print(f"[launch] elastic: {n_now} nodes registered "
+                          f"(pod has {len(pod.world)}); re-forming",
+                          file=sys.stderr)
+                    self._kill(pod)
+                    return ("reform",
+                            self.store.add(f"{self.args.job_id}/gen_bump",
+                                           1))
             statuses = [p.poll() for p in pod.procs]
             if all(s == 0 for s in statuses if s is not None) and \
                     all(s is not None for s in statuses):
-                return 0
+                return ("done", 0)
             failed = [s for s in statuses if s not in (None, 0)]
             if failed:
-                for p in pod.procs:
-                    if p.poll() is None:
-                        p.terminate()
-                for p in pod.procs:
-                    try:
-                        p.wait(timeout=10)
-                    except subprocess.TimeoutExpired:
-                        p.kill()
+                self._kill(pod)
+                if self.elastic:
+                    if self.ELASTIC_EXIT_CODE not in failed:
+                        # real failures accumulate ACROSS re-formations
+                        # (watch()-local counters would reset each time
+                        # and the budget could never trip)
+                        self._worker_failures += 1
+                        if self._worker_failures > self.args.max_restart:
+                            return ("exit", failed[0])
+                    print(f"[launch] worker exit {failed[0]}; elastic "
+                          f"re-formation", file=sys.stderr)
+                    return ("reform",
+                            self.store.add(f"{self.args.job_id}/gen_bump",
+                                           1))
                 if restarts >= self.args.max_restart:
                     print(f"[launch] worker failed (exit {failed[0]}); "
                           f"restart budget exhausted", file=sys.stderr)
-                    return failed[0]
+                    return ("exit", failed[0])
                 restarts += 1
                 print(f"[launch] worker failed (exit {failed[0]}); "
                       f"restart {restarts}/{self.args.max_restart}",
                       file=sys.stderr)
                 pod.procs = []
                 self.spawn(pod)
-            time.sleep(1.0)
+            time.sleep(0.5)
+
+    def _kill(self, pod: Pod):
+        for p in pod.procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in pod.procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        pod.procs = []
 
     def _heartbeat(self, pod: Pod):
+        self._heartbeat_now(pod.rank)
+
+    def _heartbeat_now(self, rank: int):
         if self.store is not None:
-            self.store.set(
-                f"{self.args.job_id}/hb/{pod.rank}",
-                str(time.time()))
+            self.store.set(f"{self._ns()}/hb/{rank}", str(time.time()))
+
+    def _stale_peer(self, pod: Pod):
+        now = time.time()
+        for r in range(len(pod.world)):
+            if r == pod.rank:
+                continue
+            try:
+                ts = float(self.store.get_nowait(
+                    f"{self._ns()}/hb/{r}"))
+                self._missing_since.pop((self.generation, r), None)
+            except Exception:
+                # never-written heartbeat: TTL clock starts at first
+                # sighting (a node dead between register and first
+                # heartbeat must not stall the pod forever)
+                first = self._missing_since.setdefault(
+                    (self.generation, r), now)
+                if now - first > self.args.elastic_ttl:
+                    return r
+                continue
+            if now - ts > self.args.elastic_ttl:
+                return r
+        return None
 
     def run(self) -> int:
-        pod = self.build_pod()
-        self.spawn(pod)
+        pod = None
+        reforms = 0
         try:
-            return self.watch(pod)
+            while True:
+                pod = self.build_pod()
+                self.spawn(pod)
+                result, arg = self.watch(pod)
+                if result == "done":
+                    return 0
+                if result == "exit":
+                    return arg
+                # re-form at the (possibly newer) generation
+                self.generation = max(
+                    arg, self.store.add(f"{self.args.job_id}/gen_bump", 0))
+                reforms += 1
+                if reforms > max(self.args.max_restart, 3) * 3:
+                    print("[launch] elastic re-formation budget "
+                          "exhausted", file=sys.stderr)
+                    return 1
         finally:
-            for p in pod.procs:
-                if p.poll() is None:
-                    p.terminate()
+            if pod is not None:
+                self._kill(pod)
             if self.store is not None:
                 self.store.close()
 

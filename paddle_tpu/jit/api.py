@@ -182,10 +182,26 @@ class StaticFunction:
                         return self._run_compiled(seed, in_arrays, kwargs)
                     except _trace_break_errors() as e2:
                         e = e2
+                    except Exception:
+                        # converted code misbehaved beyond a trace break:
+                        # undo the instance rebinds before surfacing
+                        self._restore_converted()
+                        raise
             _warn_graph_break(getattr(self._target, "__name__",
                                       type(self._target).__name__), e)
             self._fallback = True
             return self._eager_call(*args, **kwargs)
+
+    def _restore_converted(self):
+        from ..nn.layer.layers import Layer
+        from .dy2static import restore_layer_tree
+
+        targets = [self._target] if self._is_layer else \
+            [v for v in _reachable_values(self._target)
+             if isinstance(v, Layer)]
+        for t in targets:
+            restore_layer_tree(t)
+        self._compiled = None
 
     def _convert_target(self):
         from ..nn.layer.layers import Layer
@@ -428,6 +444,14 @@ class TrainStep:
                         retried = True
                     except _trace_break_errors() as e2:
                         e = e2
+                    except Exception:
+                        from .dy2static import restore_layer_tree
+
+                        restore_layer_tree(self.model)
+                        if hasattr(self.loss_fn, "_sub_layers"):
+                            restore_layer_tree(self.loss_fn)
+                        self._compiled = None
+                        raise
             if not retried:
                 _warn_graph_break(type(self.model).__name__, e)
                 self._fallback = True

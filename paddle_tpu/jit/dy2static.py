@@ -337,6 +337,42 @@ def _contains_escape(stmts):
     return bool(found)
 
 
+def _definite_names(stmts):
+    """Names UNCONDITIONALLY bound by executing the statement list:
+    assignment targets (never walrus inside values — `c and (y := f())`
+    is conditional), def/class/import names, with-as names, and the
+    definite names of with-bodies (which execute unconditionally).
+    Control-flow statements contribute nothing."""
+    out = set()
+
+    def targets_of(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets_of(e)
+        elif isinstance(t, ast.Starred):
+            targets_of(t.value)
+
+    for s in stmts:
+        if isinstance(s, ast.Assign):
+            for t in s.targets:
+                targets_of(t)
+        elif isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+            targets_of(s.target)
+        elif isinstance(s, (ast.FunctionDef, ast.ClassDef)):
+            out.add(s.name)
+        elif isinstance(s, (ast.Import, ast.ImportFrom)):
+            for a in s.names:
+                out.add((a.asname or a.name).split(".")[0])
+        elif isinstance(s, ast.With):
+            for item in s.items:
+                if item.optional_vars is not None:
+                    targets_of(item.optional_vars)
+            out.update(_definite_names(s.body))
+    return out
+
+
 def _def_names(stmts):
     """Names bound by function/class definitions at this level."""
     names = []
@@ -414,10 +450,6 @@ class ControlFlowTransformer(ast.NodeTransformer):
         self.counter = 0
         self.changed = False
 
-    _DEFINITE = (ast.Assign, ast.AugAssign, ast.AnnAssign,
-                 ast.FunctionDef, ast.ClassDef, ast.Import,
-                 ast.ImportFrom, ast.With, ast.Expr)
-
     def _fresh(self, kind):
         self.counter += 1
         return f"__pt_{kind}_{self.counter}__"
@@ -434,8 +466,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
             # DEFINITELY bound; names from control-flow statements may be
             # unbound at runtime and would turn the generated state tuple
             # into an UnboundLocalError the original code didn't have
-            if isinstance(s, self._DEFINITE):
-                self.bound.update(_assigned_names([s]))
+            self.bound.update(_definite_names([s]))
         return out
 
     def visit_FunctionDef(self, node):
@@ -684,6 +715,8 @@ def _bind(info, fn):
 def convert_layer_tree(layer) -> bool:
     """Convert the forward of `layer` and every sublayer (instance-level
     rebind; the underlying function is converted once per code object).
+    The original forward is kept on the instance so restore_layer_tree
+    can undo the rebind if the converted code misbehaves.
     Returns True if anything was converted."""
     converted_any = False
     seen = set()
@@ -698,8 +731,26 @@ def convert_layer_tree(layer) -> bool:
                 and not getattr(fwd.__func__, "__pt_converted__", False):
             new = convert_function(fwd.__func__)
             if new is not None:
+                l.__dict__["__pt_orig_forward__"] = fwd
                 l.forward = types.MethodType(new, l)
                 converted_any = True
         for child in getattr(l, "_sub_layers", {}).values():
             stack.append(child)
     return converted_any
+
+
+def restore_layer_tree(layer) -> None:
+    """Undo convert_layer_tree's instance rebinds (used when a converted
+    forward raises something the trace-break fallback can't absorb)."""
+    seen = set()
+    stack = [layer]
+    while stack:
+        l = stack.pop()
+        if id(l) in seen:
+            continue
+        seen.add(id(l))
+        orig = l.__dict__.pop("__pt_orig_forward__", None)
+        if orig is not None:
+            l.forward = orig
+        for child in getattr(l, "_sub_layers", {}).values():
+            stack.append(child)

@@ -127,3 +127,215 @@ def constant_folding(program):
         program.ops = kept
         program._compiled.clear()
     return program
+
+
+# ---------------------------------------------------------------------------
+# structural rewrite helpers (the DRR analog) + the distributed passes
+# (reference: paddle/fluid/pir/drr/, python/paddle/distributed/passes/
+# auto_parallel_amp.py, auto_parallel_recompute.py)
+# ---------------------------------------------------------------------------
+
+def _new_uid(program):
+    type(program)._uid_counter[0] += 1
+    return type(program)._uid_counter[0]
+
+
+def _args_treedef(n):
+    import jax
+
+    return jax.tree_util.tree_structure((tuple(range(n)), {}))
+
+
+def _compose_entries(entries, in_uids, out_uids):
+    """One callable replaying `entries` over arrays for `in_uids`,
+    returning the arrays for `out_uids` — the building block for fusion
+    and recompute region entries."""
+    import jax
+
+    def composed(*arrays):
+        env = dict(zip(in_uids, arrays))
+        for (name, fn, entry_flat, tpos, e_in, treedef, out_pos,
+             e_out) in entries:
+            flat2 = list(entry_flat)
+            for i, u in zip(tpos, e_in):
+                flat2[i] = env[u]
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            leaves = jax.tree_util.tree_leaves(out)
+            for pos, u in zip(out_pos, e_out):
+                env[u] = leaves[pos]
+        return tuple(env[u] for u in out_uids)
+
+    return composed
+
+
+def _region_io(entries, later_consumed, fetch_uids):
+    """(in_uids, out_uids) of a contiguous region: inputs are uids read
+    but not produced inside; outputs are produced uids still needed
+    afterwards (consumed later or fetched)."""
+    produced = set()
+    in_uids = []
+    for (_, _, _, _, e_in, _, _, e_out) in entries:
+        for u in e_in:
+            if u not in produced and u not in in_uids:
+                in_uids.append(u)
+        produced.update(e_out)
+    out_uids = [u for (_, _, _, _, _, _, _, e_out) in entries
+                for u in e_out
+                if u in later_consumed or u in fetch_uids]
+    # dedupe, preserve order
+    seen = set()
+    out_uids = [u for u in out_uids
+                if not (u in seen or seen.add(u))]
+    return in_uids, out_uids
+
+
+def fuse_chain(program, names, fused_name=None):
+    """DRR-style chain rewrite: wherever op `names[k]`'s single output
+    feeds exactly op `names[k+1]` (and nothing else), collapse the chain
+    into ONE fused op entry (XLA fuses the bodies; the rewrite makes the
+    fusion explicit in the op list like the reference's DRR patterns)."""
+    fused_name = fused_name or "fused_" + "_".join(names)
+    fetch_uids = {type(program)._uid(f) for f in program.fetch_targets}
+    changed = True
+    while changed:
+        changed = False
+        consumers = {}
+        for idx, entry in enumerate(program.ops):
+            for u in entry[4]:
+                consumers.setdefault(u, []).append(idx)
+        for start in range(len(program.ops)):
+            chain = [start]
+            ok = program.ops[start][0] == names[0]
+            for k in range(1, len(names)):
+                if not ok:
+                    break
+                prev = program.ops[chain[-1]]
+                outs = prev[7]
+                if len(outs) != 1 or outs[0] in fetch_uids:
+                    ok = False
+                    break
+                cons = consumers.get(outs[0], [])
+                if len(cons) != 1 or \
+                        program.ops[cons[0]][0] != names[k]:
+                    ok = False
+                    break
+                chain.append(cons[0])
+            if not ok or len(chain) != len(names):
+                continue
+            entries = [program.ops[i] for i in chain]
+            later = {u for idx2, e in enumerate(program.ops)
+                     if idx2 not in chain for u in e[4]}
+            in_uids, out_uids = _region_io(entries, later, fetch_uids)
+            fn = _compose_entries(entries, in_uids, out_uids)
+            fused = (fused_name, fn, [None] * len(in_uids),
+                     list(range(len(in_uids))), in_uids,
+                     _args_treedef(len(in_uids)),
+                     list(range(len(out_uids))), out_uids)
+            keep = [e for i, e in enumerate(program.ops)
+                    if i not in chain[:-1]]
+            keep[keep.index(entries[-1])] = fused
+            program.ops = keep
+            program._compiled.clear()
+            changed = True
+            break
+    return program
+
+
+@register_pass("auto_parallel_amp")
+def amp_insertion(program, dtype="bfloat16", custom_white=(),
+                  custom_black=()):
+    """O1 AMP cast insertion (reference auto_parallel_amp.py /
+    auto_parallel_fp16.py): explicit `cast` ops are inserted before
+    whitelist ops (matmul/conv class -> low precision) and blacklist ops
+    (softmax/norm/loss class -> fp32), visible in the op list. Casts are
+    value-cached so a tensor feeding two whitelist ops is cast once."""
+    import jax.numpy as jnp
+
+    from ..core import amp_state
+
+    low = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    white = set(amp_state.WHITE_LIST) | set(custom_white)
+    black = set(amp_state.BLACK_LIST) | set(custom_black)
+
+    def cast_entry(uid_in, uid_out, target, tag):
+        def cast_fn(a):
+            import jax.numpy as jnp_
+
+            a = jnp_.asarray(a)
+            if jnp_.issubdtype(a.dtype, jnp_.floating) \
+                    and a.dtype != target:
+                return a.astype(target)
+            return a
+
+        return (f"cast_{tag}", cast_fn, [None], [0], [uid_in],
+                _args_treedef(1), [0], [uid_out])
+
+    new_ops = []
+    cast_cache = {}
+    for entry in program.ops:
+        (name, fn, entry_flat, tpos, in_uids, treedef, out_pos,
+         out_uids) = entry
+        if name in white:
+            target, tag = low, str(jnp.dtype(low))
+        elif name in black:
+            target, tag = jnp.float32, "fp32"
+        else:
+            new_ops.append(entry)
+            continue
+        new_in = []
+        for u in in_uids:
+            cu = cast_cache.get((u, tag))
+            if cu is None:
+                cu = _new_uid(program)
+                new_ops.append(cast_entry(u, cu, target, tag))
+                cast_cache[(u, tag)] = cu
+            new_in.append(cu)
+        new_ops.append((name, fn, entry_flat, tpos, new_in, treedef,
+                        out_pos, out_uids))
+    program.ops = new_ops
+    program._compiled.clear()
+    return program
+
+
+@register_pass("auto_parallel_recompute")
+def recompute_pass(program, num_segments=2):
+    """Recompute rewrite (reference auto_parallel_recompute.py): the op
+    list is split into contiguous segments, each collapsed into one
+    `recompute::segN` entry whose body runs under jax.checkpoint — the
+    segment's internals are rematerialized in backward instead of saved.
+    Forward numerics are identical; the transform is visible in the op
+    list."""
+    import jax
+
+    n = len(program.ops)
+    if n == 0 or num_segments < 1:
+        return program
+    fetch_uids = {type(program)._uid(f) for f in program.fetch_targets}
+    bounds = [round(i * n / num_segments) for i in range(num_segments + 1)]
+    segments = [program.ops[bounds[i]:bounds[i + 1]]
+                for i in range(num_segments)]
+    segments = [s for s in segments if s]
+    new_ops = []
+    for si, seg in enumerate(segments):
+        later = {u for s2 in segments[si + 1:] for e in s2 for u in e[4]}
+        in_uids, out_uids = _region_io(seg, later, fetch_uids)
+        if not out_uids:
+            # nothing downstream consumes this segment, but it may still
+            # have effects (py_func host callbacks); keep the original
+            # ops — dead-code removal is dead_op_elimination's job
+            new_ops.extend(seg)
+            continue
+        body = _compose_entries(seg, in_uids, out_uids)
+        wrapped = jax.checkpoint(body)
+        new_ops.append((f"recompute::seg{si}", wrapped,
+                        [None] * len(in_uids), list(range(len(in_uids))),
+                        in_uids, _args_treedef(len(in_uids)),
+                        list(range(len(out_uids))), out_uids))
+    program.ops = new_ops
+    program._compiled.clear()
+    return program
+
+
+__all__ += ["fuse_chain", "amp_insertion", "recompute_pass"]

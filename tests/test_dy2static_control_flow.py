@@ -329,3 +329,34 @@ def test_train_step_with_branchy_loss_fn():
         opt2.clear_grad()
         losses_e.append(float(loss.numpy()))
     np.testing.assert_allclose(losses_c, losses_e, atol=1e-4)
+
+
+def test_conditional_prior_binding_not_treated_as_definite():
+    """Review finding: a name bound only inside a nested conditional
+    (e.g. under a with) must NOT be treated as definitely bound — the
+    tensor-if that later assigns it stays Python and graph-breaks
+    instead of generating an UnboundLocalError."""
+
+    class M(nn.Layer):
+        def forward(self, x, flag=False):
+            with paddle.no_grad():
+                if flag:               # never taken
+                    y = x * 9.0
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+    m = M()
+    sf = to_static(lambda x: m(x))
+    xs = [np.ones((2, 2), np.float32), -np.ones((2, 2), np.float32)]
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        outs = [sf(paddle.to_tensor(x)).numpy() for x in xs]
+    # correctness is what matters: no UnboundLocalError, right values
+    np.testing.assert_allclose(outs[0], 2.0)
+    np.testing.assert_allclose(outs[1], -3.0)
+    # and plain eager on the (possibly converted) instance still works
+    np.testing.assert_allclose(
+        m(paddle.to_tensor(np.ones((2, 2), np.float32))).numpy(), 2.0)

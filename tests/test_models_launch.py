@@ -218,3 +218,130 @@ def test_launch_cli_dataparallel_grad_sync(tmp_path):
         opt.clear_grad()
     np.testing.assert_allclose(
         w0, np.asarray(ref.weight.numpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_elastic_end_to_end_kill_reform_resume(tmp_path):
+    """VERDICT r2 #6 — the full elastic loop (reference
+    fleet/elastic/manager.py:124-277): two elastic nodes train and write
+    distributed checkpoints; one node is killed; the survivor detects the
+    stale heartbeat, re-forms the pod with remapped ranks (world 2 -> 1),
+    and training RESUMES from the distributed checkpoint to completion."""
+    import signal
+    import socket
+    import time
+
+    worker = tmp_path / "elastic_worker.py"
+    worker.write_text(
+        "import os\n"
+        "os.environ.setdefault('PADDLE_JAX_DISTRIBUTED', '0')\n"
+        "import sys, time\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import paddle_tpu as paddle\n"
+        "import paddle_tpu.nn as nn\n"
+        "import paddle_tpu.distributed as dist\n"
+        "from paddle_tpu.distributed.checkpoint import (save_state_dict,\n"
+        "                                               load_state_dict)\n"
+        "out = os.environ['OUT_DIR']\n"
+        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "gen = os.environ.get('PADDLE_ELASTIC_GENERATION', '0')\n"
+        "dist.init_parallel_env()\n"
+        "paddle.seed(0)\n"
+        "model = nn.Linear(4, 2)\n"
+        "opt = paddle.optimizer.SGD(parameters=model.parameters(),\n"
+        "                           learning_rate=0.05)\n"
+        "ck = os.path.join(out, 'ckpt')\n"
+        "step0 = 0\n"
+        "if os.path.exists(os.path.join(ck, '0.metadata')):\n"
+        "    sd = dict(model.state_dict())\n"
+        "    sd['__step__'] = paddle.to_tensor(np.zeros((), np.int64))\n"
+        "    load_state_dict(sd, ck)\n"
+        "    model.set_state_dict({k: v for k, v in sd.items()\n"
+        "                          if k != '__step__'})\n"
+        "    step0 = int(np.asarray(sd['__step__'].numpy()))\n"
+        "log = open(os.path.join(out, f'prog_g{gen}_r{rank}.txt'), 'w')\n"
+        "log.write(f'start world={world} rank={rank} resume={step0}\\n')\n"
+        "log.flush()\n"
+        "rng = np.random.RandomState(1)\n"
+        "x = rng.randn(8, 4).astype('float32')\n"
+        "y = rng.randn(8, 2).astype('float32')\n"
+        "TARGET = 36\n"
+        "for step in range(step0 + 1, TARGET + 1):\n"
+        "    loss = nn.MSELoss()(model(paddle.to_tensor(x)),\n"
+        "                        paddle.to_tensor(y))\n"
+        "    loss.backward()\n"
+        "    opt.step()\n"
+        "    opt.clear_grad()\n"
+        "    sd = dict(model.state_dict())\n"
+        "    sd['__step__'] = paddle.to_tensor(np.asarray(step, np.int64))\n"
+        "    save_state_dict(sd, ck)\n"
+        "    log.write(f'step={step}\\n')\n"
+        "    log.flush()\n"
+        "    time.sleep(0.25)\n"
+        "log.write('done\\n')\n"
+        "log.flush()\n"
+    )
+
+    s = socket.socket()
+    s.bind(("", 0))
+    master_port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env["OUT_DIR"] = str(tmp_path)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+
+    def controller(tag):
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{master_port}",
+             "--nnodes", "1:2", "--elastic_ttl", "4",
+             "--job_id", "elastic_e2e",
+             "--log_dir", str(tmp_path / f"log_{tag}"), str(worker)],
+            env=env, start_new_session=True,
+            stdout=open(tmp_path / f"ctl_{tag}.out", "wb"),
+            stderr=subprocess.STDOUT)
+
+    ctl_a = controller("a")
+    time.sleep(0.5)
+    ctl_b = controller("b")
+
+    def progress_files():
+        return {p.name: p.read_text()
+                for p in tmp_path.glob("prog_g*_r*.txt")}
+
+    # wait until both ranks of some generation are training at world=2
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        files = progress_files()
+        two_world = [n for n, t in files.items()
+                     if "world=2" in t and t.count("step=") >= 2]
+        ranks = {n.rsplit("_r", 1)[1] for n in two_world}
+        if {"0.txt", "1.txt"} <= ranks:
+            break
+        if ctl_a.poll() is not None and ctl_b.poll() is not None:
+            raise AssertionError(
+                "controllers exited early: "
+                + (tmp_path / "ctl_a.out").read_text()[-800:])
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"2-node training never started: "
+                             f"{progress_files().keys()}")
+
+    # kill node B (controller + its worker process group) — the "node
+    # death" the reference elastic manager detects via lease expiry
+    os.killpg(os.getpgid(ctl_b.pid), signal.SIGKILL)
+
+    rc = ctl_a.wait(timeout=180)
+    assert rc == 0, (tmp_path / "ctl_a.out").read_text()[-1200:]
+
+    files = progress_files()
+    resumed = [t for t in files.values()
+               if "world=1 rank=0" in t and "done" in t]
+    assert resumed, f"no re-formed world=1 run completed: {files.keys()}"
+    final = resumed[-1]
+    resume_step = int(final.split("resume=")[1].split("\n")[0])
+    assert resume_step > 0, \
+        "re-formed run did not resume from the distributed checkpoint"
