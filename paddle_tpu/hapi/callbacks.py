@@ -120,8 +120,13 @@ class ProgBarLogger(Callback):
                 f"{k}: {v:.4f}" for k, v in logs.items()
                 if isinstance(v, (int, float)) and k != "batch_size")
             total = f"/{self.steps}" if self.steps else ""
+            eta = ""
+            if self.steps:
+                remaining = max(self.steps - (step + 1), 0)
+                eta_s = remaining * dt / (step + 1)
+                eta = f" - ETA: {int(eta_s // 60):d}:{int(eta_s % 60):02d}"
             print(f"Epoch {self.epoch} step {step + 1}{total}: {items}"
-                  f" - {ips:.1f} samples/s")
+                  f" - {ips:.1f} samples/s{eta}")
 
     def on_epoch_end(self, epoch, logs=None):
         if not self.verbose:

@@ -1,0 +1,378 @@
+"""Continuous-batching serving engine over the paged-KV cache.
+
+Reference analog: the Paddle Inference serving engine
+(paddle/fluid/inference/api/analysis_predictor.cc) driving the
+block-attention serving kernels
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention*): N concurrent
+requests share one decoder executable; each engine step packs a mixed
+batch of prefill and decode tokens, attends against paged KV blocks
+addressed by per-request block tables, and requests join/leave the batch
+at any step (continuous batching).
+
+TPU-native shape: the WHOLE step function — embedding, L decoder layers
+with `block_multihead_attention`, head — is one exported executable with
+static shapes (token budget, max batch, fixed page pool), saved/loaded
+through the `save_inference_model` artifact. The host side
+(`ServingEngine`) is only a scheduler: page allocator + request queue +
+argmax sampling. Padding tokens are routed to a reserved trash page so
+the static token budget never corrupts live cache pages.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..core.dispatch import apply
+
+__all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine"]
+
+
+class PagedServingConfig:
+    def __init__(self, vocab_size=256, hidden_size=64, num_layers=2,
+                 num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
+                 max_batch=4, max_blocks_per_seq=8, token_budget=64):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.ffn_size = ffn_size
+        self.block_size = block_size
+        self.num_blocks = num_blocks          # page pool (page 0 = trash)
+        self.max_batch = max_batch
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.token_budget = token_budget
+        self.max_seq = max_blocks_per_seq * block_size
+
+
+class PagedCausalLM(Layer):
+    """A small causal LM whose serving forward runs entirely on paged KV
+    caches via block_multihead_attention. `forward` is the exported step
+    function; `forward_dense` is the stateless reference path over the
+    SAME weights (used to validate engine generations)."""
+
+    def __init__(self, cfg: PagedServingConfig):
+        super().__init__()
+        from .. import nn
+
+        self.cfg = cfg
+        h, f = cfg.hidden_size, cfg.ffn_size
+        self.embed = nn.Embedding(cfg.vocab_size, h)
+        self.ln1 = nn.LayerList([nn.LayerNorm(h)
+                                 for _ in range(cfg.num_layers)])
+        self.qkv = nn.LayerList([nn.Linear(h, 3 * h)
+                                 for _ in range(cfg.num_layers)])
+        self.proj = nn.LayerList([nn.Linear(h, h)
+                                  for _ in range(cfg.num_layers)])
+        self.ln2 = nn.LayerList([nn.LayerNorm(h)
+                                 for _ in range(cfg.num_layers)])
+        self.fc1 = nn.LayerList([nn.Linear(h, f)
+                                 for _ in range(cfg.num_layers)])
+        self.fc2 = nn.LayerList([nn.Linear(f, h)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(h)
+        self.head = nn.Linear(h, cfg.vocab_size)
+
+    # -- rope table shared by both paths ---------------------------------
+    def _rope_table(self, positions):
+        """(cos, sin) [..., head_dim//2] at absolute positions."""
+        half = self.cfg.head_dim // 2
+        inv = 1.0 / (10000.0 ** (
+            jnp.arange(half, dtype=jnp.float32) * 2.0 / self.cfg.head_dim))
+        ang = positions[..., None].astype(jnp.float32) * inv
+        return jnp.cos(ang), jnp.sin(ang)
+
+    # -- exported paged step ---------------------------------------------
+    def forward(self, tokens, seq_lens_encoder, seq_lens_decoder,
+                seq_lens_this_time, cu_seqlens_q, block_tables,
+                key_caches, value_caches):
+        """One engine step.
+
+        tokens [T] int32 packed (prefill rows contribute their whole
+        prompt, decode rows one token; padding routed to the trash row);
+        seq_lens_* [B+1] (last row is the padding row); cu_seqlens_q
+        [B+2]; block_tables [B+1, max_blocks]; key/value_caches
+        [L, num_blocks, H, bs, D]. Returns (last-token logits [B+1, V],
+        new key_caches, new value_caches).
+        """
+        from ..incubate.nn import functional as IF
+
+        cfg = self.cfg
+        x = self.embed(tokens)                               # [T, H]
+
+        def rope_emb_arg():
+            B1 = cfg.max_batch + 1
+            pos = jnp.arange(cfg.max_seq)
+            cos, sin = self._rope_table(pos)                 # [S, D/2]
+            cos = jnp.broadcast_to(cos[None], (B1,) + cos.shape)
+            sin = jnp.broadcast_to(sin[None], (B1,) + sin.shape)
+            return Tensor(jnp.stack([cos, sin])
+                          .reshape(2, B1, 1, cfg.max_seq, cfg.head_dim
+                                   // 2))
+
+        rope = apply(rope_emb_arg, op_name="rope_table")
+        new_kc, new_vc = [], []
+        for li in range(cfg.num_layers):
+            h = self.ln1[li](x)
+            qkv = self.qkv[li](h)                            # [T, 3H]
+            out, _, kc, vc = IF.block_multihead_attention(
+                qkv, key_caches[li], value_caches[li],
+                seq_lens_encoder, seq_lens_decoder,
+                seq_lens_this_time, None, None, cu_seqlens_q, None,
+                block_tables, rope_emb=rope,
+                max_seq_len=cfg.max_seq, block_size=cfg.block_size)
+            new_kc.append(kc)
+            new_vc.append(vc)
+            x = x + self.proj[li](out)
+            h = self.ln2[li](x)
+            from .. import nn
+
+            x = x + self.fc2[li](nn.functional.gelu(self.fc1[li](h)))
+        x = self.ln_f(x)
+        # last token of each row: cu_q[i+1]-1 (rows with 0 tokens this
+        # step read their previous row's last token — masked host-side)
+        def pick_last(xa, cu):
+            idx = jnp.maximum(cu[1:] - 1, 0)
+            return xa[idx]
+
+        last = apply(pick_last, x, cu_seqlens_q, op_name="pick_last")
+        logits = self.head(last)                             # [B+1, V]
+        return logits, _stack(new_kc), _stack(new_vc)
+
+    # -- stateless dense reference over the same weights -----------------
+    def forward_dense(self, input_ids):
+        """input_ids [1, S] -> logits [1, S, V] with standard causal
+        attention; numerically the reference for the paged path."""
+        from .. import nn
+        from ..incubate.nn import functional as IF
+
+        cfg = self.cfg
+        ids = input_ids.reshape([-1])
+        S = ids.shape[0]
+        x = self.embed(ids)
+
+        def attn_dense(qkva):
+            T = qkva.shape[0]
+            H, D = cfg.num_heads, cfg.head_dim
+            qkv3 = qkva.reshape(T, 3, H, D)
+            q, k, v = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
+            cos, sin = self._rope_table(jnp.arange(T))       # [T, D/2]
+            cos_h = cos[:, None, :]
+            sin_h = sin[:, None, :]
+
+            def rope_t(t):
+                t1, t2 = t[..., 0::2], t[..., 1::2]
+                return jnp.stack([t1 * cos_h - t2 * sin_h,
+                                  t2 * cos_h + t1 * sin_h],
+                                 axis=-1).reshape(t.shape)
+
+            q, k = rope_t(q), rope_t(k)
+            logits = jnp.einsum("thd,shd->ths", q.astype(jnp.float32),
+                                k.astype(jnp.float32)) \
+                / jnp.sqrt(jnp.float32(D))
+            causal = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+            logits = jnp.where(causal[:, None, :], logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("ths,shd->thd", probs,
+                             v.astype(jnp.float32)).astype(qkva.dtype)
+            return out.reshape(T, H * D)
+
+        for li in range(cfg.num_layers):
+            h = self.ln1[li](x)
+            qkv = self.qkv[li](h)
+            out = apply(attn_dense, qkv, op_name="dense_ref_attn")
+            x = x + self.proj[li](out)
+            h = self.ln2[li](x)
+            x = x + self.fc2[li](nn.functional.gelu(self.fc1[li](h)))
+        x = self.ln_f(x)
+        return self.head(x).reshape([1, S, cfg.vocab_size])
+
+
+def _stack(tensors):
+    return apply(lambda *ts: jnp.stack(ts), *tensors, op_name="stack_caches")
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "generated", "max_new", "pages",
+                 "prefilled", "done")
+
+    def __init__(self, rid, prompt, max_new):
+        self.rid = rid
+        self.prompt = list(int(t) for t in prompt)
+        self.generated = []
+        self.max_new = max_new
+        self.pages = []
+        self.prefilled = False
+        self.done = False
+
+    @property
+    def length(self):
+        return len(self.prompt) + len(self.generated)
+
+
+class ServingEngine:
+    """Continuous-batching scheduler over a saved PagedCausalLM artifact.
+
+    engine = ServingEngine(path_prefix, cfg)      # loads the artifact
+    rid = engine.add_request([tokens...], max_new_tokens=8)
+    engine.step()                                  # one mixed batch step
+    engine.run_to_completion() -> {rid: [generated tokens]}
+    Requests may be added between steps (continuous batching); finished
+    requests release their cache pages.
+    """
+
+    def __init__(self, path_prefix: str, cfg: PagedServingConfig,
+                 device=None):
+        from . import load_inference_model
+
+        ex, params, buffers, sig = load_inference_model(path_prefix)
+        self._exported = ex
+        self._params = params
+        self._buffers = buffers
+        self.cfg = cfg
+        L = cfg.num_layers
+        shape = (L, cfg.num_blocks, cfg.num_heads, cfg.block_size,
+                 cfg.head_dim)
+        self._kc = jnp.zeros(shape, jnp.float32)
+        self._vc = jnp.zeros(shape, jnp.float32)
+        # page 0 is the trash page for padding tokens
+        self._free_pages = list(range(1, cfg.num_blocks))
+        self._requests = {}
+        self._active = []
+        self._next_rid = 0
+        self._compiled = jax.jit(
+            lambda p, b, *ins: self._exported.call(p, b, *ins))
+
+    # -- scheduling ------------------------------------------------------
+    def add_request(self, prompt_tokens, max_new_tokens=8):
+        if len(prompt_tokens) == 0:
+            raise ValueError("prompt must contain at least one token "
+                             "(an empty row would read another request's "
+                             "logits)")
+        if len(prompt_tokens) > self.cfg.token_budget:
+            raise ValueError(
+                f"prompt of {len(prompt_tokens)} tokens exceeds the "
+                f"engine token budget {self.cfg.token_budget}")
+        if len(prompt_tokens) + max_new_tokens > self.cfg.max_seq:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens)
+        return rid
+
+    def _ensure_pages(self, req, upto_len):
+        import math
+
+        need = math.ceil(upto_len / self.cfg.block_size)
+        while len(req.pages) < need:
+            if not self._free_pages:
+                raise RuntimeError("KV page pool exhausted")
+            req.pages.append(self._free_pages.pop())
+
+    def _release(self, req):
+        self._free_pages.extend(req.pages)
+        req.pages = []
+
+    def pending(self):
+        return [r for r in self._requests.values() if not r.done]
+
+    def step(self):
+        """One engine iteration: schedule <= max_batch live requests
+        (prefill + decode mixed) within the token budget, run the
+        artifact once, append one sampled token per scheduled row."""
+        cfg = self.cfg
+        rows = []
+        budget = cfg.token_budget
+        for r in self.pending():
+            if len(rows) == cfg.max_batch:
+                break
+            cost = len(r.prompt) if not r.prefilled else 1
+            if cost > budget:
+                continue
+            budget -= cost
+            rows.append(r)
+        if not rows:
+            return []
+
+        B1 = cfg.max_batch + 1
+        enc = np.zeros(B1, np.int32)
+        dec = np.zeros(B1, np.int32)
+        this = np.zeros(B1, np.int32)
+        bt = np.zeros((B1, cfg.max_blocks_per_seq), np.int32)  # 0 = trash
+        packed = []
+        for i, r in enumerate(rows):
+            if not r.prefilled:
+                n = len(r.prompt)
+                enc[i] = n
+                this[i] = n
+                packed_tokens = r.prompt
+                self._ensure_pages(r, n)
+            else:
+                dec[i] = r.length - 1        # prefix length in cache
+                this[i] = 1
+                packed_tokens = [r.generated[-1]] if r.generated \
+                    else [r.prompt[-1]]
+                self._ensure_pages(r, r.length)
+            bt[i, :len(r.pages)] = r.pages
+            packed.extend(packed_tokens)
+        # padding tokens -> trash row (index B1-1, block table all page 0)
+        n_pad = cfg.token_budget - len(packed)
+        this[B1 - 1] = n_pad
+        enc[B1 - 1] = n_pad
+        tokens = np.asarray(packed + [0] * n_pad, np.int32)
+        cu = np.zeros(B1 + 1, np.int32)
+        cu[1:] = np.cumsum(this)
+
+        out = self._compiled(self._params, self._buffers, tokens,
+                             enc, dec, this, cu, bt, self._kc, self._vc)
+        logits, self._kc, self._vc = out[0], out[1], out[2]
+        logits = np.asarray(logits)
+
+        produced = []
+        for i, r in enumerate(rows):
+            nxt = int(np.argmax(logits[i]))
+            r.generated.append(nxt)
+            r.prefilled = True
+            produced.append((r.rid, nxt))
+            if len(r.generated) >= r.max_new:
+                r.done = True
+                self._release(r)
+        return produced
+
+    def run_to_completion(self, max_steps=1000):
+        for _ in range(max_steps):
+            if not self.pending():
+                break
+            self.step()
+        return {rid: list(r.generated)
+                for rid, r in self._requests.items()}
+
+
+def save_paged_model(path_prefix: str, model: PagedCausalLM):
+    """Export the paged step function as a serving artifact with the
+    engine's static shapes."""
+    from . import save_inference_model
+    from ..jit.api import InputSpec
+
+    cfg = model.cfg
+    B1 = cfg.max_batch + 1
+    L = cfg.num_layers
+    cache_shape = (L, cfg.num_blocks, cfg.num_heads, cfg.block_size,
+                   cfg.head_dim)
+    spec = [
+        InputSpec((cfg.token_budget,), "int32", "tokens"),
+        InputSpec((B1,), "int32", "seq_lens_encoder"),
+        InputSpec((B1,), "int32", "seq_lens_decoder"),
+        InputSpec((B1,), "int32", "seq_lens_this_time"),
+        InputSpec((B1 + 1,), "int32", "cu_seqlens_q"),
+        InputSpec((B1, cfg.max_blocks_per_seq), "int32", "block_tables"),
+        InputSpec(cache_shape, "float32", "key_caches"),
+        InputSpec(cache_shape, "float32", "value_caches"),
+    ]
+    return save_inference_model(path_prefix, model, spec,
+                                output_names=["logits", "key_caches",
+                                              "value_caches"])

@@ -653,9 +653,10 @@ _reg("dequantize_abs_max", lambda x, scale, max_range:
      jnp.asarray(x, jnp.float32) * jnp.asarray(scale) / max_range,
      differentiable=False)
 _reg("dequantize_log", lambda x, dict_data:
-     jnp.where(jnp.asarray(x) < 0,
-               -jnp.asarray(dict_data)[jnp.asarray(x) + 128],
-               jnp.asarray(dict_data)[jnp.asarray(x)]),
+     # reference dequantize_log_kernel.cc: int8 codes, negative ->
+     # -dict[code + 128] (compute in int32: +128 overflows int8)
+     (lambda xi, d: jnp.where(xi < 0, -d[xi + 128], d[xi]))(
+         jnp.asarray(x).astype(jnp.int32), jnp.asarray(dict_data)),
      differentiable=False)
 
 

@@ -48,8 +48,8 @@ class Normalize:
         return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
 
 
-def _resize_np(arr, size):
-    # nearest-neighbor resize, HWC layout
+def _resize_np(arr, size, interpolation="nearest"):
+    # HWC layout; nearest or bilinear
     h, w = arr.shape[:2]
     if isinstance(size, numbers.Number):
         if h < w:
@@ -58,17 +58,39 @@ def _resize_np(arr, size):
             oh, ow = int(size * h / w), size
     else:
         oh, ow = size
-    yi = (np.arange(oh) * h / oh).astype(int)
-    xi = (np.arange(ow) * w / ow).astype(int)
-    return arr[yi][:, xi]
+    if interpolation == "nearest":
+        yi = (np.arange(oh) * h / oh).astype(int)
+        xi = (np.arange(ow) * w / ow).astype(int)
+        return arr[yi][:, xi]
+    sy = (np.arange(oh) + 0.5) * h / oh - 0.5
+    sx = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(sy), 0, h - 1).astype(int)
+    x0 = np.clip(np.floor(sx), 0, w - 1).astype(int)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = (np.clip(sy, 0, h - 1) - y0)[:, None]
+    wx = np.clip(sx, 0, w - 1) - x0
+    f = arr.astype(np.float32)
+    if f.ndim == 3:
+        wy = wy[..., None]
+        wxe = wx[None, :, None]
+    else:
+        wxe = wx[None, :]
+    out = (f[y0][:, x0] * (1 - wy) * (1 - wxe)
+           + f[y0][:, x1] * (1 - wy) * wxe
+           + f[y1][:, x0] * wy * (1 - wxe)
+           + f[y1][:, x1] * wy * wxe)
+    return out.astype(arr.dtype) if arr.dtype != np.uint8 \
+        else np.clip(np.round(out), 0, 255).astype(np.uint8)
 
 
 class Resize:
     def __init__(self, size, interpolation="bilinear"):
         self.size = size
+        self.interpolation = interpolation
 
     def __call__(self, img):
-        return _resize_np(np.asarray(img), self.size)
+        return _resize_np(np.asarray(img), self.size, self.interpolation)
 
 
 class CenterCrop:
@@ -132,4 +154,534 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 
 def resize(img, size, interpolation="bilinear"):
-    return Resize(size)(np.asarray(img))
+    return Resize(size, interpolation)(np.asarray(img))
+
+
+# ---------------------------------------------------------------------------
+# full reference surface (python/paddle/vision/transforms/transforms.py):
+# geometric + photometric transforms and their functional forms, all
+# numpy-backed on HWC arrays (uint8 images stay uint8, floats stay float)
+# ---------------------------------------------------------------------------
+
+class BaseTransform:
+    """reference BaseTransform: keys-aware transform base; subclasses
+    implement _apply_image (and optionally _apply_* for other keys)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, img):
+        return img
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)):
+            out = []
+            for i, data in enumerate(inputs):
+                if i < len(self.keys):
+                    fn = getattr(self, f"_apply_{self.keys[i]}", None)
+                    out.append(fn(data) if fn else data)
+                else:
+                    out.append(data)      # extras pass through unchanged
+            return tuple(out)
+        return self._apply_image(inputs)
+
+
+def _as_img(img):
+    return np.asarray(img)
+
+
+def _like(out, ref):
+    if ref.dtype == np.uint8:
+        return np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out.astype(ref.dtype)
+
+
+def hflip(img):
+    return _as_img(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _as_img(img)[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return _as_img(img)[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _as_img(img)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    return crop(arr, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _as_img(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    if mode == "constant":
+        return np.pad(arr, pads, mode, constant_values=fill)
+    return np.pad(arr, pads, mode)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_img(img)
+    if arr.ndim == 2:
+        g = arr.astype(np.float32)
+    else:
+        g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+             + 0.114 * arr[..., 2]).astype(np.float32)
+    g = np.repeat(_like(g, arr)[..., None], num_output_channels, -1)
+    return g
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _as_img(img)
+    return _like(arr.astype(np.float32) * brightness_factor, arr)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_img(img)
+    f = arr.astype(np.float32)
+    gray_mean = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+                 + 0.114 * f[..., 2]).mean() if arr.ndim == 3 \
+        else f.mean()
+    return _like(f * contrast_factor
+                 + (1 - contrast_factor) * gray_mean, arr)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _as_img(img)
+    f = arr.astype(np.float32)
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
+            + 0.114 * f[..., 2])[..., None]
+    return _like(f * saturation_factor
+                 + (1 - saturation_factor) * gray, arr)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] turns) via HSV. Requires
+    an RGB(A) image; alpha passes through untouched."""
+    arr = _as_img(img)
+    if arr.ndim != 3 or arr.shape[-1] < 3:
+        raise ValueError("adjust_hue expects an RGB(A) HWC image, got "
+                         f"shape {arr.shape}")
+    alpha = arr[..., 3:] if arr.shape[-1] > 3 else None
+    f = arr.astype(np.float32) / (255.0 if arr.dtype == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = np.max(f[..., :3], -1)
+    minc = np.min(f[..., :3], -1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(d, 1e-12)
+    h = np.where(maxc == r, (g - b) / dz % 6,
+                 np.where(maxc == g, (b - r) / dz + 2,
+                          (r - g) / dz + 4)) / 6.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6)
+    fr = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - fr * s)
+    t = v * (1 - (1 - fr) * s)
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t, v])
+    g2 = np.choose(i, [t, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], -1)
+    if arr.dtype == np.uint8:
+        out = out * 255.0
+    out = _like(out, arr)
+    if alpha is not None:
+        out = np.concatenate([out, alpha], axis=-1)
+    return out
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _as_img(img) if inplace else _as_img(img).copy()
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+def _inverse_warp(arr, inv_matrix, fill=0, interpolation="bilinear",
+                  out_hw=None):
+    """Sample arr at inv_matrix @ (x_out, y_out, 1); coordinates outside
+    the source fill with `fill`. out_hw sets the output canvas size."""
+    h, w = arr.shape[:2]
+    oh, ow = out_hw or (h, w)
+    ys, xs = np.meshgrid(np.arange(oh, dtype=np.float32),
+                         np.arange(ow, dtype=np.float32), indexing="ij")
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    m = np.asarray(inv_matrix, np.float32).reshape(3, 3)
+    src = m @ coords
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-12) * np.sign(src[2])
+    sx = sx.reshape(oh, ow)
+    sy = sy.reshape(oh, ow)
+    eps = 1e-4      # boundary pixels must survive float rounding
+    valid = (sx >= -eps) & (sx <= w - 1 + eps) \
+        & (sy >= -eps) & (sy <= h - 1 + eps)
+    if interpolation == "nearest":
+        sx = np.round(sx)
+        sy = np.round(sy)
+    x0 = np.clip(np.floor(sx), 0, w - 1).astype(np.int32)
+    y0 = np.clip(np.floor(sy), 0, h - 1).astype(np.int32)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    wx = np.clip(sx, 0, w - 1) - x0
+    wy = np.clip(sy, 0, h - 1) - y0
+    f = arr.astype(np.float32)
+    if f.ndim == 2:
+        f = f[..., None]
+    wxe = wx[..., None]
+    wye = wy[..., None]
+    out = (f[y0, x0] * (1 - wye) * (1 - wxe)
+           + f[y0, x1] * (1 - wye) * wxe
+           + f[y1, x0] * wye * (1 - wxe)
+           + f[y1, x1] * wye * wxe)
+    out = np.where(valid[..., None], out, np.float32(fill))
+    if arr.ndim == 2:
+        out = out[..., 0]
+    return _like(out, arr)
+
+
+def rotate(img, angle, interpolation="bilinear", expand=False, center=None,
+           fill=0):
+    arr = _as_img(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    a = np.deg2rad(angle)
+    cos, sin = np.cos(a), np.sin(a)
+    out_hw = None
+    ox, oy = cx, cy
+    if expand:
+        oh = int(np.ceil(abs(h * cos) + abs(w * sin)))
+        ow = int(np.ceil(abs(w * cos) + abs(h * sin)))
+        out_hw = (oh, ow)
+        ox, oy = (ow - 1) / 2.0, (oh - 1) / 2.0   # new canvas center
+    # inverse of a counterclockwise rotation about the center (PIL
+    # convention: positive angle rotates the image counterclockwise)
+    inv = np.array([[cos, -sin, cx - cos * ox + sin * oy],
+                    [sin, cos, cy - sin * ox - cos * oy],
+                    [0, 0, 1]], np.float32)
+    return _inverse_warp(arr, inv, fill, interpolation, out_hw)
+
+
+def affine(img, matrix, interpolation="bilinear", fill=0):
+    """matrix: 6-element forward affine [a, b, c, d, e, f] mapping
+    output->input like the reference (PIL convention)."""
+    m = np.asarray(matrix, np.float32).reshape(2, 3)
+    inv = np.vstack([m, [0, 0, 1]]).astype(np.float32)
+    return _inverse_warp(_as_img(img), inv, fill, interpolation)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Warp so `startpoints` map to `endpoints` (each 4 [x, y])."""
+    a = []
+    b = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        a.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        a.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.extend([sx, sy])
+    coeffs = np.linalg.solve(np.asarray(a, np.float32),
+                             np.asarray(b, np.float32))
+    inv = np.append(coeffs, 1.0).reshape(3, 3)
+    return _inverse_warp(_as_img(img), inv, fill, interpolation)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return vflip(img)
+        return _as_img(img)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+def _jitter_range(value, name, center=1.0, bound=None):
+    """Normalize a jitter spec to a (low, high) range (reference
+    _check_input): scalar v -> [center-v, center+v] clamped at 0;
+    a (min, max) sequence is taken as-is."""
+    if isinstance(value, numbers.Number):
+        if value < 0:
+            raise ValueError(f"{name} value should be non-negative")
+        lo, hi = center - value, center + value
+        if center == 1.0:
+            lo = max(lo, 0.0)
+    else:
+        lo, hi = float(value[0]), float(value[1])
+    if lo > hi:
+        raise ValueError(f"{name} range {lo}..{hi} is inverted")
+    if bound is not None and not (bound[0] <= lo <= hi <= bound[1]):
+        raise ValueError(f"{name} range must be within {bound}")
+    return lo, hi
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "brightness")
+
+    def _apply_image(self, img):
+        if self.range == (1.0, 1.0):
+            return _as_img(img)
+        return adjust_brightness(img, np.random.uniform(*self.range))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "contrast")
+
+    def _apply_image(self, img):
+        if self.range == (1.0, 1.0):
+            return _as_img(img)
+        return adjust_contrast(img, np.random.uniform(*self.range))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "saturation")
+
+    def _apply_image(self, img):
+        if self.range == (1.0, 1.0):
+            return _as_img(img)
+        return adjust_saturation(img, np.random.uniform(*self.range))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.range = _jitter_range(value, "hue", center=0.0,
+                                   bound=(-0.5, 0.5))
+
+    def _apply_image(self, img):
+        if self.range == (0.0, 0.0):
+            return _as_img(img)
+        return adjust_hue(img, np.random.uniform(*self.range))
+
+
+class ColorJitter(BaseTransform):
+    """Randomly jitter brightness/contrast/saturation/hue in random
+    order (reference ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="bilinear", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Crop a random area/aspect patch and resize (reference
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _as_img(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return _resize_np(crop(arr, i, j, ch, cw), self.size,
+                                  self.interpolation)
+        return _resize_np(center_crop(arr, min(h, w)), self.size,
+                          self.interpolation)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference RandomErasing; value
+    'random' fills with noise)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        arr = _as_img(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    v = np.random.rand(
+                        eh, ew, *arr.shape[2:]).astype(np.float32)
+                    if arr.dtype == np.uint8:
+                        v = (v * 255).astype(np.uint8)
+                else:
+                    v = self.value
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="bilinear", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _as_img(img)
+        h, w = arr.shape[:2]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        shx = shy = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-abs(sh), abs(sh))
+            shx = np.deg2rad(np.random.uniform(sh[0], sh[1]))
+            if len(sh) == 4:
+                shy = np.deg2rad(np.random.uniform(sh[2], sh[3]))
+        cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        cos, sin = np.cos(angle) * sc, np.sin(angle) * sc
+        rot = np.array([[cos, -sin], [sin, cos]], np.float32)
+        shear_m = np.array([[1, np.tan(shx)], [np.tan(shy), 1]],
+                           np.float32)
+        m = rot @ shear_m
+        fwd = np.array(
+            [[m[0, 0], m[0, 1], cx - m[0, 0] * cx - m[0, 1] * cy + tx],
+             [m[1, 0], m[1, 1], cy - m[1, 0] * cx - m[1, 1] * cy + ty],
+             [0, 0, 1]], np.float32)
+        inv = np.linalg.inv(fwd)
+        return _inverse_warp(arr, inv, self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _as_img(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+
+        def jitter(lo_x, lo_y):
+            return [np.random.randint(0, dx + 1) * (1 if lo_x else -1)
+                    + (0 if lo_x else w - 1),
+                    np.random.randint(0, dy + 1) * (1 if lo_y else -1)
+                    + (0 if lo_y else h - 1)]
+
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [jitter(True, True), jitter(False, True),
+               jitter(False, False), jitter(True, False)]
+        return perspective(arr, start, end, fill=self.fill)
+
+
+__all__ += ["BaseTransform", "RandomVerticalFlip", "Pad", "Grayscale",
+            "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "RandomRotation", "RandomResizedCrop", "RandomErasing",
+            "RandomAffine", "RandomPerspective", "hflip", "vflip", "crop",
+            "center_crop", "pad", "rotate", "affine", "perspective",
+            "to_grayscale", "adjust_brightness", "adjust_contrast",
+            "adjust_saturation", "adjust_hue", "erase"]

@@ -1,0 +1,608 @@
+"""NumPy-reference value checks for the op tail (VERDICT r2 #8).
+
+The discipline of /root/reference/test/legacy_test/op_test.py:418 applied
+to the ~50 most consequential yaml_extra / vision / fused ops that were
+previously only forward-smoke tested: every check computes the expected
+result INDEPENDENTLY in NumPy and compares exactly (up to float
+tolerance), at non-trivial shapes.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers ops)
+from paddle_tpu.ops import registry
+
+R = np.random.RandomState
+
+
+def K(name):
+    info = registry.get(name)
+    assert info is not None, f"op {name} not registered"
+    return info.fn
+
+
+def A(x):
+    return np.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# vision: roi ops + proposals
+# ---------------------------------------------------------------------------
+
+def _bilinear(feat, y, x):
+    C, H, W = feat.shape
+    y = np.clip(y, 0, H - 1)
+    x = np.clip(x, 0, W - 1)
+    y0, x0 = int(np.floor(y)), int(np.floor(x))
+    y1, x1 = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+    wy, wx = y - y0, x - x0
+    return (feat[:, y0, x0] * (1 - wy) * (1 - wx)
+            + feat[:, y0, x1] * (1 - wy) * wx
+            + feat[:, y1, x0] * wy * (1 - wx)
+            + feat[:, y1, x1] * wy * wx)
+
+
+def test_roi_align_value():
+    rng = R(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    boxes = np.array([[1.0, 1.0, 5.0, 5.0],
+                      [0.0, 2.0, 6.0, 7.0],
+                      [2.0, 0.0, 7.0, 4.0]], np.float32)
+    boxes_num = np.array([2, 1], np.int32)
+    ph = pw = 2
+    sr = 2
+    got = A(K("roi_align")(x, boxes, boxes_num, pooled_height=ph,
+                           pooled_width=pw, spatial_scale=1.0,
+                           sampling_ratio=sr, aligned=True))
+    ref = np.zeros((3, 3, ph, pw), np.float32)
+    img_of = [0, 0, 1]
+    for r, (roi, bi) in enumerate(zip(boxes, img_of)):
+        x1, y1, x2, y2 = roi - np.array([0.5, 0.5, 0.5, 0.5])
+        rw = max(x2 - x1, 1e-5)
+        rh = max(y2 - y1, 1e-5)
+        bh, bw = rh / ph, rw / pw
+        for py in range(ph):
+            for px in range(pw):
+                acc = np.zeros(3, np.float32)
+                for iy in range(sr):
+                    for ix in range(sr):
+                        yy = y1 + (py + (iy + 0.5) / sr) * bh
+                        xx = x1 + (px + (ix + 0.5) / sr) * bw
+                        acc += _bilinear(x[bi], yy, xx)
+                ref[r, :, py, px] = acc / (sr * sr)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def _np_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+        w = np.maximum(0.0, xx2 - xx1 + 1)
+        h = np.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        a = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1]
+                                               + 1)
+        iou = inter / (a[i] + a[order[1:]] - inter)
+        order = order[1:][iou <= thresh]
+    return keep
+
+
+def test_generate_proposals_value():
+    """Independent NumPy RPN: decode -> clip -> min-size filter -> NMS."""
+    rng = R(1)
+    N, A_, H, W = 1, 3, 4, 4
+    scores = rng.rand(N, A_, H, W).astype(np.float32)
+    deltas = (rng.randn(N, A_ * 4, H, W) * 0.1).astype(np.float32)
+    im_shape = np.array([[32.0, 32.0]], np.float32)
+    base = []
+    for yy in range(H):
+        for xx in range(W):
+            for a in range(A_):
+                s = 4 * (a + 1)
+                cx, cy = xx * 8 + 4, yy * 8 + 4
+                base.append([cx - s, cy - s, cx + s, cy + s])
+    anchors = np.asarray(base, np.float32).reshape(H, W, A_, 4)
+    rois, probs, nums = K("generate_proposals")(
+        scores, deltas, im_shape, anchors, pre_nms_top_n=48,
+        post_nms_top_n=8, nms_thresh=0.5, min_size=2.0)
+    rois, probs, nums = A(rois), A(probs), A(nums)
+
+    # numpy reference
+    scf = scores[0].transpose(1, 2, 0).reshape(-1)
+    dlf = deltas[0].reshape(A_, 4, H, W).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4)
+    anc = anchors.reshape(-1, 4)
+    w = anc[:, 2] - anc[:, 0] + 1
+    h = anc[:, 3] - anc[:, 1] + 1
+    cx = anc[:, 0] + 0.5 * w
+    cy = anc[:, 1] + 0.5 * h
+    ncx = dlf[:, 0] * w + cx
+    ncy = dlf[:, 1] * h + cy
+    nw = np.exp(dlf[:, 2]) * w
+    nh = np.exp(dlf[:, 3]) * h
+    x1 = np.clip(ncx - 0.5 * nw, 0, 31)
+    y1 = np.clip(ncy - 0.5 * nh, 0, 31)
+    x2 = np.clip(ncx + 0.5 * nw - 1, 0, 31)
+    y2 = np.clip(ncy + 0.5 * nh - 1, 0, 31)
+    boxes = np.stack([x1, y1, x2, y2], 1)
+    valid = ((x2 - x1 + 1) >= 2.0) & ((y2 - y1 + 1) >= 2.0)
+    keep = _np_nms(boxes[valid], scf[valid], 0.5)[:8]
+    ref_boxes = boxes[valid][keep]
+    ref_probs = scf[valid][keep]
+    n = int(nums[0])
+    assert n == len(keep)
+    np.testing.assert_allclose(rois[0, :n], ref_boxes, rtol=1e-4,
+                               atol=1e-3)
+    np.testing.assert_allclose(probs[0, :n, 0], ref_probs, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = R(2)
+    prior = rng.rand(5, 4).astype(np.float32) * 10
+    prior[:, 2:] += prior[:, :2] + 1.0
+    target = rng.rand(5, 4).astype(np.float32) * 10
+    target[:, 2:] += target[:, :2] + 1.0
+    var = np.full((5, 4), 0.5, np.float32)
+    enc = A(K("box_coder")(prior, var, target,
+                           code_type="encode_center_size"))
+    # encode is pairwise [M, N, 4]; decoding each target's own-prior code
+    # must give the target back
+    diag = enc[np.arange(5), np.arange(5)].reshape(5, 1, 4)
+    dec = A(K("box_coder")(prior, var, diag,
+                           code_type="decode_center_size"))
+    np.testing.assert_allclose(dec.reshape(5, 4), target, rtol=1e-4,
+                               atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# metrics / decode
+# ---------------------------------------------------------------------------
+
+def test_auc_value():
+    rng = R(3)
+    prob = rng.rand(200).astype(np.float32)
+    lab = (rng.rand(200) > 0.6).astype(np.int64)
+    nt = 4095
+    auc, sp, sn = K("auc")(
+        np.stack([1 - prob, prob], 1), lab,
+        np.zeros(nt + 1, np.int64), np.zeros(nt + 1, np.int64),
+        num_thresholds=nt)
+    # exact ROC-AUC by pair counting (ties at bin resolution)
+    bins = np.clip((prob * nt).astype(np.int64), 0, nt)
+    pos_b = bins[lab == 1]
+    neg_b = bins[lab == 0]
+    wins = (pos_b[:, None] > neg_b[None, :]).sum()
+    ties = (pos_b[:, None] == neg_b[None, :]).sum()
+    ref = (wins + 0.5 * ties) / (len(pos_b) * len(neg_b))
+    np.testing.assert_allclose(float(A(auc)), ref, atol=1e-6)
+    assert int(A(sp).sum()) == int((lab == 1).sum())
+    assert int(A(sn).sum()) == int((lab == 0).sum())
+
+
+def test_accuracy_value():
+    idx = np.array([[0, 2], [1, 3], [4, 0], [2, 2]], np.int64)
+    lab = np.array([2, 0, 4, 1], np.int64)
+    acc, correct, total = K("accuracy")(
+        np.zeros((4, 2), np.float32), idx, lab)
+    assert float(A(acc)) == pytest.approx(0.5)
+    assert int(A(correct)) == 2 and int(A(total)) == 4
+
+
+def test_edit_distance_value():
+    def lev(a, b):
+        D = np.zeros((len(a) + 1, len(b) + 1))
+        D[:, 0] = np.arange(len(a) + 1)
+        D[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                D[i, j] = min(D[i - 1, j] + 1, D[i, j - 1] + 1,
+                              D[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return D[-1, -1]
+
+    hyps = np.array([[1, 2, 3, 4, 0], [5, 5, 5, 0, 0]], np.int64)
+    refs = np.array([[1, 3, 3, 7], [5, 5, 5, 5]], np.int64)
+    hl = np.array([4, 3])
+    rl = np.array([4, 4])
+    n, dist = K("edit_distance")(hyps, refs, hl, rl)
+    ref = [lev([1, 2, 3, 4], [1, 3, 3, 7]), lev([5, 5, 5], [5, 5, 5, 5])]
+    np.testing.assert_allclose(A(dist).reshape(-1), ref)
+    norm = A(K("edit_distance")(hyps, refs, hl, rl, normalized=True)[1])
+    np.testing.assert_allclose(norm.reshape(-1), np.asarray(ref) / 4.0)
+
+
+def test_ctc_align_value():
+    x = np.array([[0, 1, 1, 0, 2, 2, 3],
+                  [4, 4, 0, 0, 5, 0, 0]], np.int64)
+    got = A(K("ctc_align")(x, blank=0))
+    np.testing.assert_array_equal(
+        got, [[1, 2, 3, -1, -1, -1, -1], [4, 5, -1, -1, -1, -1, -1]])
+    got2 = A(K("ctc_align")(x, blank=0, merge_repeated=False))
+    np.testing.assert_array_equal(
+        got2, [[1, 1, 2, 2, 3, -1, -1], [4, 4, 5, -1, -1, -1, -1]])
+
+
+def test_gather_tree_value():
+    # T=3, B=1, W=2 beam backtrace by hand
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    got = A(K("gather_tree")(ids, parents))
+    # beam 0 at t=2 came from parent 1 at t=1 (id 4), which came from
+    # parent 0 at t=0 (id 1); beam 1 from parent 0 chain
+    ref = np.array([[[1, 1]], [[4, 3]], [[5, 6]]], np.int64)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_viterbi_decode_brute_force():
+    rng = R(4)
+    B, T, N = 2, 4, 5          # N-2=BOS, N-1=EOS when tagged
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.array([4, 3])
+    scores, path = K("viterbi_decode")(pot, trans, lens,
+                                       include_bos_eos_tag=False)
+    scores, path = A(scores), A(path)
+    import itertools
+
+    for b in range(B):
+        L = lens[b]
+        best, best_p = -1e30, None
+        for tags in itertools.product(range(N), repeat=int(L)):
+            s = pot[b, 0, tags[0]]
+            for t in range(1, L):
+                s += trans[tags[t - 1], tags[t]] + pot[b, t, tags[t]]
+            if s > best:
+                best, best_p = s, tags
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        np.testing.assert_array_equal(path[b, :L], best_p)
+
+
+# ---------------------------------------------------------------------------
+# signal: frame / overlap_add / stft / fft
+# ---------------------------------------------------------------------------
+
+def test_frame_overlap_add_value():
+    rng = R(5)
+    x = rng.randn(3, 20).astype(np.float32)
+    fl, hop = 6, 3
+    frames = A(K("frame")(x, fl, hop))
+    n_frames = 1 + (20 - fl) // hop
+    assert frames.shape == (3, fl, n_frames)
+    for i in range(n_frames):
+        np.testing.assert_allclose(frames[:, :, i],
+                                   x[:, i * hop:i * hop + fl])
+    # overlap_add inverts the framing up to window summation
+    back = A(K("overlap_add")(frames, hop))
+    ref = np.zeros((3, (n_frames - 1) * hop + fl), np.float32)
+    for i in range(n_frames):
+        ref[:, i * hop:i * hop + fl] += frames[:, :, i]
+    np.testing.assert_allclose(back, ref, rtol=1e-6)
+
+
+def test_stft_value():
+    rng = R(6)
+    x = rng.randn(2, 32).astype(np.float32)
+    n_fft, hop = 8, 4
+    win = np.hanning(n_fft).astype(np.float32)
+    got = A(K("stft")(x, win, n_fft, hop, onesided=True))
+    n_frames = 1 + (32 - n_fft) // hop
+    freqs = n_fft // 2 + 1
+    ref = np.zeros((2, freqs, n_frames), np.complex64)
+    for i in range(n_frames):
+        seg = x[:, i * hop:i * hop + n_fft] * win
+        ref[:, :, i] = np.fft.rfft(seg, axis=-1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_fft_family_vs_numpy():
+    rng = R(7)
+    x = rng.randn(4, 8).astype(np.float32)
+    xc = (rng.randn(4, 8) + 1j * rng.randn(4, 8)).astype(np.complex64)
+    np.testing.assert_allclose(A(K("fft_r2c")(x, axes=[-1])),
+                               np.fft.rfft(x, axis=-1), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(A(K("fft_c2c")(xc, axes=[-1])),
+                               np.fft.fft(xc, axis=-1), rtol=1e-4,
+                               atol=1e-4)
+    half = np.fft.rfft(x, axis=-1).astype(np.complex64)
+    np.testing.assert_allclose(
+        A(K("fft_c2r")(half, axes=[-1], last_dim_size=8)),
+        np.fft.irfft(half, n=8, axis=-1), rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# quantization family
+# ---------------------------------------------------------------------------
+
+def test_fake_quantize_abs_max_value():
+    rng = R(8)
+    x = rng.randn(6, 5).astype(np.float32) * 3
+    out, scale = K("fake_quantize_abs_max")(x, bit_length=8)
+    out, scale = A(out), A(scale)
+    s = np.abs(x).max()
+    np.testing.assert_allclose(scale.reshape(()), s, rtol=1e-6)
+    np.testing.assert_allclose(out, np.round(x / s * 127), rtol=1e-5)
+
+
+def test_fake_dequantize_max_abs_value():
+    rng = R(9)
+    q = np.round(rng.randn(4, 4) * 50).astype(np.float32)
+    scale = np.float32(3.7)
+    got = A(K("fake_dequantize_max_abs")(q, scale, 127.0))
+    np.testing.assert_allclose(got, q * 3.7 / 127.0, rtol=1e-6)
+
+
+def test_fake_channel_wise_quant_dequant_value():
+    rng = R(10)
+    x = rng.randn(4, 6).astype(np.float32) * 2
+    out, scales = K("fake_channel_wise_quantize_abs_max")(
+        x, bit_length=8, quant_axis=0)
+    out, scales = A(out), A(scales)
+    ref_s = np.abs(x).max(axis=1)
+    np.testing.assert_allclose(scales.reshape(-1), ref_s, rtol=1e-6)
+    np.testing.assert_allclose(out,
+                               np.round(x / ref_s[:, None] * 127),
+                               rtol=1e-5)
+    deq = A(K("fake_channel_wise_dequantize_max_abs")(
+        out, [scales], quant_bits=(8,), quant_axis=0))
+    np.testing.assert_allclose(deq, np.round(x / ref_s[:, None] * 127)
+                               * ref_s[:, None] / 127, rtol=1e-5)
+
+
+def test_fake_quant_dequant_roundtrip_error_bound():
+    rng = R(11)
+    x = rng.randn(8, 8).astype(np.float32)
+    got = A(K("fake_quantize_dequantize_abs_max")(x)[0])
+    step = np.abs(x).max() / 127
+    assert np.abs(got - x).max() <= step / 2 + 1e-6
+
+
+def test_fake_quantize_moving_average_value():
+    rng = R(12)
+    x = rng.randn(5, 5).astype(np.float32) * 2
+    in_scale = np.array([1.0], np.float32)
+    accum = np.array([1.0], np.float32)
+    state = np.array([1.0], np.float32)
+    out, scale_o, state_o, accum_o = K(
+        "fake_quantize_moving_average_abs_max")(
+        x, in_scale, accum, state, moving_rate=0.9)
+    # reference fake_quantize_functor.cc FindMovingAverageAbsMax:
+    # state = rate*state + 1; accum = rate*accum + cur; scale = accum/state
+    cur = np.abs(x).max()
+    ref_state = 0.9 * 1.0 + 1
+    ref_accum = 0.9 * 1.0 + cur
+    ref_scale = ref_accum / ref_state
+    np.testing.assert_allclose(A(state_o).reshape(()), ref_state,
+                               rtol=1e-6)
+    np.testing.assert_allclose(A(accum_o).reshape(()), ref_accum,
+                               rtol=1e-5)
+    np.testing.assert_allclose(A(scale_o).reshape(()), ref_scale,
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        A(out), np.clip(np.round(x / ref_scale * 127), -127, 127),
+        rtol=1e-5)
+
+
+def test_weight_quantize_dequantize_roundtrip():
+    rng = R(13)
+    w = rng.randn(16, 8).astype(np.float32)
+    qw, scale = K("weight_quantize")(w, algo="weight_only_int8")
+    deq = A(K("weight_dequantize")(A(qw), A(scale),
+                                   out_dtype="float32"))
+    step = np.abs(w).max(axis=0) / 127
+    assert np.abs(deq - w).max() <= step.max() / 2 + 1e-5
+
+
+def test_weight_only_linear_matches_fp():
+    rng = R(14)
+    x = rng.randn(3, 8).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32)   # [in, out], per-out scales
+    qw, scale = K("weight_quantize")(w, algo="weight_only_int8")
+    bias = rng.randn(16).astype(np.float32) * 0.1
+    got = A(K("weight_only_linear")(x, A(qw), bias, A(scale),
+                                    weight_dtype="int8"))
+    ref = x @ w + bias
+    assert np.abs(got - ref).max() < 0.15 * np.abs(ref).max() + 0.1
+
+
+def test_llm_int8_linear_matches_fp():
+    rng = R(15)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 16).astype(np.float32)
+    qw, scale = K("weight_quantize")(w, algo="llm.int8")
+    got = A(K("llm_int8_linear")(x, A(qw), None, A(scale)))
+    ref = x @ w
+    assert np.abs(got - ref).max() < 0.15 * np.abs(ref).max() + 0.1
+
+
+def test_apply_per_channel_scale_value():
+    rng = R(16)
+    x = rng.randn(3, 6).astype(np.float32)
+    s = (rng.rand(6).astype(np.float32) + 0.5)
+    np.testing.assert_allclose(A(K("apply_per_channel_scale")(x, s)),
+                               x * s, rtol=1e-6)
+
+
+def test_dequantize_log_value():
+    table = (np.arange(128, dtype=np.float32) / 16.0)
+    x = np.array([[3, -126, 7]], np.int8)
+    got = A(K("dequantize_log")(x, table))
+    # reference dequantize_log_kernel.cc: negative codes decode as
+    # -dict[code + 128]
+    ref = np.array([[table[3], -table[2], table[7]]], np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# MoE / routing / sampling
+# ---------------------------------------------------------------------------
+
+def test_assign_pos_value():
+    # tokens' expert ids; cum_count from a counting pass
+    x = np.array([1, 0, 1, 2, 0], np.int64)
+    counts = np.array([2, 2, 1], np.int64)
+    cum = np.cumsum(counts)
+    got = A(K("assign_pos")(x, cum, np.array([5], np.int64)))
+    # positions grouped by expert: expert0 tokens (idx 1,4), expert1
+    # (0,2), expert2 (3)
+    assert sorted(got[:2].tolist()) == [1, 4]
+    assert sorted(got[2:4].tolist()) == [0, 2]
+    assert got[4] == 3
+
+
+def test_prune_gate_by_capacity_value():
+    gate = np.array([0, 0, 0, 1, 1, 2], np.int64)
+    cap = np.array([2, 1, 5], np.int64)     # expert capacities
+    got = A(K("prune_gate_by_capacity")(gate, cap, 3, 1))
+    # third token routed to expert 0 overflows -> -1; second to expert 1
+    # overflows -> -1
+    np.testing.assert_array_equal(got, [0, 0, -1, 1, -1, 2])
+
+
+def test_top_p_sampling_peaked_distribution():
+    x = np.full((2, 10), -10.0, np.float32)    # logits, softmaxed inside
+    x[0, 3] = 10.0
+    x[1, 7] = 10.0
+    ps = np.array([[0.9], [0.9]], np.float32)
+    out, ids = K("top_p_sampling")(x, ps, seed=7)
+    np.testing.assert_array_equal(A(ids).reshape(-1), [3, 7])
+    np.testing.assert_allclose(A(out).reshape(-1), 1.0, atol=1e-4)
+
+
+def test_segment_pool_values():
+    x = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    seg = np.array([0, 0, 1, 1], np.int64)
+    s = A(K("segment_pool")(x, seg, "SUM")[0])
+    np.testing.assert_allclose(s, [[4, 6], [12, 14]])
+    m = A(K("segment_pool")(x, seg, "MEAN")[0])
+    np.testing.assert_allclose(m, [[2, 3], [6, 7]])
+    mx = A(K("segment_pool")(x, seg, "MAX")[0])
+    np.testing.assert_allclose(mx, [[3, 4], [7, 8]])
+    mn = A(K("segment_pool")(x, seg, "MIN")[0])
+    np.testing.assert_allclose(mn, [[1, 2], [5, 6]])
+
+
+def test_send_u_recv_values():
+    x = np.array([[1.], [2.], [4.]], np.float32)
+    src = np.array([0, 1, 2, 2], np.int64)
+    dst = np.array([1, 0, 0, 1], np.int64)
+    got = A(K("send_u_recv")(x, src, dst, reduce_op="SUM")[0])
+    # dst0 receives x[1]+x[2]=6; dst1 receives x[0]+x[2]=5
+    np.testing.assert_allclose(got[:2], [[6.], [5.]])
+    got_max = A(K("send_u_recv")(x, src, dst, reduce_op="MAX")[0])
+    np.testing.assert_allclose(got_max[:2], [[4.], [4.]])
+
+
+def test_send_ue_recv_and_send_uv_values():
+    x = np.array([[1.], [2.], [3.]], np.float32)
+    e = np.array([[10.], [20.], [30.]], np.float32)
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 0], np.int64)
+    got = A(K("send_ue_recv")(x, e, src, dst, message_op="ADD",
+                              reduce_op="SUM")[0])
+    np.testing.assert_allclose(got, [[33.], [11.], [22.]])
+    got2 = A(K("send_uv")(x, x, src, dst, message_op="MUL"))
+    # per-edge: x[src] * x[dst]
+    np.testing.assert_allclose(got2, [[2.], [6.], [3.]])
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation tail
+# ---------------------------------------------------------------------------
+
+def test_fill_diagonal_values():
+    x = np.zeros((4, 4), np.float32)
+    got = A(K("fill_diagonal")(x, 5.0))
+    np.testing.assert_allclose(got, np.diag([5.] * 4))
+    y = np.arange(12, np.float32).reshape(3, 4) \
+        if False else np.arange(12, dtype=np.float32).reshape(3, 4)
+    v = np.array([9., 9., 9.], np.float32)
+    got2 = A(K("fill_diagonal_tensor")(np.zeros((3, 3), np.float32),
+                                       v))
+    np.testing.assert_allclose(got2, np.diag(v))
+
+
+def test_shard_index_value():
+    idx = np.array([[1], [5], [9], [3]], np.int64)
+    got = A(K("shard_index")(idx, index_num=12, nshards=3, shard_id=1))
+    # shard size 4; ids 4..7 belong to shard 1 and remap to id-4
+    np.testing.assert_array_equal(got.reshape(-1), [-1, 1, -1, -1])
+
+
+def test_sequence_mask_value():
+    got = A(K("sequence_mask")(np.array([1, 3, 2], np.int64), 4))
+    ref = np.array([[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+    np.testing.assert_array_equal(got.astype(np.int64), ref)
+
+
+def test_full_batch_size_like_value():
+    x = np.zeros((5, 3), np.float32)
+    got = A(K("full_batch_size_like")(x, [-1, 7], 2.5))
+    assert got.shape == (5, 7)
+    np.testing.assert_allclose(got, 2.5)
+
+
+def test_as_strided_value():
+    x = np.arange(12, dtype=np.float32)
+    got = A(K("as_strided")(x, [3, 4], [4, 1]))
+    np.testing.assert_allclose(got, x.reshape(3, 4))
+    # overlapping windows
+    got2 = A(K("as_strided")(x, [5, 4], [2, 1]))
+    ref = np.stack([x[i * 2:i * 2 + 4] for i in range(5)])
+    np.testing.assert_allclose(got2, ref)
+
+
+def test_repeat_interleave_with_tensor_index_value():
+    x = np.array([[1., 2.], [3., 4.]], np.float32)
+    rep = np.array([2, 1], np.int64)
+    got = A(K("repeat_interleave_with_tensor_index")(x, rep, 0))
+    np.testing.assert_allclose(got, [[1., 2.], [1., 2.], [3., 4.]])
+
+
+def test_set_value_with_tensor_value():
+    x = np.zeros((4, 4), np.float32)
+    v = np.ones((2, 4), np.float32) * 7
+    got = A(K("set_value_with_tensor")(x, v, starts=[1], ends=[3],
+                                       steps=[1], axes=[0]))
+    ref = x.copy()
+    ref[1:3] = 7
+    np.testing.assert_allclose(got, ref)
+
+
+# ---------------------------------------------------------------------------
+# random ops: statistical properties
+# ---------------------------------------------------------------------------
+
+def test_truncated_gaussian_random_bounds():
+    # reference funcs/truncated_normal.h: a/b are ABSOLUTE bounds
+    out = A(K("truncated_gaussian_random")([20000], mean=1.0, std=2.0,
+                                           seed=5, a=-2.0, b=2.0))
+    assert out.shape == (20000,)
+    assert (out >= -2.0 - 1e-5).all() and (out <= 2.0 + 1e-5).all()
+    # analytic mean of N(1,2) truncated to [-2,2]
+    from math import erf, exp, pi, sqrt
+
+    def phi(z):
+        return exp(-z * z / 2) / sqrt(2 * pi)
+
+    def Phi(z):
+        return (1 + erf(z / sqrt(2))) / 2
+
+    al, be = (-2 - 1) / 2, (2 - 1) / 2
+    ref_mean = 1 + 2 * (phi(al) - phi(be)) / (Phi(be) - Phi(al))
+    assert abs(out.mean() - ref_mean) < 0.05
+
+
+def test_dirichlet_statistics():
+    alpha = np.array([[2.0, 3.0, 5.0]] * 4000, np.float32)
+    out = A(K("dirichlet")(alpha, seed=3))
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+    assert (out >= 0).all()
+    np.testing.assert_allclose(out.mean(0), [0.2, 0.3, 0.5], atol=0.02)

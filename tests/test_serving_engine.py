@@ -1,0 +1,103 @@
+"""Continuous-batching serving engine over paged KV caches (VERDICT r2
+#9): N concurrent prompts decode correctly in one process from a SAVED
+artifact, with requests joining mid-flight and pages recycled.
+
+Reference capability: analysis_predictor.cc + the block_multi_head_attention
+serving kernels.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.serving import (PagedCausalLM,
+                                          PagedServingConfig,
+                                          ServingEngine, save_paged_model)
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    paddle.seed(42)
+    cfg = PagedServingConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                             num_heads=4, ffn_size=64, block_size=8,
+                             num_blocks=32, max_batch=3,
+                             max_blocks_per_seq=6, token_budget=32)
+    model = PagedCausalLM(cfg)
+    model.eval()
+    path = str(tmp_path_factory.mktemp("serving") / "paged_lm")
+    save_paged_model(path, model)
+    return path, cfg, model
+
+
+def _dense_greedy(model, prompt, n_new):
+    """Greedy reference decode via the stateless dense forward."""
+    ids = list(prompt)
+    for _ in range(n_new):
+        logits = model.forward_dense(
+            paddle.to_tensor(np.asarray([ids], np.int64))).numpy()
+        ids.append(int(np.argmax(logits[0, -1])))
+    return ids[len(prompt):]
+
+
+def test_concurrent_requests_match_dense_reference(artifact):
+    path, cfg, model = artifact
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, cfg.vocab_size, n))
+               for n in (5, 9, 3)]
+
+    engine = ServingEngine(path, cfg)
+    r0 = engine.add_request(prompts[0], max_new_tokens=6)
+    r1 = engine.add_request(prompts[1], max_new_tokens=4)
+    # run a couple of steps, then add a request MID-FLIGHT
+    engine.step()
+    engine.step()
+    r2 = engine.add_request(prompts[2], max_new_tokens=5)
+    outs = engine.run_to_completion()
+
+    refs = [_dense_greedy(model, p, n)
+            for p, n in zip(prompts, (6, 4, 5))]
+    assert outs[r0] == refs[0], (outs[r0], refs[0])
+    assert outs[r1] == refs[1], (outs[r1], refs[1])
+    assert outs[r2] == refs[2], (outs[r2], refs[2])
+
+
+def test_pages_recycled_across_many_requests(artifact):
+    path, cfg, model = artifact
+    engine = ServingEngine(path, cfg)
+    free0 = len(engine._free_pages)
+    rng = np.random.RandomState(1)
+    # more requests than the page pool could hold live at once
+    for wave in range(4):
+        rids = [engine.add_request(
+            list(rng.randint(1, cfg.vocab_size, 6)), max_new_tokens=3)
+            for _ in range(3)]
+        outs = engine.run_to_completion()
+        for rid in rids:
+            assert len(outs[rid]) == 3
+    assert len(engine._free_pages) == free0     # all pages returned
+
+
+def test_artifact_loads_in_fresh_engine(artifact):
+    """The engine consumes the serialized artifact only (no live model):
+    a second engine built from disk decodes identically."""
+    path, cfg, model = artifact
+    rng = np.random.RandomState(2)
+    prompt = list(rng.randint(1, cfg.vocab_size, 7))
+
+    e1 = ServingEngine(path, cfg)
+    rid1 = e1.add_request(prompt, max_new_tokens=5)
+    out1 = e1.run_to_completion()[rid1]
+
+    e2 = ServingEngine(path, cfg)
+    rid2 = e2.add_request(prompt, max_new_tokens=5)
+    out2 = e2.run_to_completion()[rid2]
+    assert out1 == out2 == _dense_greedy(model, prompt, 5)
+
+
+def test_budget_validation(artifact):
+    path, cfg, model = artifact
+    engine = ServingEngine(path, cfg)
+    with pytest.raises(ValueError):
+        engine.add_request(list(range(cfg.token_budget + 1)))
+    with pytest.raises(ValueError):
+        engine.add_request([1, 2, 3],
+                           max_new_tokens=cfg.max_seq)
