@@ -13,10 +13,11 @@ from ..core import amp_state
 from ..core.autograd import no_grad
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
+from . import debugging  # noqa: E402  (reference paddle.amp.debugging)
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "amp_decorate",
            "is_bfloat16_supported", "is_float16_supported", "white_list",
-           "black_list"]
+           "black_list", "debugging"]
 
 
 def is_bfloat16_supported(place=None):

@@ -1,0 +1,342 @@
+"""AMP debugging / accuracy tooling.
+
+Reference analog: python/paddle/amp/debugging.py — operator dtype stats,
+per-op tensor numeric checking with configurable severity, and an
+accuracy-compare tool over two run logs (the fp32-vs-low-precision
+debugging workflow that matters for bf16-first training).
+
+TPU-native shape: everything hangs off the dispatch funnel's observer
+hook (core/dispatch.op_observer) — one funnel sees every eager op, so no
+generated per-op hooks are needed. Stats force a device sync per op;
+these are debugging tools, not production paths.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from enum import Enum
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = [
+    "DebugMode",
+    "TensorCheckerConfig",
+    "check_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection",
+    "collect_operator_stats",
+    "enable_tensor_checker",
+    "disable_tensor_checker",
+    "compare_accuracy",
+    "check_layer_numerics",
+]
+
+
+class DebugMode(Enum):
+    """reference debugging.py DebugMode (the CUDA-only dump modes map to
+    the same stat collection here)."""
+
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+
+
+def _leaf_stats(a):
+    try:
+        arr = np.asarray(a)
+    except Exception:
+        return None
+    if arr.dtype.kind not in "fc" and arr.dtype.kind != "V":
+        return None
+    f = arr.astype(np.float64) if arr.dtype.kind != "V" else \
+        np.asarray(a, np.float32).astype(np.float64)
+    finite = np.isfinite(f)
+    return {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "num_nan": int(np.isnan(f).sum()),
+        "num_inf": int(np.isinf(f).sum()),
+        "min": float(f[finite].min()) if finite.any() else None,
+        "max": float(f[finite].max()) if finite.any() else None,
+        "mean": float(f[finite].mean()) if finite.any() else None,
+    }
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Per-tensor numeric check (reference check_numerics): returns
+    (num_nan, num_inf, num_zero) and raises/warns per debug_mode."""
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    f = arr.astype(np.float64) if arr.dtype.kind in "f" else \
+        arr.astype(np.float64, copy=False)
+    num_nan = int(np.isnan(f).sum())
+    num_inf = int(np.isinf(f).sum())
+    num_zero = int((f == 0).sum())
+    if num_nan or num_inf:
+        msg = (f"[check_numerics] op={op_type} var={var_name}: "
+               f"{num_nan} NaN, {num_inf} Inf "
+               f"(shape {list(arr.shape)}, dtype {arr.dtype})")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    from ..core.tensor import Tensor as T
+
+    return (T(np.asarray(num_nan)), T(np.asarray(num_inf)),
+            T(np.asarray(num_zero)))
+
+
+def check_layer_numerics(func):
+    """Decorator (reference check_layer_numerics): checks every tensor
+    output of a Layer forward."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        out = func(self, *args, **kwargs)
+        import jax
+
+        from ..core.tensor import Tensor
+
+        for leaf in jax.tree_util.tree_leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor)):
+            if isinstance(leaf, Tensor):
+                check_numerics(leaf, type(self).__name__, "output")
+        return out
+
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# operator dtype stats (reference enable_operator_stats_collection)
+# ---------------------------------------------------------------------------
+
+_op_stats: Optional[dict] = None
+
+
+def _dtype_bucket(dtype_str):
+    if "float16" in dtype_str and "b" not in dtype_str:
+        return "FP16"
+    if "bfloat16" in dtype_str:
+        return "BF16"
+    if "float32" in dtype_str:
+        return "FP32"
+    return "OTHERS"
+
+
+def _stats_observer(name, leaves):
+    buckets = _op_stats.setdefault(
+        name, {"FP16": 0, "BF16": 0, "FP32": 0, "OTHERS": 0})
+    seen = set()
+    for a in leaves:
+        d = str(getattr(a, "dtype", ""))
+        seen.add(_dtype_bucket(d) if d else "OTHERS")
+    for b in (seen or {"OTHERS"}):
+        buckets[b] += 1
+
+
+def enable_operator_stats_collection():
+    """Count executed ops per output dtype class until disabled; the
+    table prints on disable (reference _print_operator_stats)."""
+    global _op_stats
+    from ..core import dispatch
+
+    _op_stats = {}
+    dispatch.add_op_observer(_stats_observer)
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    from ..core import dispatch
+
+    dispatch.remove_op_observer(_stats_observer)
+    stats, _op_stats = _op_stats, None
+    if stats is None:
+        return
+    print("<" + "-" * 71 + ">")
+    print(f"{'Op Name':<40} {'FP16':>6} {'BF16':>6} {'FP32':>6} "
+          f"{'OTHERS':>7}")
+    for name in sorted(stats):
+        b = stats[name]
+        print(f"{name:<40} {b['FP16']:>6} {b['BF16']:>6} {b['FP32']:>6} "
+              f"{b['OTHERS']:>7}")
+    print("<" + "-" * 71 + ">")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+# ---------------------------------------------------------------------------
+# tensor checker (reference TensorCheckerConfig + enable_tensor_checker)
+# ---------------------------------------------------------------------------
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None,
+                 stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or ())
+        self.skipped_op_list = set(skipped_op_list or ())
+        self.debug_step = debug_step
+        self._log = None
+
+    def _want(self, op_name):
+        if self.checked_op_list and op_name not in self.checked_op_list:
+            return False
+        return op_name not in self.skipped_op_list
+
+
+_checker: Optional[TensorCheckerConfig] = None
+
+
+def _checker_observer(name, leaves):
+    cfg = _checker
+    if cfg is None or not cfg._want(name):
+        return
+    for i, a in enumerate(leaves):
+        st = _leaf_stats(a)
+        if st is None:
+            continue
+        rec = dict(st, op=name, output_index=i)
+        if cfg._log is not None:
+            cfg._log.write(json.dumps(rec) + "\n")
+            cfg._log.flush()
+        if st["num_nan"] or st["num_inf"]:
+            msg = (f"[tensor_checker] op [{name}] output {i} has "
+                   f"{st['num_nan']} NaN / {st['num_inf']} Inf "
+                   f"(shape {st['shape']}, dtype {st['dtype']})")
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            print("WARNING:", msg)
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Install the per-op output checker (reference
+    enable_tensor_checker). With output_dir set, every float output's
+    stats stream to <output_dir>/tensor_stats.jsonl — the run log
+    compare_accuracy consumes."""
+    global _checker
+    from ..core import dispatch
+
+    if not checker_config.enable:
+        return
+    _checker = checker_config
+    if checker_config.output_dir:
+        os.makedirs(checker_config.output_dir, exist_ok=True)
+        checker_config._log = open(
+            os.path.join(checker_config.output_dir,
+                         "tensor_stats.jsonl"), "w")
+    dispatch.add_op_observer(_checker_observer)
+
+
+def disable_tensor_checker():
+    global _checker
+    from ..core import dispatch
+
+    dispatch.remove_op_observer(_checker_observer)
+    if _checker is not None and _checker._log is not None:
+        _checker._log.close()
+        _checker._log = None
+    _checker = None
+
+
+# ---------------------------------------------------------------------------
+# accuracy compare (reference compare_accuracy)
+# ---------------------------------------------------------------------------
+
+def _load_stats(path):
+    fname = path if path.endswith(".jsonl") else \
+        os.path.join(path, "tensor_stats.jsonl")
+    recs = []
+    with open(fname) as f:
+        for line in f:
+            if line.strip():
+                recs.append(json.loads(line))
+    return recs
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    """Compare two tensor-checker run logs (e.g. an fp32 run vs a bf16
+    run of the same model) op-by-op and write a CSV report flagging
+    outputs whose statistics diverge or go non-finite (the reference
+    writes xlsx via an optional external package; the report content is
+    the same)."""
+    import csv
+
+    a = _load_stats(dump_path)
+    b = _load_stats(another_dump_path)
+    n = min(len(a), len(b))
+    rows = []
+    for i in range(n):
+        ra, rb = a[i], b[i]
+        flag = ""
+        if ra["op"] != rb["op"]:
+            flag = "op-mismatch"
+        elif (rb["num_nan"] or rb["num_inf"]) and not (
+                ra["num_nan"] or ra["num_inf"]):
+            flag = "nonfinite-in-run2"
+        elif (ra["num_nan"] or ra["num_inf"]) and not (
+                rb["num_nan"] or rb["num_inf"]):
+            flag = "nonfinite-in-run1"
+        elif ra["mean"] is not None and rb["mean"] is not None:
+            scale = max(abs(ra["mean"]), abs(rb["mean"]), 1e-9)
+            if abs(ra["mean"] - rb["mean"] * loss_scale) / scale > 0.1:
+                flag = "mean-divergence"
+        rows.append({
+            "index": i, "op": ra["op"],
+            "run1_dtype": ra["dtype"], "run2_dtype": rb["dtype"],
+            "run1_mean": ra["mean"], "run2_mean": rb["mean"],
+            "run1_max": ra["max"], "run2_max": rb["max"],
+            "run1_nan": ra["num_nan"], "run2_nan": rb["num_nan"],
+            "run1_inf": ra["num_inf"], "run2_inf": rb["num_inf"],
+            "flag": flag,
+        })
+    if len(a) != len(b):
+        # a shorter log usually means one run aborted (e.g. the checker
+        # fired on a NaN) — the report must say so, not look clean
+        longer, which = (a, "run1") if len(a) > len(b) else (b, "run2")
+        for j in range(n, len(longer)):
+            rows.append({
+                "index": j, "op": longer[j]["op"],
+                "run1_dtype": longer[j]["dtype"] if which == "run1"
+                else "", "run2_dtype": longer[j]["dtype"]
+                if which == "run2" else "",
+                "run1_mean": None, "run2_mean": None,
+                "run1_max": None, "run2_max": None,
+                "run1_nan": None, "run2_nan": None,
+                "run1_inf": None, "run2_inf": None,
+                "flag": f"missing-in-{'run2' if which == 'run1' else 'run1'}",
+            })
+    with open(output_filename, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys())
+                                if rows else ["index"])
+        writer.writeheader()
+        for r in rows:
+            writer.writerow(r)
+    return rows
+
+
+def set_checked_op_list(checked_op_list):
+    if _checker is not None:
+        _checker.checked_op_list |= set(checked_op_list or ())
+
+
+def set_skipped_op_list(skipped_op_list):
+    if _checker is not None:
+        _checker.skipped_op_list |= set(skipped_op_list or ())

@@ -316,6 +316,27 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
 
 _MISS = object()
 
+# observer hooks for debugging tooling (amp/debugging.py): every
+# completed op's (name, output leaves) is reported to each registered
+# observer — op dtype stats and tensor checkers are independent and may
+# be active simultaneously
+op_observers: list = []
+
+
+def add_op_observer(fn):
+    if fn not in op_observers:
+        op_observers.append(fn)
+
+
+def remove_op_observer(fn):
+    if fn in op_observers:
+        op_observers.remove(fn)
+
+
+def _observe(name, leaves):
+    for obs in op_observers:
+        obs(name, leaves)
+
 
 def _next_rng_inputs(rnd):
     """Fresh (key, counter) for a cached RNG op, honoring an active
@@ -420,6 +441,7 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
 
     if _flags.flag("check_nan_inf"):
         check_nan_inf(name, jax.tree.leaves(out))
+    _observe(name, jax.tree.leaves(out))
     return _wrap_outputs(out, node=None)
 
 
@@ -444,6 +466,7 @@ def _finish_record(fn, name, flat, treedef, diff_pos, out, vjp_fn):
 
     if _flags.flag("check_nan_inf"):
         check_nan_inf(name, out_flat)
+    _observe(name, out_flat)
     out_avals = [o.aval if isinstance(o, jax.Array)
                  else jax.ShapeDtypeStruct(np.shape(o), np.asarray(o).dtype)
                  for o in out_flat]
@@ -482,6 +505,7 @@ def _apply_legacy(fn, name, flat, treedef, diff_pos, record):
 
         if _flags.flag("check_nan_inf"):
             check_nan_inf(name, jax.tree.leaves(out))
+        _observe(name, jax.tree.leaves(out))
         wrapped = _wrap_outputs(out, node=None)
         if _ProgramRecorder.active is not None:
             tensor_pos = [i for i, x in enumerate(flat) if _is_tensor(x)]

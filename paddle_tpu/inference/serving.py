@@ -284,17 +284,45 @@ class ServingEngine:
         """One engine iteration: schedule <= max_batch live requests
         (prefill + decode mixed) within the token budget, run the
         artifact once, append one sampled token per scheduled row."""
+        import math
+
         cfg = self.cfg
-        rows = []
-        budget = cfg.token_budget
-        for r in self.pending():
-            if len(rows) == cfg.max_batch:
-                break
-            cost = len(r.prompt) if not r.prefilled else 1
-            if cost > budget:
-                continue
-            budget -= cost
-            rows.append(r)
+
+        def schedule():
+            rows = []
+            budget = cfg.token_budget
+            avail = len(self._free_pages)
+            for r in self.pending():
+                if len(rows) == cfg.max_batch:
+                    break
+                # a preempted request re-prefills its whole sequence
+                cost = r.length if not r.prefilled else 1
+                target_len = r.length
+                pages_needed = max(
+                    math.ceil(target_len / cfg.block_size) - len(r.pages),
+                    0)
+                if cost > budget or pages_needed > avail:
+                    continue  # defer: rerun once budget/pages free up
+                budget -= cost
+                avail -= pages_needed
+                rows.append(r)
+            return rows
+
+        rows = schedule()
+        if not rows and self.pending():
+            # pool deadlock: in-flight requests hold pages but none can
+            # grow — preempt the least-complete one (release its pages;
+            # it re-prefills prompt+generated later), vLLM-style
+            holders = [r for r in self.pending() if r.pages]
+            if not holders:
+                raise RuntimeError(
+                    "KV page pool exhausted: no pending request fits in "
+                    f"{len(self._free_pages)} free pages — raise "
+                    "num_blocks or lower concurrency")
+            victim = min(holders, key=lambda r: len(r.generated))
+            self._release(victim)
+            victim.prefilled = False
+            rows = schedule()
         if not rows:
             return []
 
@@ -306,10 +334,11 @@ class ServingEngine:
         packed = []
         for i, r in enumerate(rows):
             if not r.prefilled:
-                n = len(r.prompt)
+                seq = r.prompt + r.generated   # full redo after preempt
+                n = len(seq)
                 enc[i] = n
                 this[i] = n
-                packed_tokens = r.prompt
+                packed_tokens = seq
                 self._ensure_pages(r, n)
             else:
                 dec[i] = r.length - 1        # prefix length in cache

@@ -234,15 +234,16 @@ def pad(img, padding, fill=0, padding_mode="constant"):
     return np.pad(arr, pads, mode)
 
 
+def _rgb_to_gray(f):
+    """ITU-R 601 luma over the last (channel) axis of a float array."""
+    return 0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2]
+
+
 def to_grayscale(img, num_output_channels=1):
     arr = _as_img(img)
-    if arr.ndim == 2:
-        g = arr.astype(np.float32)
-    else:
-        g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
-             + 0.114 * arr[..., 2]).astype(np.float32)
-    g = np.repeat(_like(g, arr)[..., None], num_output_channels, -1)
-    return g
+    g = arr.astype(np.float32) if arr.ndim == 2 \
+        else _rgb_to_gray(arr.astype(np.float32))
+    return np.repeat(_like(g, arr)[..., None], num_output_channels, -1)
 
 
 def adjust_brightness(img, brightness_factor):
@@ -253,9 +254,7 @@ def adjust_brightness(img, brightness_factor):
 def adjust_contrast(img, contrast_factor):
     arr = _as_img(img)
     f = arr.astype(np.float32)
-    gray_mean = (0.299 * f[..., 0] + 0.587 * f[..., 1]
-                 + 0.114 * f[..., 2]).mean() if arr.ndim == 3 \
-        else f.mean()
+    gray_mean = _rgb_to_gray(f).mean() if arr.ndim == 3 else f.mean()
     return _like(f * contrast_factor
                  + (1 - contrast_factor) * gray_mean, arr)
 
@@ -263,8 +262,7 @@ def adjust_contrast(img, contrast_factor):
 def adjust_saturation(img, saturation_factor):
     arr = _as_img(img)
     f = arr.astype(np.float32)
-    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1]
-            + 0.114 * f[..., 2])[..., None]
+    gray = _rgb_to_gray(f)[..., None]
     return _like(f * saturation_factor
                  + (1 - saturation_factor) * gray, arr)
 
@@ -524,12 +522,16 @@ class RandomRotation(BaseTransform):
         if isinstance(degrees, numbers.Number):
             degrees = (-abs(degrees), abs(degrees))
         self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
         self.center = center
         self.fill = fill
 
     def _apply_image(self, img):
         angle = np.random.uniform(*self.degrees)
-        return rotate(img, angle, center=self.center, fill=self.fill)
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center,
+                      fill=self.fill)
 
 
 class RandomResizedCrop(BaseTransform):
@@ -613,7 +615,9 @@ class RandomAffine(BaseTransform):
         self.translate = translate
         self.scale = scale
         self.shear = shear
+        self.interpolation = interpolation
         self.fill = fill
+        self.center = center
 
     def _apply_image(self, img):
         arr = _as_img(img)
@@ -634,7 +638,8 @@ class RandomAffine(BaseTransform):
             shx = np.deg2rad(np.random.uniform(sh[0], sh[1]))
             if len(sh) == 4:
                 shy = np.deg2rad(np.random.uniform(sh[2], sh[3]))
-        cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        cx, cy = ((w - 1) / 2.0, (h - 1) / 2.0) if self.center is None \
+            else (self.center[0], self.center[1])
         cos, sin = np.cos(angle) * sc, np.sin(angle) * sc
         rot = np.array([[cos, -sin], [sin, cos]], np.float32)
         shear_m = np.array([[1, np.tan(shx)], [np.tan(shy), 1]],
@@ -645,7 +650,7 @@ class RandomAffine(BaseTransform):
              [m[1, 0], m[1, 1], cy - m[1, 0] * cx - m[1, 1] * cy + ty],
              [0, 0, 1]], np.float32)
         inv = np.linalg.inv(fwd)
-        return _inverse_warp(arr, inv, self.fill)
+        return _inverse_warp(arr, inv, self.fill, self.interpolation)
 
 
 class RandomPerspective(BaseTransform):
@@ -654,6 +659,7 @@ class RandomPerspective(BaseTransform):
         super().__init__(keys)
         self.prob = prob
         self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
         self.fill = fill
 
     def _apply_image(self, img):
@@ -674,7 +680,9 @@ class RandomPerspective(BaseTransform):
         start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
         end = [jitter(True, True), jitter(False, True),
                jitter(False, False), jitter(True, False)]
-        return perspective(arr, start, end, fill=self.fill)
+        return perspective(arr, start, end,
+                           interpolation=self.interpolation,
+                           fill=self.fill)
 
 
 __all__ += ["BaseTransform", "RandomVerticalFlip", "Pad", "Grayscale",

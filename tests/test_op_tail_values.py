@@ -521,8 +521,6 @@ def test_fill_diagonal_values():
     x = np.zeros((4, 4), np.float32)
     got = A(K("fill_diagonal")(x, 5.0))
     np.testing.assert_allclose(got, np.diag([5.] * 4))
-    y = np.arange(12, np.float32).reshape(3, 4) \
-        if False else np.arange(12, dtype=np.float32).reshape(3, 4)
     v = np.array([9., 9., 9.], np.float32)
     got2 = A(K("fill_diagonal_tensor")(np.zeros((3, 3), np.float32),
                                        v))

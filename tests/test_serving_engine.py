@@ -101,3 +101,18 @@ def test_budget_validation(artifact):
     with pytest.raises(ValueError):
         engine.add_request([1, 2, 3],
                            max_new_tokens=cfg.max_seq)
+
+
+def test_step_defers_requests_when_pool_tight(artifact):
+    """Review finding: a step that cannot page every pending request must
+    DEFER the overflow (serve it after pages free up), not crash."""
+    path, cfg, model = artifact
+    engine = ServingEngine(path, cfg)
+    # shrink the pool so only ~1 request's pages fit at a time
+    engine._free_pages = engine._free_pages[:2]
+    rng = np.random.RandomState(5)
+    rids = [engine.add_request(list(rng.randint(1, cfg.vocab_size, 8)),
+                               max_new_tokens=2) for _ in range(3)]
+    outs = engine.run_to_completion()
+    for rid in rids:
+        assert len(outs[rid]) == 2       # all served, sequentially
