@@ -30,6 +30,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._ddp = None
         self.stop_training = False
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -37,14 +38,29 @@ class Model:
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = _to_list(metrics)
+        # distributed-aware fit (reference DynamicGraphAdapter: under a
+        # multi-process launch the network trains through DataParallel —
+        # grads allreduce over the transport — while save/state_dict
+        # keep addressing the inner network). Wrap once: re-preparing
+        # (e.g. to swap optimizers) must not re-register grad hooks.
+        from .. import distributed as dist
+
+        if self._ddp is None and dist.is_initialized() \
+                and dist.get_world_size() > 1:
+            self._ddp = dist.parallel.DataParallel(self.network)
         return self
+
+    @property
+    def _train_network(self):
+        return self._ddp if self._ddp is not None else self.network
 
     # -- core steps ---------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
-        self.network.train()
+        net = self._train_network
+        net.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        outputs = self.network(*[self._t(x) for x in inputs])
+        outputs = net(*[self._t(x) for x in inputs])
         losses = self._compute_loss(outputs, labels)
         total = losses if isinstance(losses, Tensor) else sum(losses)
         total.backward()
@@ -101,12 +117,30 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
+        dist_sampler = None
         if not isinstance(train_data, DataLoader):
-            train_loader = DataLoader(train_data, batch_size=batch_size,
-                                      shuffle=shuffle, drop_last=drop_last,
-                                      num_workers=num_workers)
+            if self._ddp is not None:
+                # shard the dataset across ranks (reference fit uses
+                # DistributedBatchSampler under a parallel env)
+                from ..io import DistributedBatchSampler
+
+                dist_sampler = DistributedBatchSampler(
+                    train_data, batch_size=batch_size, shuffle=shuffle,
+                    drop_last=drop_last)
+                train_loader = DataLoader(train_data,
+                                          batch_sampler=dist_sampler,
+                                          num_workers=num_workers)
+            else:
+                train_loader = DataLoader(train_data,
+                                          batch_size=batch_size,
+                                          shuffle=shuffle,
+                                          drop_last=drop_last,
+                                          num_workers=num_workers)
         else:
             train_loader = train_data
+            dist_sampler = getattr(train_loader, "batch_sampler", None)
+            if not hasattr(dist_sampler, "set_epoch"):
+                dist_sampler = None
         eval_loader = None
         if eval_data is not None:
             eval_loader = eval_data if isinstance(eval_data, DataLoader) \
@@ -128,6 +162,9 @@ class Model:
                 break
             for m in self._metrics:
                 m.reset()
+            if dist_sampler is not None:
+                # fresh per-epoch shuffle order across ranks
+                dist_sampler.set_epoch(epoch)
             cbks.on_epoch_begin(epoch, {"steps": steps})
             logs = {}
             for step, batch in enumerate(train_loader):
