@@ -36,6 +36,19 @@ def peak_flops_per_chip():
     return 197e12  # conservative default
 
 
+def best_of(windows, run_window, sync):
+    """min wall-clock over `windows` runs of run_window() (each drained by
+    sync() before the clock stops) — tunnel-load immunity for every bench
+    row's timing."""
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        run_window()
+        sync()
+        dt = min(dt, time.perf_counter() - t0)
+    return dt
+
+
 def model_flops_per_token(cfg, n_params, seq):
     """Standard MFU accounting (PaLM appendix B): per-token train FLOPs =
     6N (fwd+bwd matmuls) + 12*L*h*s (attention scores+values, fwd+bwd)."""
@@ -78,11 +91,13 @@ def bench_resnet50(on_tpu):
 
     loss = call()
     jax.device_get(loss._value)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = call()
-    jax.device_get(loss._value)
-    dt = time.perf_counter() - t0
+
+    def window():
+        nonlocal loss
+        for _ in range(steps):
+            loss = call()
+
+    dt = best_of(2, window, lambda: jax.device_get(loss._value))
     return {"images_per_sec": round(batch * steps / dt, 1),
             "batch": batch, "image_size": size,
             "loss": float(jax.device_get(loss._value))}
@@ -133,11 +148,13 @@ def bench_bert(on_tpu):
         rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
     loss = step(ids, labels)
     jax.device_get(loss._value)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, labels)
-    jax.device_get(loss._value)
-    dt = time.perf_counter() - t0
+
+    def window():
+        nonlocal loss
+        for _ in range(steps):
+            loss = step(ids, labels)
+
+    dt = best_of(2, window, lambda: jax.device_get(loss._value))
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tps = batch * seq * steps / dt
     mfu = tps * model_flops_per_token(cfg, n_params, seq) \
@@ -179,11 +196,13 @@ def bench_sd_unet(on_tpu):
     step = to_static(lambda a, b, c: model(a, b, c))
     out = step(x, t, ctx)
     jax.device_get(out._value)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = step(x, t, ctx)
-    jax.device_get(out._value)
-    dt = time.perf_counter() - t0
+
+    def window():
+        nonlocal out
+        for _ in range(steps):
+            out = step(x, t, ctx)
+
+    dt = best_of(2, window, lambda: jax.device_get(out._value))
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     return {"denoise_steps_per_sec": round(steps / dt, 2),
             "latents_per_sec": round(batch * steps / dt, 2),
@@ -214,11 +233,13 @@ def bench_llama13b_block(on_tpu):
     blocks, opt, loss = jitted(blocks, opt, x)
     jax.device_get(loss)
     steps = 10 if on_tpu else 2
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        blocks, opt, loss = jitted(blocks, opt, x)
-    jax.device_get(loss)
-    dt = time.perf_counter() - t0
+
+    def window():
+        nonlocal blocks, opt, loss
+        for _ in range(steps):
+            blocks, opt, loss = jitted(blocks, opt, x)
+
+    dt = best_of(2, window, lambda: jax.device_get(loss))
     tok_s = batch * seq * steps / dt
     mfu = tok_s * (6 * n_blk + 12 * hidden * seq) / peak_flops_per_chip()
 
@@ -323,11 +344,12 @@ def main():
     loss = trainer.step(ids, labels)
     jax.device_get(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(ids, labels)
-    jax.device_get(loss)
-    dt = time.perf_counter() - t0
+    def window():
+        nonlocal loss
+        for _ in range(steps):
+            loss = trainer.step(ids, labels)
+
+    dt = best_of(2, window, lambda: jax.device_get(loss))
 
     tokens_per_sec = batch * seq * steps / dt
     flops_per_token = model_flops_per_token(cfg, n_params, seq)
