@@ -55,6 +55,13 @@ def _index_key(key: str, offset) -> str:
     return f"{key}@{','.join(str(int(o)) for o in offset)}"
 
 
+def _atomic_dump(obj, dest: str):
+    tmp = f"{dest}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f, protocol=4)
+    os.replace(tmp, dest)
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
     """Write per-process shard files + metadata manifest."""
@@ -92,8 +99,10 @@ def save_state_dict(state_dict, path, process_group=None,
             shards[_index_key(key, (0,) * arr.ndim)] = arr
         meta.state_dict_metadata[key] = metas
     shard_file = f"{rank}_0.distcp"
-    with open(os.path.join(path, shard_file), "wb") as f:
-        pickle.dump(shards, f, protocol=4)
+    # tmp + atomic rename: a worker killed mid-save (elastic re-formation
+    # SIGTERMs workers) must never leave a truncated shard/metadata file
+    # for the re-formed pod to load
+    _atomic_dump(shards, os.path.join(path, shard_file))
     for key, metas in meta.state_dict_metadata.items():
         for m in metas:
             meta.storage_metadata[_index_key(key, m.global_offset)] = \
@@ -111,8 +120,7 @@ def save_state_dict(state_dict, path, process_group=None,
             merged.storage_metadata.update(m.storage_metadata)
         meta = merged
     if rank == coordinator_rank:
-        with open(os.path.join(path, "0.metadata"), "wb") as f:
-            pickle.dump(meta, f, protocol=4)
+        _atomic_dump(meta, os.path.join(path, "0.metadata"))
 
 
 def _assemble(key: str, meta: Metadata, path: str,

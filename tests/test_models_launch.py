@@ -220,6 +220,112 @@ def test_launch_cli_dataparallel_grad_sync(tmp_path):
         w0, np.asarray(ref.weight.numpy()), rtol=1e-4, atol=1e-5)
 
 
+
+
+# -- shared fixtures for the elastic e2e tests -------------------------------
+
+_ELASTIC_WORKER = """\
+import os
+os.environ.setdefault('PADDLE_JAX_DISTRIBUTED', '0')
+import sys, time
+sys.path.insert(0, '/root/repo')
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.checkpoint import (save_state_dict,
+                                               load_state_dict)
+out = os.environ['OUT_DIR']
+rank = int(os.environ['PADDLE_TRAINER_ID'])
+world = int(os.environ['PADDLE_TRAINERS_NUM'])
+gen = os.environ.get('PADDLE_ELASTIC_GENERATION', '0')
+dist.init_parallel_env()
+paddle.seed(0)
+model = nn.Linear(4, 2)
+opt = paddle.optimizer.SGD(parameters=model.parameters(),
+                           learning_rate=0.05)
+ck = os.path.join(out, 'ckpt')
+step0 = 0
+if os.path.exists(os.path.join(ck, '0.metadata')):
+    sd = dict(model.state_dict())
+    sd['__step__'] = paddle.to_tensor(np.zeros((), np.int64))
+    load_state_dict(sd, ck)
+    model.set_state_dict({k: v for k, v in sd.items()
+                          if k != '__step__'})
+    step0 = int(np.asarray(sd['__step__'].numpy()))
+log = open(os.path.join(out, f'prog_g{gen}_r{rank}.txt'), 'w')
+log.write(f'start world={world} rank={rank} resume={step0}\\n')
+log.flush()
+rng = np.random.RandomState(1)
+x = rng.randn(8, 4).astype('float32')
+y = rng.randn(8, 2).astype('float32')
+for step in range(step0 + 1, TARGET + 1):
+    loss = nn.MSELoss()(model(paddle.to_tensor(x)), paddle.to_tensor(y))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    sd = dict(model.state_dict())
+    sd['__step__'] = paddle.to_tensor(np.asarray(step, np.int64))
+    save_state_dict(sd, ck)
+    log.write(f'step={step}\\n')
+    log.flush()
+    time.sleep(0.25)
+log.write('done\\n')
+log.flush()
+"""
+
+
+def _write_elastic_worker(tmp_path, target_steps):
+    worker = tmp_path / "elastic_worker.py"
+    worker.write_text(_ELASTIC_WORKER.replace("TARGET",
+                                              str(target_steps)))
+    return worker
+
+
+def _elastic_master_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _elastic_controller(tag, tmp_path, master_port, job_id, worker, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{master_port}",
+         "--nnodes", "1:2", "--elastic_ttl", "4", "--job_id", job_id,
+         "--log_dir", str(tmp_path / f"log_{tag}"), str(worker)],
+        env=env, start_new_session=True,
+        stdout=open(tmp_path / f"ctl_{tag}.out", "wb"),
+        stderr=subprocess.STDOUT)
+
+
+def _elastic_progress(tmp_path):
+    return {p.name: p.read_text()
+            for p in tmp_path.glob("prog_g*_r*.txt")}
+
+
+def _assert_controllers_alive(tmp_path, *controllers):
+    if all(c.poll() is not None for c in controllers):
+        raise AssertionError(
+            "controllers exited early: "
+            + (tmp_path / "ctl_a.out").read_text()[-800:])
+
+
+def _gen_world2_ranks(progress):
+    """{generation: set-of-ranks training at world=2 with >=2 steps}."""
+    out = {}
+    for name, text in progress.items():
+        if "world=2" in text and text.count("step=") >= 2:
+            gen, rank = name[len("prog_"):-len(".txt")].split("_r")
+            out.setdefault(gen, set()).add(rank)
+    return out
+
+
 def test_elastic_end_to_end_kill_reform_resume(tmp_path):
     """VERDICT r2 #6 — the full elastic loop (reference
     fleet/elastic/manager.py:124-277): two elastic nodes train and write
@@ -227,124 +333,55 @@ def test_elastic_end_to_end_kill_reform_resume(tmp_path):
     stale heartbeat, re-forms the pod with remapped ranks (world 2 -> 1),
     and training RESUMES from the distributed checkpoint to completion."""
     import signal
-    import socket
     import time
 
-    worker = tmp_path / "elastic_worker.py"
-    worker.write_text(
-        "import os\n"
-        "os.environ.setdefault('PADDLE_JAX_DISTRIBUTED', '0')\n"
-        "import sys, time\n"
-        "sys.path.insert(0, '/root/repo')\n"
-        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
-        "import numpy as np\n"
-        "import paddle_tpu as paddle\n"
-        "import paddle_tpu.nn as nn\n"
-        "import paddle_tpu.distributed as dist\n"
-        "from paddle_tpu.distributed.checkpoint import (save_state_dict,\n"
-        "                                               load_state_dict)\n"
-        "out = os.environ['OUT_DIR']\n"
-        "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
-        "world = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
-        "gen = os.environ.get('PADDLE_ELASTIC_GENERATION', '0')\n"
-        "dist.init_parallel_env()\n"
-        "paddle.seed(0)\n"
-        "model = nn.Linear(4, 2)\n"
-        "opt = paddle.optimizer.SGD(parameters=model.parameters(),\n"
-        "                           learning_rate=0.05)\n"
-        "ck = os.path.join(out, 'ckpt')\n"
-        "step0 = 0\n"
-        "if os.path.exists(os.path.join(ck, '0.metadata')):\n"
-        "    sd = dict(model.state_dict())\n"
-        "    sd['__step__'] = paddle.to_tensor(np.zeros((), np.int64))\n"
-        "    load_state_dict(sd, ck)\n"
-        "    model.set_state_dict({k: v for k, v in sd.items()\n"
-        "                          if k != '__step__'})\n"
-        "    step0 = int(np.asarray(sd['__step__'].numpy()))\n"
-        "log = open(os.path.join(out, f'prog_g{gen}_r{rank}.txt'), 'w')\n"
-        "log.write(f'start world={world} rank={rank} resume={step0}\\n')\n"
-        "log.flush()\n"
-        "rng = np.random.RandomState(1)\n"
-        "x = rng.randn(8, 4).astype('float32')\n"
-        "y = rng.randn(8, 2).astype('float32')\n"
-        "TARGET = 36\n"
-        "for step in range(step0 + 1, TARGET + 1):\n"
-        "    loss = nn.MSELoss()(model(paddle.to_tensor(x)),\n"
-        "                        paddle.to_tensor(y))\n"
-        "    loss.backward()\n"
-        "    opt.step()\n"
-        "    opt.clear_grad()\n"
-        "    sd = dict(model.state_dict())\n"
-        "    sd['__step__'] = paddle.to_tensor(np.asarray(step, np.int64))\n"
-        "    save_state_dict(sd, ck)\n"
-        "    log.write(f'step={step}\\n')\n"
-        "    log.flush()\n"
-        "    time.sleep(0.25)\n"
-        "log.write('done\\n')\n"
-        "log.flush()\n"
-    )
-
-    s = socket.socket()
-    s.bind(("", 0))
-    master_port = s.getsockname()[1]
-    s.close()
-
+    worker = _write_elastic_worker(tmp_path, target_steps=36)
+    master_port = _elastic_master_port()
     env = dict(os.environ)
     env["OUT_DIR"] = str(tmp_path)
-    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-
-    def controller(tag):
-        return subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.distributed.launch",
-             "--master", f"127.0.0.1:{master_port}",
-             "--nnodes", "1:2", "--elastic_ttl", "4",
-             "--job_id", "elastic_e2e",
-             "--log_dir", str(tmp_path / f"log_{tag}"), str(worker)],
-            env=env, start_new_session=True,
-            stdout=open(tmp_path / f"ctl_{tag}.out", "wb"),
-            stderr=subprocess.STDOUT)
-
-    ctl_a = controller("a")
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+    ctl_a = _elastic_controller("a", tmp_path, master_port, "elastic_e2e",
+                                worker, env)
     time.sleep(0.5)
-    ctl_b = controller("b")
-
-    def progress_files():
-        return {p.name: p.read_text()
-                for p in tmp_path.glob("prog_g*_r*.txt")}
-
-    # wait until both ranks of some generation are training at world=2
-    deadline = time.time() + 90
-    while time.time() < deadline:
-        files = progress_files()
-        two_world = [n for n, t in files.items()
-                     if "world=2" in t and t.count("step=") >= 2]
-        ranks = {n.rsplit("_r", 1)[1] for n in two_world}
-        if {"0.txt", "1.txt"} <= ranks:
-            break
-        if ctl_a.poll() is not None and ctl_b.poll() is not None:
+    ctl_b = _elastic_controller("b", tmp_path, master_port, "elastic_e2e",
+                                worker, env)
+    try:
+        # wait until some generation has BOTH ranks training at world=2
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if any(r >= {"0", "1"} for r in
+                   _gen_world2_ranks(_elastic_progress(tmp_path))
+                   .values()):
+                break
+            _assert_controllers_alive(tmp_path, ctl_a, ctl_b)
+            time.sleep(0.5)
+        else:
             raise AssertionError(
-                "controllers exited early: "
-                + (tmp_path / "ctl_a.out").read_text()[-800:])
-        time.sleep(0.5)
-    else:
-        raise AssertionError(f"2-node training never started: "
-                             f"{progress_files().keys()}")
+                f"2-node training never started: "
+                f"{_elastic_progress(tmp_path).keys()}")
 
-    # kill node B (controller + its worker process group) — the "node
-    # death" the reference elastic manager detects via lease expiry
-    os.killpg(os.getpgid(ctl_b.pid), signal.SIGKILL)
+        # kill node B (controller + worker process group) — the "node
+        # death" the reference elastic manager detects via lease expiry
+        os.killpg(os.getpgid(ctl_b.pid), signal.SIGKILL)
 
-    rc = ctl_a.wait(timeout=180)
-    assert rc == 0, (tmp_path / "ctl_a.out").read_text()[-1200:]
+        rc = ctl_a.wait(timeout=180)
+        assert rc == 0, (tmp_path / "ctl_a.out").read_text()[-1200:]
 
-    files = progress_files()
-    resumed = [t for t in files.values()
-               if "world=1 rank=0" in t and "done" in t]
-    assert resumed, f"no re-formed world=1 run completed: {files.keys()}"
-    final = resumed[-1]
-    resume_step = int(final.split("resume=")[1].split("\n")[0])
-    assert resume_step > 0, \
-        "re-formed run did not resume from the distributed checkpoint"
+        files = _elastic_progress(tmp_path)
+        resumed = [t for t in files.values()
+                   if "world=1 rank=0" in t and "done" in t]
+        assert resumed, f"no re-formed world=1 run completed: "                         f"{files.keys()}"
+        final = resumed[-1]
+        resume_step = int(final.split("resume=")[1].split("\n")[0])
+        assert resume_step > 0, \
+            "re-formed run did not resume from the distributed checkpoint"
+    finally:
+        for c in (ctl_a, ctl_b):
+            try:
+                os.killpg(os.getpgid(c.pid), signal.SIGKILL)
+            except ProcessLookupError:
+                pass
 
 
 def test_hapi_fit_distributed_aware(tmp_path):
@@ -421,3 +458,66 @@ def test_hapi_fit_distributed_aware(tmp_path):
     m.fit(DS(), epochs=3, batch_size=8, shuffle=False, verbose=0)
     w_ref = np.asarray(dict(net.state_dict())["weight"].numpy())
     np.testing.assert_allclose(w0, w_ref, atol=1e-4)
+
+
+def test_elastic_scale_out_node_joins(tmp_path):
+    """Scale-OUT direction of the elastic loop: a single-node elastic job
+    is joined by a second node mid-run; the pod re-forms at world=2 with
+    both ranks of ONE generation training (resumed from the distributed
+    checkpoint)."""
+    import signal
+    import time
+
+    worker = _write_elastic_worker(tmp_path, target_steps=40)
+    master_port = _elastic_master_port()
+    env = dict(os.environ)
+    env["OUT_DIR"] = str(tmp_path)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+    ctl_a = _elastic_controller("a", tmp_path, master_port,
+                                "scaleout_e2e", worker, env)
+    ctl_b = None
+    try:
+        # wait until node A trains ALONE at world=1
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if any("world=1" in t and t.count("step=") >= 2
+                   for t in _elastic_progress(tmp_path).values()):
+                break
+            _assert_controllers_alive(tmp_path, ctl_a)
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"solo phase never started: "
+                f"{_elastic_progress(tmp_path)}")
+
+        ctl_b = _elastic_controller("b", tmp_path, master_port,
+                                    "scaleout_e2e", worker, env)
+        # expect ONE re-formed generation training at world=2 on both
+        # ranks
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if any(r >= {"0", "1"} for r in
+                   _gen_world2_ranks(_elastic_progress(tmp_path))
+                   .values()):
+                break
+            _assert_controllers_alive(tmp_path, ctl_a, ctl_b)
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                f"scale-out never happened: "
+                f"{_elastic_progress(tmp_path)}")
+
+        # the re-formed run resumed from the checkpoint, not step 0
+        resumed = [t for t in _elastic_progress(tmp_path).values()
+                   if "world=2" in t and "resume=" in t]
+        assert any(int(t.split("resume=")[1].split("\n")[0]) > 0
+                   for t in resumed), resumed
+    finally:
+        for c in (ctl_a, ctl_b):
+            if c is None:
+                continue
+            try:
+                os.killpg(os.getpgid(c.pid), signal.SIGTERM)
+            except ProcessLookupError:
+                pass
