@@ -505,13 +505,16 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                               quant_min_bound=-127.0, out_scale=-1,
                               compute_dtype="default"):
     """Paged-KV-cache attention (reference block_multihead_attention):
-    qkv [token_num, 3*H*D] packs each batch row's tokens this step
+    qkv [token_num, (HQ+2*HKV)*D] packs each batch row's tokens this step
     (prefill rows contribute seq_lens_encoder[b] tokens at positions
-    0..n-1; decode rows one token at position seq_lens_decoder[b]);
-    key_cache/value_cache [num_blocks, H, block_size, D] are page pools
-    indexed by block_tables [B, max_blocks]. New k/v are scattered into
-    their pages, then each token attends its row's filled prefix
-    (causal). Returns (out [token_num, H*D], qkv, key_cache, value_cache).
+    0..n-1; decode/chunk rows seq_lens_this_time[b] tokens starting at
+    position seq_lens_decoder[b]); key_cache/value_cache
+    [num_blocks, HKV, block_size, D] are page pools indexed by
+    block_tables [B, max_blocks]. HKV may divide HQ (GQA — the reference
+    kernel's kv_num_heads path, block_multi_head_attention.cu). New k/v
+    are scattered into their pages, then each token attends its row's
+    filled prefix (causal). Returns
+    (out [token_num, HQ*D], qkv, key_cache, value_cache).
     int8 cache quant and pre_caches are CUDA-path-only (must be None)."""
     if cache_k_quant_scales is not None or use_dynamic_cachekv_quant:
         raise NotImplementedError("block_multihead_attention: int8 cache "
@@ -528,13 +531,15 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         b = next(it) if qkv_bias is not None else None
         rope = next(it) if rope_emb is not None else None
         T = qkva.shape[0]
-        num_blocks, H, bs, D = kc.shape
+        num_blocks, HKV, bs, D = kc.shape
         B, max_blocks = bt.shape
         max_seq = max_blocks * bs
         if b is not None:
             qkva = qkva + b.reshape(1, -1)
-        qkv3 = qkva.reshape(T, 3, H, D)
-        q, k, v = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]        # [T, H, D]
+        HQ = qkva.shape[1] // D - 2 * HKV                    # GQA: HQ >= HKV
+        q = qkva[:, :HQ * D].reshape(T, HQ, D)
+        k = qkva[:, HQ * D:(HQ + HKV) * D].reshape(T, HKV, D)
+        v = qkva[:, (HQ + HKV) * D:].reshape(T, HKV, D)
         # token -> (batch, position)
         tok = jnp.arange(T)
         t2b = jnp.searchsorted(cu_q[1:], tok, side="right")  # [T]
@@ -564,7 +569,10 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                                   t2 * cos_h + t1 * sin_h],
                                  axis=-1).reshape(t.shape)
 
-            q, k = rope_t(q), rope_t(k)
+            # rope promotes to the f32 angle dtype; restore the compute
+            # dtype so the page scatter below matches the cache dtype
+            q = rope_t(q).astype(qkva.dtype)
+            k = rope_t(k).astype(qkva.dtype)
         # scatter new k/v into pages
         page = bt[t2b, pos // bs]                            # [T]
         slot = pos % bs
@@ -575,17 +583,19 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         page_of = bt[:, seqpos // bs]                        # [B, max_seq]
         kd = kc[page_of, :, seqpos[None, :] % bs, :]         # [B, S, H, D]
         vd = vc[page_of, :, seqpos[None, :] % bs, :]
-        kd = jnp.swapaxes(kd, 1, 2)                          # [B, H, S, D]
+        kd = jnp.swapaxes(kd, 1, 2)                          # [B, HKV, S, D]
         vd = jnp.swapaxes(vd, 1, 2)
-        logits = jnp.einsum("thd,thsd->ths", q.astype(jnp.float32),
+        G = HQ // HKV
+        qg = q.reshape(T, HKV, G, D)
+        logits = jnp.einsum("tkgd,tksd->tkgs", qg.astype(jnp.float32),
                             kd[t2b].astype(jnp.float32)) \
             / jnp.sqrt(jnp.float32(D))
         valid = seqpos[None, :] <= pos[:, None]              # [T, S]
-        logits = jnp.where(valid[:, None, :], logits, -jnp.inf)
+        logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("ths,thsd->thd", probs,
+        out = jnp.einsum("tkgs,tksd->tkgd", probs,
                          vd[t2b].astype(jnp.float32)).astype(qkva.dtype)
-        return out.reshape(T, H * D), qkva, kc, vc
+        return out.reshape(T, HQ * D), qkva, kc, vc
 
     args = [qkv, key_cache, value_cache, seq_lens_encoder,
             seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,

@@ -1,23 +1,35 @@
 """Continuous-batching serving engine over the paged-KV cache.
 
-Reference analog: the Paddle Inference serving engine
+Reference analog: the Paddle Inference serving stack
 (paddle/fluid/inference/api/analysis_predictor.cc) driving the
 block-attention serving kernels
-(paddle/phi/kernels/fusion/gpu/block_multi_head_attention*): N concurrent
-requests share one decoder executable; each engine step packs a mixed
-batch of prefill and decode tokens, attends against paged KV blocks
-addressed by per-request block tables, and requests join/leave the batch
-at any step (continuous batching).
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention.cu): N
+concurrent requests share one decoder executable; each engine step packs
+a mixed batch of prefill and decode tokens, attends against paged KV
+blocks addressed by per-request block tables, and requests join/leave
+the batch at any step (continuous batching).
 
-TPU-native shape: the WHOLE step function — embedding, L decoder layers
-with `block_multihead_attention`, head — is one exported executable with
-static shapes (token budget, max batch, fixed page pool), saved/loaded
-through the `save_inference_model` artifact. The host side
-(`ServingEngine`) is only a scheduler: page allocator + request queue +
-argmax sampling. Padding tokens are routed to a reserved trash page so
-the static token budget never corrupts live cache pages.
+TPU-native shape: the WHOLE step function — embedding, L llama-style
+decoder layers (RMSNorm, GQA `block_multihead_attention`, swiglu) and
+the LM head — is one executable with static shapes (token budget, max
+batch, fixed page pool), either exported through the
+`save_inference_model` artifact or jitted directly from a live model
+(`ServingEngine.from_model`). The host side (`ServingEngine`) is only a
+scheduler: page allocator + request queue + chunked prefill. Sampling
+(greedy / temperature / top-k / top-p) runs ON DEVICE with
+schedule-independent RNG salts, so paged-engine generations reproduce
+the dense reference path token-for-token under the same seed. Padding
+tokens are routed to a reserved trash page so the static token budget
+never corrupts live cache pages.
+
+Per-step host work is O(batch); `decode_run` additionally amortises the
+host round-trip over many decode steps (tokens are fed device-to-device
+between steps, one sync per window) — the multi-step scheduling trick
+production engines use, essential over high-latency links.
 """
 from __future__ import annotations
+
+import math
 
 import numpy as np
 
@@ -28,17 +40,21 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..core.dispatch import apply
 
-__all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine"]
+__all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
+           "SamplingParams", "save_paged_model", "sampling_salt",
+           "sample_logits"]
 
 
 class PagedServingConfig:
     def __init__(self, vocab_size=256, hidden_size=64, num_layers=2,
                  num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
-                 max_batch=4, max_blocks_per_seq=8, token_budget=64):
+                 max_batch=4, max_blocks_per_seq=8, token_budget=64,
+                 num_kv_heads=None, dtype="float32"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
         self.head_dim = hidden_size // num_heads
         self.ffn_size = ffn_size
         self.block_size = block_size
@@ -46,36 +62,176 @@ class PagedServingConfig:
         self.max_batch = max_batch
         self.max_blocks_per_seq = max_blocks_per_seq
         self.token_budget = token_budget
+        self.dtype = dtype
         self.max_seq = max_blocks_per_seq * block_size
+
+    @classmethod
+    def llama_1b(cls, **over):
+        """Flagship serving dims: the ~0.9B llama config bench.py trains
+        (hidden 2048, 16 layers), GQA 16q/8kv, bf16 cache."""
+        base = dict(vocab_size=32000, hidden_size=2048, num_layers=16,
+                    num_heads=16, num_kv_heads=8, ffn_size=5632,
+                    block_size=32, num_blocks=64, max_batch=8,
+                    max_blocks_per_seq=6, token_budget=256,
+                    dtype="bfloat16")
+        base.update(over)
+        return cls(**base)
+
+
+class SamplingParams:
+    """Per-request decode sampling. temperature<=0 means greedy (argmax);
+    top_k<=0 and top_p>=1 disable those filters. Reference analog: the
+    sampling layers of the fused-generation serving path
+    (paddle/phi/kernels/fusion/gpu — top_p_sampling kernels)."""
+
+    def __init__(self, temperature=0.0, top_k=0, top_p=1.0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+
+
+GREEDY = SamplingParams()
+
+
+def sampling_salt(seed, rid, n_generated):
+    """Schedule-independent RNG salt for one sampled token: depends only
+    on (engine seed, request id, index of the token being sampled), so
+    chunked prefill, preemption, batching order and the dense reference
+    path all draw identical randomness."""
+    return (seed * 1000003 + rid * 65537 + n_generated) & 0x7FFFFFFF
+
+
+def _sample_core(logits, temps, topks, topps, salts):
+    """Batched device-side sampling: greedy when temp<=0, else
+    gumbel-argmax over temperature-scaled logits restricted to the
+    top-k/top-p support. Gumbel noise is indexed by TOKEN ID (not sorted
+    rank) so near-tie sort-order differences between two numerically
+    close logit sources cannot change the draw."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    base = jax.random.key(0)
+
+    def row(lg, t, k, p, s):
+        greedy = jnp.argmax(lg)
+        lt = lg / jnp.maximum(t, 1e-6)
+        order = jnp.argsort(-lt)
+        sl = lt[order]
+        ranks = jnp.arange(V)
+        keep = jnp.where(k > 0, ranks < k, True)
+        pr = jax.nn.softmax(jnp.where(keep, sl, -jnp.inf))
+        keep = keep & ((jnp.cumsum(pr) - pr) < p)   # excl-cumsum keeps >=1
+        keep_tok = jnp.zeros((V,), bool).at[order].set(keep)
+        g = jax.random.gumbel(jax.random.fold_in(base, s), (V,),
+                              jnp.float32)
+        sampled = jnp.argmax(jnp.where(keep_tok, lt, -jnp.inf) + g)
+        return jnp.where(t <= 0.0, greedy, sampled).astype(jnp.int32)
+
+    return jax.vmap(row)(logits, temps.astype(jnp.float32),
+                         topks.astype(jnp.int32),
+                         topps.astype(jnp.float32),
+                         salts.astype(jnp.int32))
+
+
+_TOPK_FAST_C = 128
+
+
+def _sample_topk_core(logits, temps, topks, topps, salts):
+    """Fast sampler for the common serving regime: every sampling row has
+    0 < top_k <= _TOPK_FAST_C. `lax.top_k` over C candidates replaces the
+    full-vocab sort (the 32k-sort dominates a bf16 decode step on TPU).
+    EXACT vs `_sample_core`: the top-p filter is applied inside the top-k
+    support (so the kept set is identical for k <= C), candidate values
+    equal the sorted values, and gumbel noise is keyed by TOKEN ID, so
+    the argmax winner is the same token."""
+    logits = logits.astype(jnp.float32)
+    V = logits.shape[-1]
+    C = min(_TOPK_FAST_C, V)       # C == V degenerates to the full set
+    base = jax.random.key(0)
+
+    def row(lg, t, k, p, s):
+        greedy = jnp.argmax(lg)
+        lt = lg / jnp.maximum(t, 1e-6)
+        vals, idx = jax.lax.top_k(lt, C)                 # ties: low index
+        keep = jnp.arange(C) < k
+        pr = jax.nn.softmax(jnp.where(keep, vals, -jnp.inf))
+        keep = keep & ((jnp.cumsum(pr) - pr) < p)
+        g = jax.random.gumbel(jax.random.fold_in(base, s), (V,),
+                              jnp.float32)
+        win = jnp.argmax(jnp.where(keep, vals, -jnp.inf) + g[idx])
+        return jnp.where(t <= 0.0, greedy, idx[win]).astype(jnp.int32)
+
+    return jax.vmap(row)(logits, temps.astype(jnp.float32),
+                         topks.astype(jnp.int32),
+                         topps.astype(jnp.float32),
+                         salts.astype(jnp.int32))
+
+
+def _topk_fast_ok(temps, topks):
+    """True when every sampling row is within the exact top-k fast path."""
+    sampling = temps > 0
+    return bool(np.all(~sampling | ((topks > 0)
+                                    & (topks <= _TOPK_FAST_C))))
+
+
+_greedy_tokens_dev = jax.jit(
+    lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32))
+_sample_tokens_dev = jax.jit(_sample_core)
+_sample_topk_dev = jax.jit(_sample_topk_core)
+
+
+def sample_logits(logits, sampling: SamplingParams, salt: int) -> int:
+    """Sample one token from a single logits vector with the engine's
+    exact sampler — the reference-path helper for parity tests."""
+    out = _sample_tokens_dev(
+        jnp.asarray(logits)[None], jnp.asarray([sampling.temperature]),
+        jnp.asarray([sampling.top_k]), jnp.asarray([sampling.top_p]),
+        jnp.asarray([salt]))
+    return int(np.asarray(out)[0])
 
 
 class PagedCausalLM(Layer):
-    """A small causal LM whose serving forward runs entirely on paged KV
-    caches via block_multihead_attention. `forward` is the exported step
-    function; `forward_dense` is the stateless reference path over the
-    SAME weights (used to validate engine generations)."""
+    """A llama-architecture causal LM (RMSNorm → GQA attention → swiglu
+    MLP, untied LM head, no biases — models/llama.py at serving time)
+    whose serving forward runs entirely on paged KV caches via
+    block_multihead_attention. `forward` is the exported step function;
+    `forward_dense` is the stateless reference path over the SAME weights
+    (used to validate engine generations)."""
 
     def __init__(self, cfg: PagedServingConfig):
         super().__init__()
         from .. import nn
 
         self.cfg = cfg
-        h, f = cfg.hidden_size, cfg.ffn_size
+        h, f, D = cfg.hidden_size, cfg.ffn_size, cfg.head_dim
+        kvw = cfg.num_kv_heads * D
         self.embed = nn.Embedding(cfg.vocab_size, h)
-        self.ln1 = nn.LayerList([nn.LayerNorm(h)
+        self.ln1 = nn.LayerList([nn.RMSNorm(h)
                                  for _ in range(cfg.num_layers)])
-        self.qkv = nn.LayerList([nn.Linear(h, 3 * h)
+        self.qkv = nn.LayerList([nn.Linear(h, h + 2 * kvw,
+                                           bias_attr=False)
                                  for _ in range(cfg.num_layers)])
-        self.proj = nn.LayerList([nn.Linear(h, h)
+        self.proj = nn.LayerList([nn.Linear(h, h, bias_attr=False)
                                   for _ in range(cfg.num_layers)])
-        self.ln2 = nn.LayerList([nn.LayerNorm(h)
+        self.ln2 = nn.LayerList([nn.RMSNorm(h)
                                  for _ in range(cfg.num_layers)])
-        self.fc1 = nn.LayerList([nn.Linear(h, f)
-                                 for _ in range(cfg.num_layers)])
-        self.fc2 = nn.LayerList([nn.Linear(f, h)
-                                 for _ in range(cfg.num_layers)])
-        self.ln_f = nn.LayerNorm(h)
-        self.head = nn.Linear(h, cfg.vocab_size)
+        self.gate_up = nn.LayerList([nn.Linear(h, 2 * f, bias_attr=False)
+                                     for _ in range(cfg.num_layers)])
+        self.down = nn.LayerList([nn.Linear(f, h, bias_attr=False)
+                                  for _ in range(cfg.num_layers)])
+        self.ln_f = nn.RMSNorm(h)
+        self.head = nn.Linear(h, cfg.vocab_size, bias_attr=False)
+
+    def _mlp(self, li, h):
+        from ..incubate.nn.functional import swiglu
+
+        gu = self.gate_up[li](h)
+        half = self.cfg.ffn_size
+
+        def split(a):
+            return a[..., :half], a[..., half:]
+
+        g, u = apply(split, gu, op_name="split_gate_up")
+        return self.down[li](swiglu(g, u))
 
     # -- rope table shared by both paths ---------------------------------
     def _rope_table(self, positions):
@@ -92,11 +248,12 @@ class PagedCausalLM(Layer):
                 key_caches, value_caches):
         """One engine step.
 
-        tokens [T] int32 packed (prefill rows contribute their whole
-        prompt, decode rows one token; padding routed to the trash row);
+        tokens [T] int32 packed (each scheduled row contributes its
+        chunk of seq_lens_this_time[b] tokens starting at cache position
+        seq_lens_decoder[b]; padding routed to the trash row);
         seq_lens_* [B+1] (last row is the padding row); cu_seqlens_q
         [B+2]; block_tables [B+1, max_blocks]; key/value_caches
-        [L, num_blocks, H, bs, D]. Returns (last-token logits [B+1, V],
+        [L, num_blocks, HKV, bs, D]. Returns (last-token logits [B+1, V],
         new key_caches, new value_caches).
         """
         from ..incubate.nn import functional as IF
@@ -118,7 +275,7 @@ class PagedCausalLM(Layer):
         new_kc, new_vc = [], []
         for li in range(cfg.num_layers):
             h = self.ln1[li](x)
-            qkv = self.qkv[li](h)                            # [T, 3H]
+            qkv = self.qkv[li](h)                      # [T, (HQ+2HKV)*D]
             out, _, kc, vc = IF.block_multihead_attention(
                 qkv, key_caches[li], value_caches[li],
                 seq_lens_encoder, seq_lens_decoder,
@@ -129,9 +286,7 @@ class PagedCausalLM(Layer):
             new_vc.append(vc)
             x = x + self.proj[li](out)
             h = self.ln2[li](x)
-            from .. import nn
-
-            x = x + self.fc2[li](nn.functional.gelu(self.fc1[li](h)))
+            x = x + self._mlp(li, h)
         x = self.ln_f(x)
         # last token of each row: cu_q[i+1]-1 (rows with 0 tokens this
         # step read their previous row's last token — masked host-side)
@@ -145,11 +300,8 @@ class PagedCausalLM(Layer):
 
     # -- stateless dense reference over the same weights -----------------
     def forward_dense(self, input_ids):
-        """input_ids [1, S] -> logits [1, S, V] with standard causal
+        """input_ids [1, S] -> logits [1, S, V] with standard causal GQA
         attention; numerically the reference for the paged path."""
-        from .. import nn
-        from ..incubate.nn import functional as IF
-
         cfg = self.cfg
         ids = input_ids.reshape([-1])
         S = ids.shape[0]
@@ -157,20 +309,26 @@ class PagedCausalLM(Layer):
 
         def attn_dense(qkva):
             T = qkva.shape[0]
-            H, D = cfg.num_heads, cfg.head_dim
-            qkv3 = qkva.reshape(T, 3, H, D)
-            q, k, v = qkv3[:, 0], qkv3[:, 1], qkv3[:, 2]
+            HQ, HKV, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+            q = qkva[:, :HQ * D].reshape(T, HQ, D)
+            k = qkva[:, HQ * D:(HQ + HKV) * D].reshape(T, HKV, D)
+            v = qkva[:, (HQ + HKV) * D:].reshape(T, HKV, D)
             cos, sin = self._rope_table(jnp.arange(T))       # [T, D/2]
-            cos_h = cos[:, None, :]
-            sin_h = sin[:, None, :]
+            cos_h = cos[:, None, :].astype(jnp.float32)
+            sin_h = sin[:, None, :].astype(jnp.float32)
 
             def rope_t(t):
-                t1, t2 = t[..., 0::2], t[..., 1::2]
+                td = t.astype(jnp.float32)
+                t1, t2 = td[..., 0::2], td[..., 1::2]
                 return jnp.stack([t1 * cos_h - t2 * sin_h,
                                   t2 * cos_h + t1 * sin_h],
-                                 axis=-1).reshape(t.shape)
+                                 axis=-1).reshape(t.shape).astype(t.dtype)
 
             q, k = rope_t(q), rope_t(k)
+            if HQ != HKV:
+                rep = HQ // HKV
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
             logits = jnp.einsum("thd,shd->ths", q.astype(jnp.float32),
                                 k.astype(jnp.float32)) \
                 / jnp.sqrt(jnp.float32(D))
@@ -179,7 +337,7 @@ class PagedCausalLM(Layer):
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("ths,shd->thd", probs,
                              v.astype(jnp.float32)).astype(qkva.dtype)
-            return out.reshape(T, H * D)
+            return out.reshape(T, HQ * D)
 
         for li in range(cfg.num_layers):
             h = self.ln1[li](x)
@@ -187,7 +345,7 @@ class PagedCausalLM(Layer):
             out = apply(attn_dense, qkv, op_name="dense_ref_attn")
             x = x + self.proj[li](out)
             h = self.ln2[li](x)
-            x = x + self.fc2[li](nn.functional.gelu(self.fc1[li](h)))
+            x = x + self._mlp(li, h)
         x = self.ln_f(x)
         return self.head(x).reshape([1, S, cfg.vocab_size])
 
@@ -198,16 +356,18 @@ def _stack(tensors):
 
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "max_new", "pages",
-                 "prefilled", "done")
+                 "cached", "done", "sampling", "eos_token_id")
 
-    def __init__(self, rid, prompt, max_new):
+    def __init__(self, rid, prompt, max_new, sampling, eos_token_id):
         self.rid = rid
         self.prompt = list(int(t) for t in prompt)
         self.generated = []
         self.max_new = max_new
         self.pages = []
-        self.prefilled = False
+        self.cached = 0        # tokens whose KV currently lives in pages
         self.done = False
+        self.sampling = sampling or GREEDY
+        self.eos_token_id = eos_token_id
 
     @property
     def length(self):
@@ -215,58 +375,108 @@ class _Request:
 
 
 class ServingEngine:
-    """Continuous-batching scheduler over a saved PagedCausalLM artifact.
+    """Continuous-batching scheduler over a PagedCausalLM step function.
 
     engine = ServingEngine(path_prefix, cfg)      # loads the artifact
-    rid = engine.add_request([tokens...], max_new_tokens=8)
-    engine.step()                                  # one mixed batch step
+    engine = ServingEngine.from_model(model, cfg) # or jit a live model
+    rid = engine.add_request([tokens...], max_new_tokens=8,
+                             sampling=SamplingParams(temperature=0.8,
+                                                     top_k=50, top_p=0.9))
+    engine.step()                # one mixed prefill/decode batch step
+    engine.decode_run(16)        # 16 decode steps, ONE host sync
     engine.run_to_completion() -> {rid: [generated tokens]}
-    Requests may be added between steps (continuous batching); finished
-    requests release their cache pages.
+    Requests may be added between steps (continuous batching); prompts
+    longer than the token budget prefill in chunks; finished requests
+    release their cache pages.
     """
 
-    def __init__(self, path_prefix: str, cfg: PagedServingConfig,
-                 device=None):
-        from . import load_inference_model
+    def __init__(self, path_prefix: str = None,
+                 cfg: PagedServingConfig = None, device=None, seed=0):
+        if path_prefix is not None:
+            from . import load_inference_model
 
-        ex, params, buffers, sig = load_inference_model(path_prefix)
-        self._exported = ex
-        self._params = params
-        self._buffers = buffers
+            ex, params, buffers, sig = load_inference_model(path_prefix)
+            # stage weights into HBM once — calls must not re-transfer
+            self._params = jax.device_put(params)
+            self._buffers = jax.device_put(buffers)
+            self._compiled = jax.jit(
+                lambda p, b, *ins: ex.call(p, b, *ins))
+            # the exported module has a FIXED token length; jit-based
+            # engines (from_model) may feed shorter decode batches
+            self._fixed_token_len = cfg.token_budget
+        else:
+            self._fixed_token_len = None
+        self.seed = seed
         self.cfg = cfg
         L = cfg.num_layers
-        shape = (L, cfg.num_blocks, cfg.num_heads, cfg.block_size,
+        shape = (L, cfg.num_blocks, cfg.num_kv_heads, cfg.block_size,
                  cfg.head_dim)
-        self._kc = jnp.zeros(shape, jnp.float32)
-        self._vc = jnp.zeros(shape, jnp.float32)
+        cache_dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self._cache_dt = cache_dt
+        self._kc = jnp.zeros(shape, cache_dt)
+        self._vc = jnp.zeros(shape, cache_dt)
         # page 0 is the trash page for padding tokens
         self._free_pages = list(range(1, cfg.num_blocks))
         self._requests = {}
-        self._active = []
         self._next_rid = 0
-        self._compiled = jax.jit(
-            lambda p, b, *ins: self._exported.call(p, b, *ins))
+        self._window_fns = {}
+
+    @classmethod
+    def from_model(cls, model: PagedCausalLM, cfg: PagedServingConfig,
+                   seed=0):
+        """Build an engine directly over a live model (no disk artifact):
+        the step function is jitted from the layer's functional form, with
+        floating params cast to cfg.dtype (bf16 serving regime). The
+        compiled step and staged weights are cached on the model, so
+        several engines over the same model share one executable and one
+        HBM weight copy (weights are snapshotted at the first call)."""
+        from ..jit import functional as FB
+
+        eng = cls(None, cfg, seed=seed)
+        cached = getattr(model, "_serving_shared", None)
+        if cached is not None and cached[0] == cfg.dtype:
+            _, eng._compiled, eng._params, eng._buffers = cached
+            return eng
+        params = FB.current_params(model)
+        buffers = FB.current_buffers(model)
+        tgt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        cast = jax.tree_util.tree_map(
+            lambda a: a.astype(tgt)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+            params)
+        flat_p, tree_p = jax.tree_util.tree_flatten(cast)
+        flat_b, tree_b = jax.tree_util.tree_flatten(buffers)
+
+        def pure(fp, fb, *ins):
+            ps = jax.tree_util.tree_unflatten(tree_p, fp)
+            bs = jax.tree_util.tree_unflatten(tree_b, fb)
+            out, _ = FB.call_functional(model, ps, bs, ins, train=False)
+            return tuple(out)
+
+        eng._params = jax.device_put(flat_p)
+        eng._buffers = jax.device_put(flat_b)
+        eng._compiled = jax.jit(pure)
+        object.__setattr__(model, "_serving_shared",
+                           (cfg.dtype, eng._compiled, eng._params,
+                            eng._buffers))
+        return eng
 
     # -- scheduling ------------------------------------------------------
-    def add_request(self, prompt_tokens, max_new_tokens=8):
+    def add_request(self, prompt_tokens, max_new_tokens=8, sampling=None,
+                    eos_token_id=None):
         if len(prompt_tokens) == 0:
             raise ValueError("prompt must contain at least one token "
                              "(an empty row would read another request's "
                              "logits)")
-        if len(prompt_tokens) > self.cfg.token_budget:
-            raise ValueError(
-                f"prompt of {len(prompt_tokens)} tokens exceeds the "
-                f"engine token budget {self.cfg.token_budget}")
         if len(prompt_tokens) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + max_new_tokens exceeds max_seq")
         rid = self._next_rid
         self._next_rid += 1
-        self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens)
+        self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens,
+                                       sampling, eos_token_id)
         return rid
 
     def _ensure_pages(self, req, upto_len):
-        import math
-
         need = math.ceil(upto_len / self.cfg.block_size)
         while len(req.pages) < need:
             if not self._free_pages:
@@ -277,52 +487,66 @@ class ServingEngine:
         self._free_pages.extend(req.pages)
         req.pages = []
 
+    def _set_caches(self, kc, vc):
+        # a bf16 artifact casts float outputs to f32 (the deploy-artifact
+        # contract) — restore the cache dtype so the next call's input
+        # avals match the exported signature
+        if kc.dtype != self._cache_dt:
+            kc, vc = kc.astype(self._cache_dt), vc.astype(self._cache_dt)
+        self._kc, self._vc = kc, vc
+
     def pending(self):
         return [r for r in self._requests.values() if not r.done]
 
+    def _schedule(self):
+        """Pick <= max_batch rows and a prefill/decode chunk size for
+        each within the token budget (vLLM-style chunked prefill: a
+        request needing more tokens than fit this step takes the next
+        chunk of its prompt+generated sequence)."""
+        cfg = self.cfg
+        rows = []
+        budget = cfg.token_budget
+        avail = len(self._free_pages)
+        for r in self.pending():
+            if len(rows) == cfg.max_batch or budget == 0:
+                break
+            chunk = min(r.length - r.cached, budget)
+            cap = (len(r.pages) + avail) * cfg.block_size  # page-limited
+            chunk = min(chunk, cap - r.cached)
+            if chunk <= 0:
+                continue  # defer: rerun once budget/pages free up
+            pages_needed = max(
+                math.ceil((r.cached + chunk) / cfg.block_size)
+                - len(r.pages), 0)
+            budget -= chunk
+            avail -= pages_needed
+            rows.append((r, chunk))
+        return rows
+
     def step(self):
         """One engine iteration: schedule <= max_batch live requests
-        (prefill + decode mixed) within the token budget, run the
-        artifact once, append one sampled token per scheduled row."""
-        import math
-
+        (prefill chunks + decode mixed) within the token budget, run the
+        step function once, sample one token per request that reached its
+        sequence tip."""
         cfg = self.cfg
 
-        def schedule():
-            rows = []
-            budget = cfg.token_budget
-            avail = len(self._free_pages)
-            for r in self.pending():
-                if len(rows) == cfg.max_batch:
-                    break
-                # a preempted request re-prefills its whole sequence
-                cost = r.length if not r.prefilled else 1
-                target_len = r.length
-                pages_needed = max(
-                    math.ceil(target_len / cfg.block_size) - len(r.pages),
-                    0)
-                if cost > budget or pages_needed > avail:
-                    continue  # defer: rerun once budget/pages free up
-                budget -= cost
-                avail -= pages_needed
-                rows.append(r)
-            return rows
-
-        rows = schedule()
-        if not rows and self.pending():
+        rows = self._schedule()
+        while not rows and self.pending():
             # pool deadlock: in-flight requests hold pages but none can
-            # grow — preempt the least-complete one (release its pages;
-            # it re-prefills prompt+generated later), vLLM-style
+            # grow — preempt the NEWEST holder (FCFS priority: the oldest
+            # request always makes progress, so symmetric requests cannot
+            # thrash each other's pages), vLLM-style. The victim releases
+            # its pages and re-prefills prompt+generated in chunks later.
             holders = [r for r in self.pending() if r.pages]
             if not holders:
                 raise RuntimeError(
                     "KV page pool exhausted: no pending request fits in "
                     f"{len(self._free_pages)} free pages — raise "
                     "num_blocks or lower concurrency")
-            victim = min(holders, key=lambda r: len(r.generated))
+            victim = max(holders, key=lambda r: r.rid)
             self._release(victim)
-            victim.prefilled = False
-            rows = schedule()
+            victim.cached = 0
+            rows = self._schedule()
         if not rows:
             return []
 
@@ -332,22 +556,13 @@ class ServingEngine:
         this = np.zeros(B1, np.int32)
         bt = np.zeros((B1, cfg.max_blocks_per_seq), np.int32)  # 0 = trash
         packed = []
-        for i, r in enumerate(rows):
-            if not r.prefilled:
-                seq = r.prompt + r.generated   # full redo after preempt
-                n = len(seq)
-                enc[i] = n
-                this[i] = n
-                packed_tokens = seq
-                self._ensure_pages(r, n)
-            else:
-                dec[i] = r.length - 1        # prefix length in cache
-                this[i] = 1
-                packed_tokens = [r.generated[-1]] if r.generated \
-                    else [r.prompt[-1]]
-                self._ensure_pages(r, r.length)
+        for i, (r, chunk) in enumerate(rows):
+            seq = r.prompt + r.generated
+            dec[i] = r.cached                # chunk starts at this pos
+            this[i] = chunk
+            self._ensure_pages(r, r.cached + chunk)
             bt[i, :len(r.pages)] = r.pages
-            packed.extend(packed_tokens)
+            packed.extend(seq[r.cached:r.cached + chunk])
         # padding tokens -> trash row (index B1-1, block table all page 0)
         n_pad = cfg.token_budget - len(packed)
         this[B1 - 1] = n_pad
@@ -358,18 +573,201 @@ class ServingEngine:
 
         out = self._compiled(self._params, self._buffers, tokens,
                              enc, dec, this, cu, bt, self._kc, self._vc)
-        logits, self._kc, self._vc = out[0], out[1], out[2]
-        logits = np.asarray(logits)
+        logits = out[0]
+        self._set_caches(out[1], out[2])
+
+        # device-side sampling for rows that reached their sequence tip
+        temps = np.zeros(B1, np.float32)
+        topks = np.zeros(B1, np.int32)
+        topps = np.ones(B1, np.float32)
+        salts = np.zeros(B1, np.int32)
+        tip = [False] * len(rows)
+        for i, (r, chunk) in enumerate(rows):
+            if r.cached + chunk == r.length:
+                tip[i] = True
+                sp = r.sampling
+                temps[i] = sp.temperature
+                topks[i] = sp.top_k
+                topps[i] = sp.top_p
+                salts[i] = sampling_salt(self.seed, r.rid,
+                                         len(r.generated))
+        if not any(tip):
+            # pure prefill-chunk step: nothing to sample — skip the
+            # sampler dispatch AND the host round-trip entirely
+            for r, chunk in rows:
+                r.cached += chunk
+            return []
+        # fast paths: skip the full-vocab sort when no row samples, or
+        # when every sampling row fits the exact top-k candidate sampler
+        if not np.any(temps > 0):
+            sampled = np.asarray(_greedy_tokens_dev(logits))
+        elif _topk_fast_ok(temps, topks):
+            sampled = np.asarray(_sample_topk_dev(
+                logits, temps, topks, topps, salts))
+        else:
+            sampled = np.asarray(_sample_tokens_dev(
+                logits, temps, topks, topps, salts))
 
         produced = []
-        for i, r in enumerate(rows):
-            nxt = int(np.argmax(logits[i]))
+        for i, (r, chunk) in enumerate(rows):
+            r.cached += chunk
+            if not tip[i]:
+                continue
+            nxt = int(sampled[i])
             r.generated.append(nxt)
-            r.prefilled = True
             produced.append((r.rid, nxt))
-            if len(r.generated) >= r.max_new:
+            if len(r.generated) >= r.max_new \
+                    or (r.eos_token_id is not None
+                        and nxt == r.eos_token_id):
                 r.done = True
                 self._release(r)
+        return produced
+
+    # -- multi-step decode (one device program per window) ---------------
+    def _decode_window_fn(self, n_rows, n_steps, sample_mode):
+        """Jitted whole-window decoder: `n_steps` model steps + sampling
+        + next-token feed as ONE lax.scan on device — a decode window is
+        a single dispatch + a single sync, so host/link latency is paid
+        once per window instead of once per token (the reference serving
+        stack's multi-step scheduling, done the XLA way)."""
+        tok_len = self._fixed_token_len or n_rows
+        key = (n_rows, n_steps, sample_mode, tok_len)
+        fn = self._window_fns.get(key)
+        if fn is not None:
+            return fn
+        B1 = self.cfg.max_batch + 1
+        cache_dt = self._cache_dt
+        compiled = self._compiled
+
+        def window(fp, fb, tokens, enc, dec, this, cu, bt, kc, vc,
+                   temps, topks, topps, salts):       # salts [n, B1]
+            live = (jnp.arange(B1) < n_rows).astype(jnp.int32)
+
+            def body(carry, salts_j):
+                tokens, dec, kc, vc = carry
+                out = compiled(fp, fb, tokens, enc, dec, this, cu, bt,
+                               kc, vc)
+                logits, kc, vc = out[0], out[1], out[2]
+                kc = kc.astype(cache_dt)
+                vc = vc.astype(cache_dt)
+                if sample_mode == "topk":
+                    sampled = _sample_topk_core(logits, temps, topks,
+                                                topps, salts_j)
+                elif sample_mode == "full":
+                    sampled = _sample_core(logits, temps, topks, topps,
+                                           salts_j)
+                else:
+                    sampled = jnp.argmax(logits, -1).astype(jnp.int32)
+                tokens = jnp.concatenate(
+                    [sampled[:n_rows],
+                     jnp.zeros((tok_len - n_rows,), jnp.int32)])
+                return (tokens, dec + live, kc, vc), sampled
+
+            (_, _, kc, vc), samples = jax.lax.scan(
+                body, (tokens, dec, kc, vc), salts)
+            return samples, kc, vc
+
+        fn = self._window_fns[key] = jax.jit(window)
+        return fn
+
+    def decode_run(self, n_steps):
+        """Run up to `n_steps` decode iterations over the current decode
+        batch as one device-side scan (ONE dispatch + ONE host sync):
+        each step's sampled tokens feed the next step's inputs on device.
+        Requests must be at their decode tip (fully prefilled); pages for
+        the whole window are reserved up front so block tables stay
+        static. Returns the produced (rid, token) list in step order."""
+        cfg = self.cfg
+        rows = [r for r in self.pending()
+                if r.length - r.cached == 1][:cfg.max_batch]
+        if not rows:
+            return []
+        n = min([n_steps] + [r.max_new - len(r.generated) for r in rows])
+        # clamp the window to what the free page pool can hold (the whole
+        # window's pages are reserved up front so block tables stay
+        # static); callers fall back to step() — which can preempt — when
+        # not even one decode step fits
+        free = len(self._free_pages)
+        while n > 0 and sum(
+                max(math.ceil((r.cached + n) / cfg.block_size)
+                    - len(r.pages), 0) for r in rows) > free:
+            n -= 1
+        if n <= 0:
+            return []
+        if n < n_steps:
+            # bound the executable zoo: tail windows (remaining budget or
+            # page pool smaller than requested) round down to a power of
+            # two, so at most log2 window programs exist per batch size
+            # instead of one per distinct remaining-token count
+            n = 1 << (n.bit_length() - 1)
+        B = len(rows)
+        B1 = cfg.max_batch + 1
+        for r in rows:
+            self._ensure_pages(r, r.cached + n)
+
+        enc = np.zeros(B1, np.int32)
+        this = np.zeros(B1, np.int32)
+        this[:B] = 1
+        # jit engines feed exactly the B live tokens (decode matmuls run
+        # at T=B, not the full prefill budget); artifact engines must pad
+        # to the module's fixed token length
+        tok_len = self._fixed_token_len or B
+        n_pad = tok_len - B
+        this[B1 - 1] = n_pad
+        enc[B1 - 1] = n_pad
+        cu = np.zeros(B1 + 1, np.int32)
+        cu[1:] = np.cumsum(this)
+        bt = np.zeros((B1, cfg.max_blocks_per_seq), np.int32)
+        for i, r in enumerate(rows):
+            bt[i, :len(r.pages)] = r.pages
+        dec0 = np.array([r.cached for r in rows], np.int32)
+        ngen0 = [len(r.generated) for r in rows]
+
+        tokens = np.asarray(
+            [(r.prompt + r.generated)[-1] for r in rows]
+            + [0] * n_pad, np.int32)
+        temps = np.zeros(B1, np.float32)
+        topks = np.zeros(B1, np.int32)
+        topps = np.ones(B1, np.float32)
+        for i, r in enumerate(rows):
+            temps[i] = r.sampling.temperature
+            topks[i] = r.sampling.top_k
+            topps[i] = r.sampling.top_p
+        if not np.any(temps > 0):
+            sample_mode = "greedy"
+        elif _topk_fast_ok(temps, topks):
+            sample_mode = "topk"
+        else:
+            sample_mode = "full"
+        salts = np.zeros((n, B1), np.int32)
+        for j in range(n):
+            for i, r in enumerate(rows):
+                salts[j, i] = sampling_salt(self.seed, r.rid,
+                                            ngen0[i] + j)
+        dec = np.zeros(B1, np.int32)
+        dec[:B] = dec0
+
+        window = self._decode_window_fn(B, n, sample_mode)
+        samples, kc, vc = window(self._params, self._buffers, tokens,
+                                 enc, dec, this, cu, bt,
+                                 self._kc, self._vc,
+                                 temps, topks, topps, salts)
+        self._kc, self._vc = kc, vc
+        fetched = np.asarray(samples)                    # [n, B1] — sync
+        produced = []
+        for j in range(n):
+            for i, r in enumerate(rows):
+                if r.done:
+                    continue
+                nxt = int(fetched[j, i])
+                r.generated.append(nxt)
+                r.cached += 1
+                produced.append((r.rid, nxt))
+                if len(r.generated) >= r.max_new \
+                        or (r.eos_token_id is not None
+                            and nxt == r.eos_token_id):
+                    r.done = True
+                    self._release(r)
         return produced
 
     def run_to_completion(self, max_steps=1000):
@@ -384,13 +782,13 @@ class ServingEngine:
 def save_paged_model(path_prefix: str, model: PagedCausalLM):
     """Export the paged step function as a serving artifact with the
     engine's static shapes."""
-    from . import save_inference_model
+    from . import PrecisionType, save_inference_model
     from ..jit.api import InputSpec
 
     cfg = model.cfg
     B1 = cfg.max_batch + 1
     L = cfg.num_layers
-    cache_shape = (L, cfg.num_blocks, cfg.num_heads, cfg.block_size,
+    cache_shape = (L, cfg.num_blocks, cfg.num_kv_heads, cfg.block_size,
                    cfg.head_dim)
     spec = [
         InputSpec((cfg.token_budget,), "int32", "tokens"),
@@ -399,9 +797,12 @@ def save_paged_model(path_prefix: str, model: PagedCausalLM):
         InputSpec((B1,), "int32", "seq_lens_this_time"),
         InputSpec((B1 + 1,), "int32", "cu_seqlens_q"),
         InputSpec((B1, cfg.max_blocks_per_seq), "int32", "block_tables"),
-        InputSpec(cache_shape, "float32", "key_caches"),
-        InputSpec(cache_shape, "float32", "value_caches"),
+        InputSpec(cache_shape, cfg.dtype, "key_caches"),
+        InputSpec(cache_shape, cfg.dtype, "value_caches"),
     ]
+    precision = PrecisionType.Bfloat16 if cfg.dtype == "bfloat16" \
+        else PrecisionType.Float32
     return save_inference_model(path_prefix, model, spec,
+                                precision=precision,
                                 output_names=["logits", "key_caches",
                                               "value_caches"])
