@@ -61,6 +61,15 @@ from . import onnx
 from . import geometric
 from . import audio
 from . import text
+from . import regularizer
+from . import decomposition
+from . import hub
+from . import inference
+from . import sysconfig
+from .hapi import callbacks
+from .framework.io import async_save, clear_async_save_task_queue
+from .core.place import IPUPlace, XPUPlace
+from .pir import IrGuard
 from .hapi.model import Model
 from . import hapi
 from . import profiler
@@ -78,6 +87,16 @@ def is_compiled_with_cuda():
 
 def is_compiled_with_xpu():
     return False
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def get_cudnn_version():
+    """reference: paddle.get_cudnn_version — None when no cuDNN (the
+    TPU build has none; XLA owns conv lowering)."""
+    return None
 
 
 def is_compiled_with_rocm():

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import autograd
+from . import amp_state, autograd
+from ..utils import flags as _flags
 from .dtype import FLOATING, COMPLEX
 from .tensor import Tensor
 
@@ -149,7 +150,7 @@ def _fp_fn(fn, depth=0):
 
 
 class _Entry:
-    __slots__ = ("uses_rng", "disabled", "fwd", "vjp", "calls")
+    __slots__ = ("uses_rng", "disabled", "fwd", "vjp", "calls", "fails")
 
     def __init__(self, uses_rng):
         self.uses_rng = uses_rng
@@ -157,6 +158,7 @@ class _Entry:
         self.fwd = None
         self.vjp = None
         self.calls = 1
+        self.fails = 0
 
 
 _op_cache: dict = {}
@@ -265,7 +267,7 @@ def _build_vjp(rebuild, diff_mask, uses_rng):
 
 
 def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
-          cacheable: bool = True, **kwargs):
+          cacheable: bool = True, op_key=None, **kwargs):
     """Run `fn` (a pure jax function) on Tensor/array args.
 
     Tensors anywhere in the (args, kwargs) pytree are unwrapped; if any of
@@ -273,6 +275,12 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
     pullback is recorded. Output arrays are wrapped back into Tensors.
     Set cacheable=False for ops that do host-side validation of concrete
     values (the jit cache would silently skip those checks).
+
+    op_key: optional hashable fingerprint replacing the automatic closure
+    inspection in the jit-cache key — hot call sites that build a fresh
+    closure per call (matmul's transpose flags, reductions' axis config)
+    pass (op_name, *config) so dispatch never walks the closure. The
+    caller owns correctness: the key must determine fn's behavior.
     """
     name = op_name or getattr(fn, "__name__", "op")
     flat, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
@@ -285,8 +293,6 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
                 _Capture.active[id(t)] = t
 
     # AMP autocast hook (reference: amp_auto_cast.h in every *_ad_func)
-    from . import amp_state
-
     if amp_state.amp_enabled():
         target = amp_state.cast_policy(name)
         if target is not None:
@@ -308,7 +314,7 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
     if (_cache_enabled and cacheable
             and _ProgramRecorder.active is None):
         result = _apply_cached(fn, name, flat, treedef, tensor_pos,
-                               diff_pos, record)
+                               diff_pos, record, op_key)
         if result is not _MISS:
             return result
     return _apply_legacy(fn, name, flat, treedef, diff_pos, record)
@@ -341,8 +347,13 @@ def _observe(name, leaves):
 def _next_rng_inputs(rnd):
     """Fresh (key, counter) for a cached RNG op, honoring an active
     rng_guard exactly like next_key() does (guard draws must stay
-    deterministic per guard key and must not advance the global state)."""
+    deterministic per guard key and must not advance the global state).
+    A deferred guard (another op's probe in flight) is materialized
+    first, exactly as next_key() would — passing the sentinel downstream
+    would throw in fold_in and burn this entry's fast path."""
     st = rnd._state
+    if st.guard_key is rnd._DEFERRED:
+        rnd._materialize_deferred_guard()
     if st.guard_key is not None:
         st.guard_counter += 1
         return st.guard_key, np.int32(st.guard_counter)
@@ -350,7 +361,8 @@ def _next_rng_inputs(rnd):
     return st.key, np.int32(st.counter)
 
 
-def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
+def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
+                  op_key=None):
     # one pass: partition leaves into static (key material) and dynamic
     static_items = []   # (index, type-name, key-fingerprint)
     static_vals = []    # (index, original value) — what rebuild injects
@@ -380,10 +392,13 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
         dyn_pos.append(i)
         dyn_vals.append(v)
         diff_mask.append(i in diff_set)
-    try:
-        fp = _fp_fn(fn)
-    except _Uncacheable:
-        return _MISS
+    if op_key is not None:
+        fp = ("opkey", op_key)
+    else:
+        try:
+            fp = _fp_fn(fn)
+        except _Uncacheable:
+            return _MISS
     key = (fp, treedef, tuple(static_items), tuple(dyn_pos),
            tuple(diff_mask), record)
     entry = _op_cache.get(key)
@@ -427,7 +442,7 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
             out = entry.fwd(rkey, rctr, dyn_vals)
         else:
             out = entry.fwd(dyn_vals)
-    except Exception:
+    except Exception as cache_exc:
         entry.disabled = True
         try:
             result = _apply_legacy(fn, name, flat, treedef, diff_pos, record)
@@ -436,9 +451,33 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record):
             # limitation): surface the real error, keep the cache live
             entry.disabled = False
             raise
-        return result
-    from ..utils import flags as _flags
+        # legacy succeeded but the cached executable failed. A
+        # deterministic tracing failure (host-side reads of traced
+        # values: concretization/tracer-conversion errors) will fail
+        # identically forever — disable immediately and silently, like
+        # the round-3 behavior. Transient failures (device flake,
+        # compile-time OOM) get 3 tries before pinning to the slow path,
+        # and say why once.
+        deterministic = isinstance(
+            cache_exc, (jax.errors.TracerArrayConversionError,
+                        jax.errors.TracerBoolConversionError,
+                        jax.errors.TracerIntegerConversionError,
+                        jax.errors.ConcretizationTypeError,
+                        jax.errors.UnexpectedTracerError))
+        entry.fails += 1
+        entry.fwd = None
+        entry.vjp = None
+        if not deterministic:
+            if entry.fails < 3:
+                entry.disabled = False
+            else:
+                import warnings
 
+                warnings.warn(
+                    f"op [{name}] cached executable failed {entry.fails} "
+                    f"times ({type(cache_exc).__name__}: {cache_exc}); "
+                    "pinning this signature to the legacy eager path")
+        return result
     if _flags.flag("check_nan_inf"):
         check_nan_inf(name, jax.tree.leaves(out))
     _observe(name, jax.tree.leaves(out))
@@ -462,8 +501,6 @@ def _make_run(fn, flat, treedef, diff_pos):
 
 def _finish_record(fn, name, flat, treedef, diff_pos, out, vjp_fn):
     out_flat, out_treedef = jax.tree.flatten(out)
-    from ..utils import flags as _flags
-
     if _flags.flag("check_nan_inf"):
         check_nan_inf(name, out_flat)
     _observe(name, out_flat)
@@ -530,8 +567,6 @@ def check_nan_inf(name, arrays):
     """FLAGS_check_nan_inf debug mode (reference: paddle/common/flags.cc:72,
     nan_inf_utils hooks in eager + new_executor). Eager-only: sync-checks
     every op output; level>=3 reports instead of raising."""
-    from ..utils import flags as _flags
-
     for a in arrays:
         if not hasattr(a, "dtype") or not jnp.issubdtype(a.dtype,
                                                          jnp.inexact):

@@ -70,6 +70,14 @@ def CUDAPlace(device_id: int = 0) -> Place:
     return TPUPlace(device_id)
 
 
+def XPUPlace(device_id: int = 0) -> Place:
+    return TPUPlace(device_id)
+
+
+def IPUPlace() -> Place:
+    return TPUPlace(0)
+
+
 CustomPlace = TPUPlace
 
 _state = threading.local()

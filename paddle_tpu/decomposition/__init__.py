@@ -1,0 +1,165 @@
+"""paddle.decomposition — composite-op decomposition over a recorded
+Program.
+
+Reference analog: python/paddle/decomposition/decomp.py:192 `decompose`
+(search ops with registered composite rules in a PIR program and replace
+them with primitive ops; rules live in paddle/fluid/primitive/composite).
+
+TPU-native shape: every eager op in this framework is ALREADY a jax
+function, and XLA traces it down to HLO primitives — the "primitive
+dialect" is jax's primitive set, reached by tracing, not by a C++
+rewrite. What `decompose` adds on top is the Program-level view: entries
+of a recorded `static.Program` whose op has a registered rule are
+rewritten IN the program to the rule's primitive-only implementation
+(raw lax/jnp, no fused library calls), so
+
+- replay executes the decomposed math (numerics-identical by rule
+  contract, testable),
+- passes and inspection see `<op>@decomposed` entries,
+- `jax.make_jaxpr` of the rule exposes the exact primitive list
+  (`primitives_of`).
+
+Rules are registered with `register_decomp(op_name)`; the built-in set
+covers the composite ops the reference decomposes most (softmax, gelu,
+silu, log_softmax, mean, rms/layer norms' affine forms are already
+primitive here).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, FrozenSet, List
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["decompose", "register_decomp", "has_decomp_rule",
+           "registered_ops", "primitives_of"]
+
+_RULES: Dict[str, Callable] = {}
+
+
+def register_decomp(op_name: str):
+    """Register `fn` as the primitive-only decomposition of `op_name`.
+    The rule must take the SAME positional arguments as the op's recorded
+    kernel fn and return the same output structure."""
+
+    def deco(fn):
+        _RULES[op_name] = fn
+        return fn
+
+    return deco
+
+
+def has_decomp_rule(op_name: str) -> bool:
+    return op_name in _RULES
+
+
+def registered_ops() -> List[str]:
+    return sorted(_RULES)
+
+
+# -- built-in rules (raw lax/jnp only — no jax.nn fused forms) -----------
+# Rules accept the composite op's recorded positional signature plus the
+# op wrapper's closure config by NAME (decompose() recovers it from the
+# recorded fn's free variables — e.g. nn.functional.softmax closes over
+# `axis` and the dtype `d`).
+
+@register_decomp("softmax")
+def _softmax_rule(x, axis=-1, d=None, **kw):
+    if d is not None:
+        x = x.astype(d)
+    axis = int(axis)
+    m = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("gelu")
+def _gelu_rule(x, approximate=False, **kw):
+    # tanh approximation when requested, else erf-exact via lax.erf
+    if approximate:
+        c = 0.7978845608028654  # sqrt(2/pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+    return 0.5 * x * (1.0 + jax.lax.erf(x / jnp.sqrt(x.dtype.type(2.0))))
+
+
+@register_decomp("silu")
+def _silu_rule(x, **kw):
+    return x / (1.0 + jnp.exp(-x))
+
+
+@register_decomp("log_softmax")
+def _log_softmax_rule(x, axis=-1, d=None, **kw):
+    if d is not None:
+        x = x.astype(d)
+    axis = int(axis)
+    m = jax.lax.stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    s = x - m
+    return s - jnp.log(jnp.sum(jnp.exp(s), axis=axis, keepdims=True))
+
+
+@register_decomp("sigmoid")
+def _sigmoid_rule(x, **kw):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+def _closure_config(fn):
+    """Recover an op wrapper's closed-over config (axis, approximate,
+    dtype, ...) by free-variable name; arrays and exotic objects are
+    skipped (rules only consume simple config)."""
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None)
+    if code is None or not cells:
+        return {}
+    out = {}
+    for namev, cell in zip(code.co_freevars, cells):
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if v is None or isinstance(v, (bool, int, float, str)) \
+                or isinstance(v, type) \
+                or getattr(v, "__module__", "").startswith("numpy"):
+            out[namev] = v
+    return out
+
+
+def primitives_of(op_name: str, *example_args, **kw) -> List[str]:
+    """Primitive names the rule for `op_name` lowers to, via
+    jax.make_jaxpr over example arguments (aval-only is fine)."""
+    rule = _RULES[op_name]
+    jaxpr = jax.make_jaxpr(lambda *a: rule(*a, **kw))(*example_args)
+    return sorted({str(eq.primitive) for eq in jaxpr.jaxpr.eqns})
+
+
+def decompose(program, src_vars=(), blacklist=frozenset(),
+              whitelist=frozenset(), start_index=0, end_index=-1):
+    """Rewrite composite ops of `program` (a static.Program) into their
+    registered primitive-only rules, in place, honoring the reference's
+    selection contract (decomp.py:192): the decomposed set is
+    ``(ops with a rule & whitelist) - blacklist`` over the entry range
+    [start_index, end_index). Returns `src_vars` unchanged — recorded
+    entries are rewritten in place, so the program's tensors keep their
+    identities (the reference returns replacement vars because PIR
+    rebuilds values; the flat-list Program does not need to)."""
+    blacklist = frozenset(blacklist)
+    whitelist = frozenset(whitelist)
+    end = len(program.ops) if end_index == -1 else end_index
+    for idx in range(start_index, min(end, len(program.ops))):
+        entry = program.ops[idx]
+        name = entry[0]
+        if name.endswith("@decomposed"):
+            continue
+        if name not in _RULES or name in blacklist:
+            continue
+        if whitelist and name not in whitelist:
+            continue
+        rule = _RULES[name]
+        cfg = _closure_config(entry[1])
+
+        def rewritten(*a, _rule=rule, _cfg=cfg, **k):
+            return _rule(*a, **{**_cfg, **k})
+
+        program.ops[idx] = (f"{name}@decomposed", rewritten) \
+            + tuple(entry[2:])
+    program._compiled.clear()
+    return list(src_vars)

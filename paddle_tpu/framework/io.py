@@ -49,3 +49,51 @@ def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         obj = pickle.load(f)
     return _from_storable(obj, return_numpy=return_numpy)
+
+
+# -- async checkpoint save (reference: paddle.async_save /
+# clear_async_save_task_queue, python/paddle/framework/io.py) -----------
+_async_tasks = []
+
+
+def async_save(obj, path, protocol=_PROTO, sync_other_task=False,
+               **configs):
+    """Snapshot `obj` host-side NOW, write it on a background thread —
+    training continues while the checkpoint hits disk (the reference's
+    async_save contract: the caller may mutate params right after the
+    call)."""
+    import tempfile
+    import threading
+
+    if sync_other_task:
+        clear_async_save_task_queue()
+    snapshot = _to_storable(obj)        # host copy before returning
+
+    def work():
+        d = os.path.dirname(path) or "."
+        os.makedirs(d, exist_ok=True)
+        # unique temp per call: overlapping saves to the same path must
+        # not interleave bytes; last os.replace wins atomically
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                pickle.dump(snapshot, f, protocol=protocol)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    _async_tasks[:] = [t for t in _async_tasks if t.is_alive()]
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    _async_tasks.append(t)
+    return t
+
+
+def clear_async_save_task_queue():
+    """Block until all queued async saves finish (reference API)."""
+    while _async_tasks:
+        _async_tasks.pop().join()

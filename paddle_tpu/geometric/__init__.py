@@ -1,61 +1,89 @@
-"""paddle.geometric (reference: python/paddle/geometric/ — message passing
-+ segment ops). Segment ops map to jax.ops.segment_* (XLA scatter-reduce)."""
+"""paddle.geometric (reference: python/paddle/geometric/ — message
+passing, segment ops, and the graph-sampling family).
+
+TPU-native shape: segment reductions map to jax.ops.segment_* (XLA
+scatter-reduce) and are JIT-SAFE — the segment count is an explicit
+`num_segments`/`out_size` argument threaded from the API; when omitted
+in eager mode it is derived with one host read (and tracing without it
+raises a clear error instead of a silent wrong shape). The sampling
+family (reference python/paddle/geometric/sampling/neighbors.py and
+reindex.py — GPU hashtable kernels there) computes on device with
+static shapes (gumbel top-k sampling over padded neighbor windows,
+sort-based order-preserving reindex) and materializes only the final
+dynamically-sized outputs.
+"""
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
-           "send_u_recv", "send_ue_recv", "send_uv"]
+           "send_u_recv", "send_ue_recv", "send_uv",
+           "sample_neighbors", "weighted_sample_neighbors",
+           "reindex_graph"]
 
 
-def _nseg(segment_ids):
-    import numpy as np
+def _is_traced(x):
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, jax.core.Tracer)
 
+
+def _nseg(segment_ids, num_segments, op_name):
+    """Explicit count wins; eager falls back to one host read; tracing
+    without the count is an error (data-dependent shapes cannot jit)."""
+    if num_segments is not None:
+        return int(num_segments)
+    if _is_traced(segment_ids):
+        raise ValueError(
+            f"{op_name}: pass num_segments/out_size explicitly when "
+            "tracing — the segment count is data-dependent and cannot "
+            "be read from a traced index tensor")
     ids = segment_ids.numpy() if isinstance(segment_ids, Tensor) else \
         np.asarray(segment_ids)
     return int(ids.max()) + 1 if ids.size else 0
 
 
-def segment_sum(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments, "segment_sum")
     return apply(lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
-                 data, segment_ids, op_name="segment_sum")
+                 data, segment_ids, op_name="segment_sum",
+                 op_key=("segment_sum", n))
 
 
-def segment_mean(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments, "segment_mean")
 
     def fn(d, i):
         s = jax.ops.segment_sum(d, i, num_segments=n)
         c = jax.ops.segment_sum(jnp.ones(d.shape[:1]), i, num_segments=n)
         return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (d.ndim - 1))
-    return apply(fn, data, segment_ids, op_name="segment_mean")
+    return apply(fn, data, segment_ids, op_name="segment_mean",
+                 op_key=("segment_mean", n))
 
 
-def segment_max(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments, "segment_max")
     return apply(lambda d, i: jax.ops.segment_max(d, i, num_segments=n),
-                 data, segment_ids, op_name="segment_max")
+                 data, segment_ids, op_name="segment_max",
+                 op_key=("segment_max", n))
 
 
-def segment_min(data, segment_ids, name=None):
-    n = _nseg(segment_ids)
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    n = _nseg(segment_ids, num_segments, "segment_min")
     return apply(lambda d, i: jax.ops.segment_min(d, i, num_segments=n),
-                 data, segment_ids, op_name="segment_min")
+                 data, segment_ids, op_name="segment_min",
+                 op_key=("segment_min", n))
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
-    """Gather x[src], scatter-reduce to dst (reference message passing)."""
-    import numpy as np
-
-    n = out_size or (int(dst_index.numpy().max()) + 1
-                     if isinstance(dst_index, Tensor)
-                     else int(np.asarray(dst_index).max()) + 1)
+    """Gather x[src], scatter-reduce to dst (reference message passing,
+    send_recv.py); out_size is the reference's jit-safe segment count."""
+    n = _nseg(dst_index, out_size, "send_u_recv")
 
     def fn(xa, s, d):
         msgs = jnp.take(xa, s, axis=0)
@@ -72,16 +100,13 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
         if reduce_op == "min":
             return jax.ops.segment_min(msgs, d, num_segments=n)
         raise ValueError(reduce_op)
-    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv",
+                 op_key=("send_u_recv", reduce_op, n))
 
 
 def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
-    import numpy as np
-
-    n = out_size or (int(dst_index.numpy().max()) + 1
-                     if isinstance(dst_index, Tensor)
-                     else int(np.asarray(dst_index).max()) + 1)
+    n = _nseg(dst_index, out_size, "send_ue_recv")
 
     def fn(xa, ya, s, d):
         msgs = jnp.take(xa, s, axis=0)
@@ -94,7 +119,8 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
         if reduce_op == "max":
             return jax.ops.segment_max(msgs, d, num_segments=n)
         raise ValueError(reduce_op)
-    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv",
+                 op_key=("send_ue_recv", message_op, reduce_op, n))
 
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
@@ -102,4 +128,161 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         a = jnp.take(xa, s, axis=0)
         b = jnp.take(ya, d, axis=0)
         return a + b if message_op == "add" else a * b
-    return apply(fn, x, y, src_index, dst_index, op_name="send_uv")
+    return apply(fn, x, y, src_index, dst_index, op_name="send_uv",
+                 op_key=("send_uv", message_op))
+
+
+# ---------------------------------------------------------------------------
+# sampling family (reference: geometric/sampling/neighbors.py, reindex.py)
+# ---------------------------------------------------------------------------
+
+def _arr(x, dtype=None):
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    v = v.reshape(-1)
+    return v.astype(dtype) if dtype is not None else v
+
+
+def _sample_windows(row, colptr, nodes, sample_size, key, weights=None):
+    """Device-side core: per input node, gather its padded neighbor
+    window [N, W] from the CSC graph and pick `sample_size` of them
+    (gumbel top-k over the valid mask — uniform without replacement, or
+    weighted when `weights` is given), W = max degree of the batch.
+    Returns (chosen_cols [N, K], counts [N], K) with chosen_cols holding
+    positions into `row` (-1 on padding)."""
+    start = colptr[nodes]
+    deg = colptr[nodes + 1] - start
+    max_deg = int(jax.device_get(jnp.max(deg))) if deg.size else 0
+    W = max(max_deg, 1)
+    counts = deg if sample_size < 0 else jnp.minimum(deg, sample_size)
+    K = W if sample_size < 0 else min(sample_size, W)
+    pos = start[:, None] + jnp.arange(W)[None, :]            # [N, W]
+    valid = jnp.arange(W)[None, :] < deg[:, None]
+    pos = jnp.where(valid, pos, 0)
+    if sample_size < 0:
+        order = jnp.broadcast_to(jnp.arange(W)[None, :], pos.shape)
+        chosen = jnp.where(valid, pos, -1)
+        return chosen, counts, W, order
+    if weights is not None:
+        w = jnp.where(valid, jnp.log(jnp.maximum(
+            weights[pos], 1e-30)), -jnp.inf)
+    else:
+        w = jnp.where(valid, 0.0, -jnp.inf)
+    g = w + jax.random.gumbel(key, pos.shape)
+    _, top = jax.lax.top_k(g, K)                             # [N, K]
+    keep = jnp.arange(K)[None, :] < counts[:, None]
+    chosen = jnp.where(keep, jnp.take_along_axis(pos, top, axis=1), -1)
+    return chosen, counts, K, top
+
+
+def _finish_sample(row, chosen, counts, eids=None):
+    """Trim the padded [N, K] selection into the reference's flat
+    (neighbors, counts[, eids]) outputs — the one dynamic-shape step,
+    done with a single host materialization."""
+    chosen_np = np.asarray(jax.device_get(chosen))
+    counts_np = np.asarray(jax.device_get(counts))
+    mask = chosen_np >= 0
+    flat_pos = chosen_np[mask]
+    row_np = np.asarray(jax.device_get(row))
+    out_neighbors = row_np[flat_pos]
+    outs = [Tensor(jnp.asarray(out_neighbors)),
+            Tensor(jnp.asarray(counts_np.astype(np.int32)))]
+    if eids is not None:
+        eids_np = np.asarray(jax.device_get(_arr(eids)))
+        outs.append(Tensor(jnp.asarray(eids_np[flat_pos])))
+    return outs
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniform neighbor sampling over a CSC graph (reference:
+    geometric/sampling/neighbors.py:23 graph_sample_neighbors kernel).
+    Returns (out_neighbors, out_count[, out_eids]). The sampling itself
+    runs on device (padded windows + gumbel top-k, the fisher-yates
+    analog); randomness comes from the framework RNG stream."""
+    if return_eids and eids is None:
+        raise ValueError(
+            "`eids` should not be None if `return_eids` is True.")
+    from ..framework import random as rnd
+
+    row_a = _arr(row)
+    colptr_a = _arr(colptr)
+    nodes_a = _arr(input_nodes)
+    chosen, counts, _, _ = _sample_windows(
+        row_a, colptr_a, nodes_a, int(sample_size), rnd.next_key())
+    outs = _finish_sample(row_a, chosen, counts,
+                          eids if return_eids else None)
+    return tuple(outs) if len(outs) > 2 else (outs[0], outs[1])
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weighted variant (reference neighbors.py:172): neighbors drawn
+    without replacement with probability proportional to edge weight
+    (gumbel top-k over log-weights — the exponential-race trick the
+    reference's GPU kernel implements with A-Res sampling)."""
+    if return_eids and eids is None:
+        raise ValueError(
+            "`eids` should not be None if `return_eids` is True.")
+    from ..framework import random as rnd
+
+    row_a = _arr(row)
+    colptr_a = _arr(colptr)
+    nodes_a = _arr(input_nodes)
+    w_a = _arr(edge_weight, jnp.float32)
+    chosen, counts, _, _ = _sample_windows(
+        row_a, colptr_a, nodes_a, int(sample_size), rnd.next_key(),
+        weights=w_a)
+    outs = _finish_sample(row_a, chosen, counts,
+                          eids if return_eids else None)
+    return tuple(outs) if len(outs) > 2 else (outs[0], outs[1])
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """Reindex sampled nodes to a dense [0, n) id space (reference:
+    geometric/reindex.py:25 graph_reindex kernel — GPU hashtables).
+    Device-side: order-preserving unique via stable sort + segment-min
+    representatives; only the final `out_nodes` trim reads one count.
+
+    Returns (reindex_src, reindex_dst, out_nodes) with the input nodes
+    `x` occupying the front of `out_nodes`."""
+    x_a = _arr(x)
+    nb_a = _arr(neighbors)
+    cnt_a = _arr(count, jnp.int32)
+
+    def core(xa, nba, cnta):
+        allv = jnp.concatenate([xa, nba])
+        n = allv.shape[0]
+        idx = jnp.arange(n)
+        order = jnp.argsort(allv, stable=True)
+        sv = allv[order]
+        si = idx[order]
+        newrun = jnp.concatenate(
+            [jnp.ones((1,), bool), sv[1:] != sv[:-1]])
+        run_id = jnp.cumsum(newrun) - 1                      # [n]
+        n_runs_max = n
+        # representative of each run = MIN original index (first
+        # occurrence in the concat order: x first, then neighbors)
+        rep = jax.ops.segment_min(si, run_id, num_segments=n_runs_max)
+        n_unique = run_id[-1] + 1
+        rep = jnp.where(jnp.arange(n) < n_unique, rep, n)
+        # new id of a run = rank of its representative index
+        rank = jnp.argsort(jnp.argsort(rep))                 # [n_runs_max]
+        new_of_elem = rank[run_id]                           # sorted order
+        mapped = jnp.zeros((n,), new_of_elem.dtype) \
+            .at[order].set(new_of_elem)                      # orig order
+        reindex_src = mapped[xa.shape[0]:]
+        # dst: node i repeated cnta[i] times == searchsorted over cumsum
+        ends = jnp.cumsum(cnta)
+        dst = jnp.searchsorted(ends, jnp.arange(nba.shape[0]),
+                               side="right")
+        out_nodes_padded = allv[jnp.sort(rep)[:n]]
+        return reindex_src, dst.astype(reindex_src.dtype), \
+            out_nodes_padded, n_unique
+
+    src, dst, out_padded, n_unique = apply(
+        core, x_a, nb_a, cnt_a, op_name="reindex_graph",
+        differentiable=False)
+    n_u = int(jax.device_get(n_unique._value))
+    return src, dst, Tensor(out_padded._value[:n_u])

@@ -1,0 +1,140 @@
+"""paddle.hub — hubconf-protocol model loading.
+
+Reference analog: python/paddle/hapi/hub.py (list/help/load over a repo
+that ships a `hubconf.py` with entrypoint callables and an optional
+`dependencies` list; sources github | gitee | local, with a download
+cache under the hub home).
+
+Behavior parity: the local source is fully functional; github/gitee
+resolve to the same archive URLs and cache layout as the reference and
+download via urllib — on an air-gapped host the download raises a clear
+RuntimeError naming the URL (the protocol, cache and hubconf handling
+are identical either way).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import zipfile
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf"
+_VAR_DEPENDENCY = "dependencies"
+
+
+def _hub_home():
+    return os.environ.get(
+        "PPTPU_HUB_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "hub"))
+
+
+def _parse_repo(repo, source):
+    """'owner/name[:branch]' -> (owner, name, branch) with the
+    reference's default branch per source."""
+    if ":" in repo:
+        repo, branch = repo.split(":", 1)
+    else:
+        branch = "main" if source == "github" else "master"
+    if repo.count("/") != 1:
+        raise ValueError(
+            f'repo must look like "repo_owner/repo_name[:branch]", got '
+            f'"{repo}"')
+    owner, name = repo.split("/")
+    return owner, name, branch
+
+
+def _archive_url(owner, name, branch, source):
+    if source == "github":
+        return (f"https://github.com/{owner}/{name}/archive/"
+                f"{branch}.zip")
+    return (f"https://gitee.com/{owner}/{name}/repository/archive/"
+            f"{branch}.zip")
+
+
+def _get_cache_or_reload(repo, force_reload, source):
+    """Materialize the repo under the hub cache dir; returns its path."""
+    owner, name, branch = _parse_repo(repo, source)
+    home = _hub_home()
+    os.makedirs(home, exist_ok=True)
+    dirname = f"{owner}_{name}_{branch}".replace("/", "_")
+    repo_dir = os.path.join(home, dirname)
+    if os.path.isdir(repo_dir) and not force_reload:
+        return repo_dir
+    url = _archive_url(owner, name, branch, source)
+    zip_path = os.path.join(home, dirname + ".zip")
+    try:
+        import urllib.request
+
+        urllib.request.urlretrieve(url, zip_path)
+    except Exception as e:
+        raise RuntimeError(
+            f"failed to download hub repo from {url}: {e}. On an offline "
+            "host use source='local' with a checked-out repo directory."
+        ) from e
+    with zipfile.ZipFile(zip_path) as zf:
+        top = zf.namelist()[0].split("/")[0]
+        zf.extractall(home)
+    os.replace(os.path.join(home, top), repo_dir)
+    os.unlink(zip_path)
+    return repo_dir
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF + ".py")
+    if not os.path.isfile(path):
+        raise RuntimeError(f"no {_HUBCONF}.py found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location(_HUBCONF, path)
+    m = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(m, _VAR_DEPENDENCY, None)
+    if deps:
+        missing = [d for d in deps
+                   if importlib.util.find_spec(d) is None]
+        if missing:
+            raise RuntimeError(
+                f"hub repo requires missing dependencies: {missing}")
+    return m
+
+
+def _resolve(repo_dir, source, force_reload):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            '"gitee" | "local".')
+    if source == "local":
+        return repo_dir
+    return _get_cache_or_reload(repo_dir, force_reload, source)
+
+
+def _load_entry(m, name):
+    fn = getattr(m, name, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"cannot find callable {name} in hubconf")
+    return fn
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """Entrypoint names exposed by the repo's hubconf.py."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return [k for k, v in vars(m).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of one entrypoint."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return _load_entry(m, model).__doc__
+
+
+def load(repo_dir, model, *args, source="github", force_reload=False,
+         **kwargs):
+    """Call the entrypoint and return its result (usually a Layer)."""
+    m = _import_hubconf(_resolve(repo_dir, source, force_reload))
+    return _load_entry(m, model)(*args, **kwargs)

@@ -130,9 +130,37 @@ def __pt_while__(test_fn, body_fn, state):
     return _rewrap_state(out, was_tensor)
 
 
-def __pt_for_range__(rargs, body_fn, state, prior=None, has_prior=False):
+class _UnboundLoopVar:
+    """Binding for a loop variable after a zero-trip for-range with no
+    prior binding: any use raises NameError, matching plain Python
+    (where the name would simply not exist)."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        object.__setattr__(self, "name", name)
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            f"name '{object.__getattribute__(self, 'name')}' is not "
+            "defined (a zero-trip for-range left the loop variable "
+            "unbound)")
+
+    def __getattr__(self, attr):
+        self._raise()
+
+    __bool__ = __int__ = __float__ = __index__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __repr__ = __str__ = __hash__ = _raise
+
+
+def __pt_for_range__(rargs, body_fn, state, prior=None, has_prior=False,
+                     name="<loop var>"):
     """prior/has_prior: the loop variable's binding before the loop (when
-    definitely bound) so a zero-trip range preserves it like Python."""
+    definitely bound) so a zero-trip range preserves it like Python; with
+    no prior binding a zero-trip range binds a NameError-raising sentinel
+    (plain Python leaves the name undefined)."""
     rargs = tuple(_unwrap(a) for a in rargs)
     if len(rargs) == 1:
         start, stop, step = 0, rargs[0], 1
@@ -142,7 +170,7 @@ def __pt_for_range__(rargs, body_fn, state, prior=None, has_prior=False):
         start, stop, step = rargs
     if not any(isinstance(a, jax.core.Tracer)
                for a in (start, stop, step)):
-        i = prior if has_prior else None
+        i = prior if has_prior else _UnboundLoopVar(name)
         for i in range(int(start), int(stop), int(step)):
             state = body_fn(i, *state)
         return (i,) + tuple(state)
@@ -617,6 +645,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
                     ast.keyword(arg="has_prior",
                                 value=ast.Constant(
                                     value=ivar in self.bound)),
+                    ast.keyword(arg="name",
+                                value=ast.Constant(value=ivar)),
                 ]))
         return [bdef, call]
 

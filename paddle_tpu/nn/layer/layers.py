@@ -134,6 +134,7 @@ class Layer:
         init = default_initializer
         name = None
         learning_rate = 1.0
+        regularizer = None
         if attr is not None and attr is not False:
             from .. import ParamAttr
 
@@ -141,6 +142,7 @@ class Layer:
                 init = attr.initializer or init
                 name = attr.name
                 learning_rate = attr.learning_rate
+                regularizer = attr.regularizer
             elif isinstance(attr, str):
                 name = attr
         if init is None:
@@ -148,6 +150,10 @@ class Layer:
         data = _apply_initializer(init, shape, dtype)
         p = Parameter(data, dtype=dtype, name=name)
         p.optimize_attr["learning_rate"] = learning_rate
+        if regularizer is not None:
+            # ParamAttr regularizer overrides the optimizer-level one
+            # (reference priority: python/paddle/regularizer.py docstring)
+            p.regularizer = regularizer
         return p
 
     def create_tensor(self, name=None, persistable=False, dtype=None):

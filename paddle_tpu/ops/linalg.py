@@ -31,7 +31,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
         return jnp.matmul(a, b)
-    return apply(fn, x, y, op_name="matmul")
+    return apply(fn, x, y, op_name="matmul",
+                 op_key=("matmul", transpose_x, transpose_y))
 
 
 def bmm(x, y, name=None):

@@ -246,7 +246,8 @@ def _reduce(op_name, fn, differentiable=True):
             if dtype is not None:
                 kw["dtype"] = convert_dtype(dtype)
             return fn(a, axis=ax, keepdims=keepdim, **kw)
-        return apply(run, x, op_name=op_name, differentiable=differentiable)
+        return apply(run, x, op_name=op_name, differentiable=differentiable,
+                     op_key=(op_name, ax, keepdim, str(dtype)))
     op.__name__ = op_name
     return op
 

@@ -71,6 +71,16 @@ class ClipGradByGlobalNorm:
                 for g in grads_flat]
 
 
+
+def _decay_tag(g, arr, wd):
+    """Apply a weight-decay tag inside the fused update: a float is L2
+    (grad += wd * param); an ("l1", coeff) tag from
+    paddle_tpu.regularizer.L1Decay adds coeff * sign(param)."""
+    if isinstance(wd, tuple):
+        return g + wd[1] * jnp.sign(arr)
+    return g + wd * arr
+
+
 class Optimizer:
     _hyperparams: tuple = ()
 
@@ -91,7 +101,9 @@ class Optimizer:
             p for g in self._param_groups for p in g["params"]
         ]
         self._learning_rate = learning_rate
-        self._weight_decay = weight_decay if weight_decay is not None else 0.0
+        from ..regularizer import _normalize_weight_decay
+
+        self._weight_decay = _normalize_weight_decay(weight_decay)
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._accumulators: Dict[int, dict] = {}
@@ -115,13 +127,21 @@ class Optimizer:
                 if getattr(p, "optimize_attr", None):
                     attr_mult = float(
                         p.optimize_attr.get("learning_rate", 1.0))
-                wd = float(g_wd) if g_wd is not None else None
+                wd = _normalize_weight_decay(g_wd) \
+                    if g_wd is not None else None
                 self._per_param[id(p)] = (g_lr_mult * attr_mult, wd)
 
     def _param_lr_wd(self, p, index):
         """Resolve (lr multiplier, weight decay) for one parameter,
-        honoring groups and apply_decay_param_fun/exclude fns."""
+        honoring ParamAttr regularizers (highest priority, reference
+        semantics), groups, and apply_decay_param_fun/exclude fns."""
+        from ..regularizer import (WeightDecayRegularizer,
+                                   _normalize_weight_decay)
+
         lr_mult, wd = self._per_param.get(id(p), (1.0, None))
+        reg = getattr(p, "regularizer", None)
+        if isinstance(reg, WeightDecayRegularizer):
+            wd = _normalize_weight_decay(reg)
         if wd is None:
             wd = self._weight_decay
         fn = getattr(self, "_apply_decay_param_fun", None)
@@ -262,7 +282,7 @@ class SGD(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         return (p - (lr * g).astype(p.dtype)), {}
 
 
@@ -281,7 +301,7 @@ class Momentum(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         v = self._momentum * state["velocity"] + g
         if self._nesterov:
             upd = g + self._momentum * v
@@ -315,7 +335,7 @@ class Adam(Optimizer):
         pf = p.astype(jnp.float32)
         t = state["_step"]
         if wd and not self._decoupled():
-            g = g + wd * pf
+            g = _decay_tag(g, pf, wd)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
         mhat = m / (1 - self._beta1 ** t)
@@ -329,7 +349,7 @@ class Adam(Optimizer):
             denom = jnp.sqrt(vhat) + self._eps
         upd = mhat / denom
         if wd and self._decoupled():
-            upd = upd + wd * pf
+            upd = _decay_tag(upd, pf, wd)
         return (pf - lr * upd).astype(p.dtype), new_state
 
 
@@ -362,7 +382,7 @@ class Adamax(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         t = state["_step"]
         m = self._beta1 * state["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
@@ -386,7 +406,7 @@ class Adagrad(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         acc = state["moment"] + g * g
         upd = lr * g / (jnp.sqrt(acc) + self._eps)
         return (p.astype(jnp.float32) - upd).astype(p.dtype), {"moment": acc}
@@ -406,7 +426,7 @@ class Adadelta(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
         upd = g * jnp.sqrt(state["avg_squared_update"] + self._eps) \
             / jnp.sqrt(asg + self._eps)
@@ -434,7 +454,7 @@ class RMSProp(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
         new_state = {"mean_square": ms}
         if self._centered:
@@ -470,7 +490,7 @@ class Lamb(Optimizer):
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
-        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * pf
+        r = _decay_tag(mhat / (jnp.sqrt(vhat) + self._eps), pf, wd)
         w_norm = jnp.sqrt(jnp.sum(pf * pf))
         r_norm = jnp.sqrt(jnp.sum(r * r))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
@@ -484,7 +504,7 @@ class NAdam(Adam):
         pf = p.astype(jnp.float32)
         t = state["_step"]
         if wd:
-            g = g + wd * pf
+            g = _decay_tag(g, pf, wd)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
         mhat = (self._beta1 * m / (1 - self._beta1 ** (t + 1))
@@ -500,7 +520,7 @@ class RAdam(Adam):
         pf = p.astype(jnp.float32)
         t = state["_step"]
         if wd:
-            g = g + wd * pf
+            g = _decay_tag(g, pf, wd)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
         mhat = m / (1 - self._beta1 ** t)
@@ -605,7 +625,7 @@ class ASGD(Optimizer):
     def _update(self, p, g, state, lr, wd):
         g = g.astype(jnp.float32)
         if wd:
-            g = g + wd * p.astype(jnp.float32)
+            g = _decay_tag(g, p.astype(jnp.float32), wd)
         m = state["m"]
         idx = (m % self._n).astype(jnp.int32)
         old = state["ys"][idx]

@@ -17,3 +17,16 @@ def translate_to_pir(program_desc):
 
 def check_unregistered_ops(program_desc):
     return []
+
+
+class IrGuard:
+    """reference: paddle.IrGuard (python/paddle/pir_utils.py) — switches
+    the process between the legacy program IR and PIR. This framework has
+    ONE IR (the recorded Program lowering through jax/StableHLO), so the
+    guard is a no-op context manager kept for script compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,86 @@
+"""paddle.regularizer — weight-decay regularizers.
+
+Reference analog: python/paddle/regularizer.py (L1Decay/L2Decay applied by
+appending the regularization gradient during the optimizer update). Here
+the regularizer resolves to a tag the optimizers fold into their fused
+jitted update (optimizer/optimizer.py::_decay_grad): L2 adds
+``coeff * param`` to the gradient, L1 adds ``coeff * sign(param)`` —
+inside the same XLA executable as the main update, so regularization
+costs no extra dispatch.
+
+Accepted anywhere the reference accepts a regularizer: the optimizer's
+``weight_decay`` argument, per-parameter-group ``weight_decay``, and
+``ParamAttr(regularizer=...)``.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    """Base class of weight-decay regularizers (interface parity with the
+    reference base class)."""
+
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+    def _wd_tag(self):
+        """Hashable tag consumed by the optimizers' fused update."""
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param) (sparsity-inducing).
+
+    reference: python/paddle/regularizer.py L1Decay (L1DecayRegularizer).
+    """
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        from .ops.math import sign
+
+        return grad + sign(param) * self._coeff
+
+    def _wd_tag(self):
+        return ("l1", self._coeff)
+
+    def __str__(self):
+        return f"L1Decay, coeff={self._coeff}"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param.
+
+    reference: python/paddle/regularizer.py L2Decay (L2DecayRegularizer).
+    """
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __call__(self, param, grad):
+        return grad + param * self._coeff
+
+    def _wd_tag(self):
+        return self._coeff      # identical math to the float fast path
+
+    def __str__(self):
+        return f"L2Decay, coeff={self._coeff}"
+
+
+def _normalize_weight_decay(wd):
+    """float | L1Decay | L2Decay | None -> hashable update tag."""
+    if wd is None:
+        return 0.0
+    if isinstance(wd, WeightDecayRegularizer):
+        return wd._wd_tag()
+    return float(wd)

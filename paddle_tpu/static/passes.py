@@ -194,51 +194,65 @@ def fuse_chain(program, names, fused_name=None):
     """DRR-style chain rewrite: wherever op `names[k]`'s single output
     feeds exactly op `names[k+1]` (and nothing else), collapse the chain
     into ONE fused op entry (XLA fuses the bodies; the rewrite makes the
-    fusion explicit in the op list like the reference's DRR patterns)."""
+    fusion explicit in the op list like the reference's DRR patterns).
+
+    Single pass over the op list with one consumer index — O(n·k) for an
+    n-op program and k-op pattern (the round-3 version rescanned from
+    scratch after every fusion: O(n²·k))."""
     fused_name = fused_name or "fused_" + "_".join(names)
     fetch_uids = {type(program)._uid(f) for f in program.fetch_targets}
-    changed = True
-    while changed:
-        changed = False
-        consumers = {}
-        for idx, entry in enumerate(program.ops):
-            for u in entry[4]:
-                consumers.setdefault(u, []).append(idx)
-        for start in range(len(program.ops)):
-            chain = [start]
-            ok = program.ops[start][0] == names[0]
-            for k in range(1, len(names)):
-                if not ok:
-                    break
-                prev = program.ops[chain[-1]]
-                outs = prev[7]
-                if len(outs) != 1 or outs[0] in fetch_uids:
-                    ok = False
-                    break
-                cons = consumers.get(outs[0], [])
-                if len(cons) != 1 or \
-                        program.ops[cons[0]][0] != names[k]:
-                    ok = False
-                    break
-                chain.append(cons[0])
-            if not ok or len(chain) != len(names):
-                continue
-            entries = [program.ops[i] for i in chain]
-            later = {u for idx2, e in enumerate(program.ops)
-                     if idx2 not in chain for u in e[4]}
-            in_uids, out_uids = _region_io(entries, later, fetch_uids)
-            fn = _compose_entries(entries, in_uids, out_uids)
-            fused = (fused_name, fn, [None] * len(in_uids),
-                     list(range(len(in_uids))), in_uids,
-                     _args_treedef(len(in_uids)),
-                     list(range(len(out_uids))), out_uids)
-            keep = [e for i, e in enumerate(program.ops)
-                    if i not in chain[:-1]]
-            keep[keep.index(entries[-1])] = fused
-            program.ops = keep
-            program._compiled.clear()
-            changed = True
-            break
+    ops = program.ops
+    consumers = {}
+    for idx, entry in enumerate(ops):
+        for u in entry[4]:
+            consumers.setdefault(u, []).append(idx)
+
+    used = set()            # op indices already claimed by a chain
+    chains = []
+    for start in range(len(ops)):
+        if start in used or ops[start][0] != names[0]:
+            continue
+        chain = [start]
+        ok = True
+        for k in range(1, len(names)):
+            prev = ops[chain[-1]]
+            outs = prev[7]
+            if len(outs) != 1 or outs[0] in fetch_uids:
+                ok = False
+                break
+            cons = consumers.get(outs[0], [])
+            if len(cons) != 1 or cons[0] in used \
+                    or ops[cons[0]][0] != names[k]:
+                ok = False
+                break
+            chain.append(cons[0])
+        if ok and len(chain) == len(names):
+            chains.append(chain)
+            used.update(chain)
+    if not chains:
+        return program
+
+    replacement = {}        # last-op index -> fused entry
+    drop = set()
+    for chain in chains:
+        chain_set = set(chain)
+        entries = [ops[i] for i in chain]
+        # 'later' only needs membership for uids the chain PRODUCES:
+        # a produced uid is externally alive iff some consumer index
+        # lies outside the chain (consumer lists, not a full rescan)
+        later = {u for e in entries for u in e[7]
+                 if any(c not in chain_set for c in consumers.get(u, []))}
+        in_uids, out_uids = _region_io(entries, later, fetch_uids)
+        fn = _compose_entries(entries, in_uids, out_uids)
+        replacement[chain[-1]] = (
+            fused_name, fn, [None] * len(in_uids),
+            list(range(len(in_uids))), in_uids,
+            _args_treedef(len(in_uids)),
+            list(range(len(out_uids))), out_uids)
+        drop.update(chain[:-1])
+    program.ops = [replacement.get(i, e) for i, e in enumerate(ops)
+                   if i not in drop]
+    program._compiled.clear()
     return program
 
 
@@ -294,7 +308,45 @@ def amp_insertion(program, dtype="bfloat16", custom_white=(),
             new_in.append(cu)
         new_ops.append((name, fn, entry_flat, tpos, new_in, treedef,
                         out_pos, out_uids))
-    program.ops = new_ops
+
+    # O1-faithful output casts (reference auto_parallel_amp.py): a
+    # whitelist op's low-precision output must reach NON-white consumers
+    # and fetched values as fp32. The white entry is rewired to a fresh
+    # uid; low-precision consumers (the cast-to-low entries inserted
+    # above) read that uid; a cast back to fp32 re-produces the ORIGINAL
+    # uid, which gray ops, black-side casts and fetches keep consuming.
+    fetch_uids = {type(program)._uid(f) for f in program.fetch_targets}
+    low_cast_name = f"cast_{jnp.dtype(low)}"
+    consumers = {}
+    for idx, e in enumerate(new_ops):
+        for u in e[4]:
+            consumers.setdefault(u, []).append(idx)
+    final_ops = []
+    for idx, entry in enumerate(new_ops):
+        name = entry[0]
+        if name not in white:
+            final_ops.append(new_ops[idx])
+            continue
+        outs = list(entry[7])
+        back_casts = []
+        for oi, u in enumerate(outs):
+            external = u in fetch_uids or any(
+                new_ops[c][0] != low_cast_name
+                for c in consumers.get(u, []))
+            if not external:
+                continue
+            v = _new_uid(program)
+            outs[oi] = v
+            for c in consumers.get(u, []):
+                if new_ops[c][0] == low_cast_name:
+                    ce = new_ops[c]
+                    new_ops[c] = ce[:4] + (
+                        [v if x == u else x for x in ce[4]],) + ce[5:]
+            back_casts.append(cast_entry(v, u, jnp.float32, "fp32out"))
+        entry = new_ops[idx]
+        final_ops.append(entry[:7] + (outs,))
+        final_ops.extend(back_casts)
+    program.ops = final_ops
     program._compiled.clear()
     return program
 

@@ -1,0 +1,20 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building extensions against the framework)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def _root():
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of framework headers (the native runtime's csrc ships in
+    the sdist; installed wheels expose this package directory)."""
+    return os.path.join(_root(), "include")
+
+
+def get_lib():
+    """Directory of the native runtime library (csrc/pt_runtime)."""
+    return os.path.join(_root(), "lib")

@@ -1,0 +1,111 @@
+"""Whole-sweep cached backward (VERDICT r3 #2): the eager tape's reverse
+sweep compiles to ONE jitted composite per graph signature, replacing the
+per-node pullback dispatch loop.
+
+Reference analog: the all-C++ eager engine RunBackward
+(paddle/fluid/eager/backward.cc:105) — there the walk is native; here the
+walk is host-side but every FLOP of the sweep is one executable.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core import autograd
+
+
+def _r(shape, seed):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def test_sweep_matches_jax_grad_diamond():
+    """Shared-input diamond (x feeds two branches that re-merge) — the
+    cotangent accumulation inside the sweep must sum both paths."""
+    x = paddle.to_tensor(_r((8, 8), 0))
+    y = paddle.to_tensor(_r((8, 8), 1))
+    x.stop_gradient = False
+    y.stop_gradient = False
+
+    def f(a, b):
+        u = a @ b
+        v = a * 2.0
+        return jnp.sum(u + v + a)
+
+    for _ in range(3):  # cold (legacy), trace, cached+sweep steady state
+        z = (paddle.matmul(x, y) + x * 2.0 + x).sum()
+        z.backward()
+        gx, gy = x.grad.numpy(), y.grad.numpy()
+        x.clear_grad()
+        y.clear_grad()
+    ref_x = jax.grad(f, argnums=0)(x._value, y._value)
+    ref_y = jax.grad(f, argnums=1)(x._value, y._value)
+    np.testing.assert_allclose(gx, np.asarray(ref_x), rtol=1e-5)
+    np.testing.assert_allclose(gy, np.asarray(ref_y), rtol=1e-5)
+    assert len(autograd._sweep_cache) >= 1
+
+
+def test_sweep_grad_accumulation_across_calls():
+    """Without clear_grad, .grad accumulates across backward calls —
+    sweep and engine semantics must agree."""
+    x = paddle.to_tensor(_r((4, 4), 2))
+    x.stop_gradient = False
+    for i in range(3):
+        (x * x).sum().backward()
+    expect = 3 * 2 * x.numpy()
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_sweep_retain_graph_allows_second_backward():
+    x = paddle.to_tensor(_r((4, 4), 3))
+    x.stop_gradient = False
+    z = (x * 3.0).sum()
+    z.backward(retain_graph=True)
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               np.full((4, 4), 6.0, np.float32),
+                               rtol=1e-6)
+
+
+def test_sweep_released_graph_raises():
+    x = paddle.to_tensor(_r((4, 4), 4))
+    x.stop_gradient = False
+    z = (x * 3.0).sum()
+    z.backward()
+    with pytest.raises(RuntimeError):
+        z.backward()
+
+
+def test_hooks_fall_back_and_fire():
+    """A leaf hook makes the graph sweep-ineligible; the engine path must
+    still run and fire the hook on the accumulated grad."""
+    x = paddle.to_tensor(_r((4, 4), 5))
+    x.stop_gradient = False
+    seen = []
+    x.register_hook(lambda g: seen.append(np.asarray(g.numpy()).copy()))
+    (x * 2.0).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], np.full((4, 4), 2.0, np.float32))
+
+
+def test_nonscalar_root_with_explicit_seed():
+    x = paddle.to_tensor(_r((3, 3), 6))
+    x.stop_gradient = False
+    z = x * x
+    seed = paddle.to_tensor(np.full((3, 3), 0.5, np.float32))
+    autograd.backward([z], [seed])
+    np.testing.assert_allclose(x.grad.numpy(), x.numpy(), rtol=1e-5)
+
+
+def test_sweep_cache_reused_across_iterations():
+    autograd._sweep_cache.clear()
+    x = paddle.to_tensor(_r((8, 8), 7))
+    y = paddle.to_tensor(_r((8, 8), 8))
+    x.stop_gradient = False
+    for _ in range(6):
+        (paddle.matmul(x, y)).sum().backward()
+        x.clear_grad()
+    # one signature -> at most a couple of cache entries (cold-start
+    # iterations may record legacy nodes with a different pull structure)
+    assert 1 <= len(autograd._sweep_cache) <= 2

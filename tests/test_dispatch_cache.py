@@ -273,3 +273,72 @@ def test_seed_reproducible_across_cache_states():
         _ = paddle.matmul(x, x)             # warm, draws nothing
         b = F.dropout(x, 0.5, training=True).numpy()
     np.testing.assert_array_equal(a, b)
+
+
+def test_rng_op_during_other_ops_probe_keeps_fast_path():
+    """ADVICE r3: a cached RNG op invoked while another op's deferred
+    probe guard is active must materialize the guard (as next_key does)
+    instead of feeding the sentinel to fold_in and burning its cache
+    entry."""
+    dispatch.clear_op_cache()
+    x = _t(np.ones((16, 16), np.float32))
+    with paddle.no_grad():
+        paddle.seed(3)
+        # warm dropout to the cached state (probe, trace, steady)
+        for _ in range(3):
+            F.dropout(x, 0.5, training=True)
+
+        def outer(a):
+            # runs under the OUTER op's deferred probe guard; the inner
+            # dropout dispatch is a nested eager call only on the probe
+            # run (host-side), exercising _next_rng_inputs under guard
+            return a * 2.0
+
+        from paddle_tpu.core.dispatch import apply
+
+        # probe an op while issuing a cached RNG op between dispatches
+        from paddle_tpu.framework import random as rnd
+        with rnd.deferred_rng_guard():
+            out = F.dropout(x, 0.5, training=True)  # cached RNG op
+        assert out.shape == x.shape
+    # the dropout entry must not be disabled
+    stats = dispatch.op_cache_stats()
+    assert stats["disabled"] == 0, stats
+
+
+def test_transient_cache_failure_retries_before_disable():
+    """ADVICE r3: a transient cached-executable failure falls back to
+    legacy for that call but re-enables the fast path; only repeated
+    failures pin the signature to the slow path."""
+    dispatch.clear_op_cache()
+    x = _t(np.ones((4, 4), np.float32))
+    with paddle.no_grad():
+        r = None
+        for _ in range(3):
+            r = paddle.matmul(x, x)
+    key, entry = next(iter(dispatch._op_cache.items()))
+    assert entry.fwd is not None
+
+    class Boom:
+        def __call__(self, *a, **k):
+            raise RuntimeError("transient device flake")
+
+    entry.fwd = Boom()                      # simulate a transient failure
+    with paddle.no_grad():
+        out = paddle.matmul(x, x)           # legacy fallback, no raise
+    np.testing.assert_allclose(out.numpy(), r.numpy())
+    assert not entry.disabled and entry.fails == 1
+    with paddle.no_grad():
+        paddle.matmul(x, x)                 # rebuilds fwd, succeeds
+    assert entry.fwd is not None and not isinstance(entry.fwd, Boom)
+    # three failures pin it
+    import warnings as _w
+
+    entry.fails = 2
+    entry.fwd = Boom()
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        with paddle.no_grad():
+            paddle.matmul(x, x)
+    assert entry.disabled and entry.fails == 3
+    assert any("legacy eager path" in str(w.message) for w in rec)
