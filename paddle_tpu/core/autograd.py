@@ -440,7 +440,17 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
             if out_t is not None and out_t._hooks:
                 return False
         pull = node.vjp_fn
-        pull = getattr(pull, "pull", pull)
+        # Only cached-dispatch pullbacks participate: their Partial
+        # treedefs come from one jitted lowering and are STABLE across
+        # calls, so the sweep key repeats. A raw jax.vjp pullback
+        # (legacy path: cold entries, uncacheable ops) materializes a
+        # fresh closure per call — its treedef never repeats, and keying
+        # on it would recompile the whole sweep every backward.
+        from .dispatch import _CachedPullback
+
+        if not isinstance(pull, _CachedPullback):
+            return False
+        pull = pull.pull
         leaves, pull_td = jax.tree.flatten(pull)
         for lf in leaves:
             if not isinstance(lf, (jax.Array, _np.ndarray, float, int,

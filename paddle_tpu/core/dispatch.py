@@ -311,8 +311,7 @@ def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
                 diff_pos.append(i)
     record = bool(diff_pos)
 
-    if (_cache_enabled and cacheable
-            and _ProgramRecorder.active is None):
+    if _cache_enabled and cacheable:
         result = _apply_cached(fn, name, flat, treedef, tensor_pos,
                                diff_pos, record, op_key)
         if result is not _MISS:
@@ -481,7 +480,13 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
     if _flags.flag("check_nan_inf"):
         check_nan_inf(name, jax.tree.leaves(out))
     _observe(name, jax.tree.leaves(out))
-    return _wrap_outputs(out, node=None)
+    wrapped = _wrap_outputs(out, node=None)
+    if _ProgramRecorder.active is not None:
+        # recording no longer forces legacy dispatch (VERDICT r3 #3a):
+        # the cached executable ran; append the entry like legacy does
+        _ProgramRecorder.active._record(
+            name, fn, flat, tensor_pos, treedef, wrapped)
+    return wrapped
 
 
 def _make_run(fn, flat, treedef, diff_pos):

@@ -77,6 +77,10 @@ def _get_cache_or_reload(repo, force_reload, source):
     with zipfile.ZipFile(zip_path) as zf:
         top = zf.namelist()[0].split("/")[0]
         zf.extractall(home)
+    if os.path.isdir(repo_dir):        # force_reload over a prior cache
+        import shutil
+
+        shutil.rmtree(repo_dir)
     os.replace(os.path.join(home, top), repo_dir)
     os.unlink(zip_path)
     return repo_dir

@@ -70,19 +70,35 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
         if sin is not None:
             sa, ca = rest[i], rest[i + 1]
             i += 2
+            if position_ids is not None:
+                # reference contract: provided sin/cos TABLES are
+                # indexed by position_ids (kv-cached decode offsets)
+                pid = rest[i].astype(jnp.int32)
+                i += 1
+                d_last = sa.shape[-1]
+                sa = sa.reshape(-1, d_last)[pid][:, :, None, :]
+                ca = ca.reshape(-1, d_last)[pid][:, :, None, :]
         else:
             s = qa.shape[1]
             d = qa.shape[-1]
             inv = 1.0 / (rotary_emb_base ** (
                 jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-            pos = jnp.arange(s, dtype=jnp.float32)
-            freqs = jnp.outer(pos, inv)
+            if position_ids is not None:
+                # absolute positions [B, S] (or [1, S] broadcast): the
+                # kv-cached decode path rotates appended chunks at
+                # their true offsets (reference position_ids contract)
+                pid = rest[i].astype(jnp.float32)
+                i += 1
+                freqs = pid[..., None] * inv          # [B, S, d/2]
+            else:
+                pos = jnp.arange(s, dtype=jnp.float32)
+                freqs = jnp.outer(pos, inv)[None]     # [1, S, d/2]
             if use_neox_rotary_style:
                 emb = jnp.concatenate([freqs, freqs], axis=-1)
             else:
                 emb = jnp.repeat(freqs, 2, axis=-1)
-            ca = jnp.cos(emb)[None, :, None, :]
-            sa = jnp.sin(emb)[None, :, None, :]
+            ca = jnp.cos(emb)[:, :, None, :]
+            sa = jnp.sin(emb)[:, :, None, :]
         ca = ca.astype(jnp.float32)
         sa = sa.astype(jnp.float32)
         outs = []
@@ -98,6 +114,8 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     args = [q] + [t for t in (k, v) if t is not None]
     if sin is not None:
         args += [sin, cos]
+    if position_ids is not None:
+        args += [position_ids]
     outs = apply(fn, *args, op_name="fused_rope")
     result = []
     it = iter(outs if isinstance(outs, tuple) else (outs,))

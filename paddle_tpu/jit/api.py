@@ -124,6 +124,42 @@ class InputSpec:
         self.stop_gradient = stop_gradient
 
 
+def _unwrap_arg(a):
+    if isinstance(a, Tensor):
+        return a._value
+    if isinstance(a, (list, tuple, dict)):
+        return jax.tree.map(
+            lambda t: t._value if isinstance(t, Tensor) else t, a,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    return a
+
+
+def _wrap_arg(a):
+    if isinstance(a, (list, tuple, dict)):
+        return jax.tree.map(
+            lambda v: Tensor(v, stop_gradient=True)
+            if isinstance(v, jax.Array) else v, a)
+    if isinstance(a, jax.Array):
+        return Tensor(a, stop_gradient=True)
+    return a
+
+
+def _is_dynamic_arg(a):
+    """Array-like args trace; everything else (ints, strs, None, flags)
+    is STATIC — part of the program spec, like the reference's
+    to_static non-tensor arguments (a generate loop's max_new_tokens
+    must shape buffers, not become a traced scalar)."""
+    import numpy as _np
+
+    if isinstance(a, (Tensor, jax.Array, _np.ndarray)):
+        return True
+    if isinstance(a, (list, tuple, dict)):
+        return any(isinstance(l, (Tensor, jax.Array, _np.ndarray))
+                   for l in jax.tree.leaves(
+                       a, is_leaf=lambda x: isinstance(x, Tensor)))
+    return False
+
+
 class StaticFunction:
     """A compiled callable over a Layer or plain function."""
 
@@ -137,7 +173,7 @@ class StaticFunction:
         self._compiled = None
         self._n_calls = 0
 
-    def _build_layer_fn(self):
+    def _build_layer_fn(self, static_pos=()):
         layer = self._target
 
         def pure(params, buffers, seed, *in_arrays):
@@ -148,25 +184,29 @@ class StaticFunction:
                     else self._train)
             return out, new_buf
 
-        return jax.jit(pure)
+        return jax.jit(pure,
+                       static_argnums=tuple(p + 3 for p in static_pos))
 
-    def _build_fn(self):
+    def _build_fn(self, static_pos=()):
         fn = self._target
 
         def pure(seed, *in_arrays, **kw):
             with _TracingGuard(), rng_guard(seed), no_grad():
-                ins = [Tensor(a, stop_gradient=True) for a in in_arrays]
+                ins = [_wrap_arg(a) for a in in_arrays]
                 out = fn(*ins, **kw)
             return jax.tree.map(
                 lambda x: x._value if isinstance(x, Tensor) else x, out,
                 is_leaf=lambda x: isinstance(x, Tensor))
 
-        return jax.jit(pure)
+        return jax.jit(pure,
+                       static_argnums=tuple(p + 1 for p in static_pos))
 
     def __call__(self, *args, **kwargs):
         if getattr(self, "_fallback", False):
             return self._eager_call(*args, **kwargs)
-        in_arrays = [a._value if isinstance(a, Tensor) else a for a in args]
+        # pytree-aware: Tensors nested in list/tuple/dict args (kv-cache
+        # lists, state dicts) unwrap to array pytrees for the jit
+        in_arrays = [_unwrap_arg(a) for a in args]
         seed = next_key()
         try:
             return self._run_compiled(seed, in_arrays, kwargs)
@@ -223,19 +263,39 @@ class StaticFunction:
                 converted = convert_layer_tree(v) or converted
         return converted
 
+    @staticmethod
+    def _static_positions(in_arrays):
+        def hashable(a):
+            try:
+                hash(a)
+            except TypeError:
+                return False
+            return True
+
+        return tuple(i for i, a in enumerate(in_arrays)
+                     if not _is_dynamic_arg(a) and hashable(a))
+
     def _run_compiled(self, seed, in_arrays, kwargs):
+        # non-tensor hashable args are STATIC (jit specializes per
+        # value): a generate loop's max_new_tokens/eos_token_id shape
+        # the program instead of becoming traced scalars
+        static_pos = self._static_positions(in_arrays)
+        if not isinstance(self._compiled, dict):
+            self._compiled = {}
+        jitted = self._compiled.get(static_pos)
         if self._is_layer:
-            if self._compiled is None:
-                self._compiled = self._build_layer_fn()
+            if jitted is None:
+                jitted = self._compiled[static_pos] = \
+                    self._build_layer_fn(static_pos)
             params = FB.current_params(self._target)
             buffers = FB.current_buffers(self._target)
-            out, new_buf = self._compiled(params, buffers, seed,
-                                          *in_arrays)
+            out, new_buf = jitted(params, buffers, seed, *in_arrays)
             FB.write_back(self._target, {}, new_buf)
         else:
-            if self._compiled is None:
-                self._compiled = self._build_fn()
-            out = self._compiled(seed, *in_arrays, **kwargs)
+            if jitted is None:
+                jitted = self._compiled[static_pos] = \
+                    self._build_fn(static_pos)
+            out = jitted(seed, *in_arrays, **kwargs)
         return jax.tree.map(lambda x: Tensor(x), out)
 
     def _eager_call(self, *args, **kwargs):

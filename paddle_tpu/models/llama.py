@@ -132,8 +132,20 @@ class LlamaAttention(nn.Layer):
             [b, s, cfg.num_key_value_heads, cfg.head_dim])
         v = self.v_proj(x).reshape(
             [b, s, cfg.num_key_value_heads, cfg.head_dim])
+        prev_len = 0
+        if kv_cache is not None:
+            prev_len = int(kv_cache[0].shape[1])
+        # rope at ABSOLUTE positions: a decode chunk appended after
+        # prev_len cached tokens rotates at prev_len..prev_len+s-1
+        pos_ids = None
+        if prev_len or position_offset:
+            off = prev_len + position_offset
+            from ..ops.creation import arange
+
+            pos_ids = (arange(s, dtype="int64") + off).reshape([1, s])
         q, k, _ = fused_rotary_position_embedding(
-            q, k, None, rotary_emb_base=cfg.rope_theta)
+            q, k, None, position_ids=pos_ids,
+            rotary_emb_base=cfg.rope_theta)
         if kv_cache is not None:
             k_prev, v_prev = kv_cache
             from ..ops.manipulation import concat
@@ -149,8 +161,10 @@ class LlamaAttention(nn.Layer):
 
             k = repeat_interleave(k, rep, axis=2)
             v = repeat_interleave(v, rep, axis=2)
+        # causal whenever the query chunk spans >1 position (prefill with
+        # or without a cache); a 1-token decode attends the full prefix
         out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=kv_cache is None)
+            q, k, v, is_causal=s > 1)
         out = out.reshape([b, s, cfg.hidden_size])
         out = self.o_proj(out)
         if new_cache is not None:
@@ -285,7 +299,7 @@ class LlamaForCausalLM(nn.Layer):
         return cls(copy.deepcopy(LLAMA_PRESETS[name]))
 
     # -- greedy generation with KV cache (deployment parity) ---------------
-    def generate(self, input_ids, max_new_tokens=32):
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None):
         from ..core.autograd import no_grad
         from ..ops.manipulation import concat
         from ..ops.search import argmax
@@ -306,9 +320,44 @@ class LlamaForCausalLM(nn.Layer):
             cur = argmax(logits[:, -1], axis=-1).reshape([b, 1])
             for _ in range(max_new_tokens):
                 out = concat([out, cur], axis=1)
+                if eos_token_id is not None and bool(
+                        (cur == eos_token_id).all()):
+                    break
                 logits, caches = self.forward(cur, kv_caches=caches)
                 cur = argmax(logits[:, -1], axis=-1).reshape([b, 1])
             return out
+
+    def generate_static(self, input_ids, max_new_tokens=32,
+                        eos_token_id=None):
+        """Compile-friendly greedy decode — the dy2static target
+        (VERDICT r3 #5): a FIXED-size token buffer, the EOS early-exit as
+        a `break` (lowered to a carried stop-flag in lax.while_loop), and
+        traced write positions via put_along_axis. One executable under
+        @to_static, plain Python semantics eagerly; matches `generate`
+        token-for-token on the generated prefix. (KV-cached decoding at
+        serving efficiency lives in inference/serving.py; this path
+        recomputes the prefix each step.) Returns the [b, s0+max_new]
+        buffer; positions beyond an EOS stop hold padding zeros."""
+        from ..ops.creation import zeros
+        from ..ops.manipulation import concat, put_along_axis, \
+            take_along_axis
+        from ..ops.search import argmax
+
+        b = input_ids.shape[0]
+        s0 = input_ids.shape[1]
+        pad = zeros([b, max_new_tokens], dtype="int64")
+        buf = concat([input_ids.astype("int64"), pad], axis=1)
+        zero_idx = zeros([b, 1], dtype="int64")
+        for i in range(max_new_tokens):
+            logits = self.forward(buf)               # causal: tail inert
+            read = zeros([b, 1, 1], dtype="int64") + (i + s0 - 1)
+            last = take_along_axis(logits, read, axis=1)   # [b, 1, V]
+            nxt = argmax(last, axis=-1)                    # [b, 1]
+            buf = put_along_axis(buf, zero_idx + (i + s0), nxt, axis=1)
+            if eos_token_id is not None:
+                if (nxt == eos_token_id).all():
+                    break
+        return buf
 
 
 # ---------------------------------------------------------------------------

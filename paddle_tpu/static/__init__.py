@@ -110,10 +110,120 @@ class Program:
     def __str__(self):
         lines = [f"Program({len(self.ops)} ops, "
                  f"{len(self.feed_targets)} feeds)"]
-        for name, _, _, _, in_uids, _, _, out_uids in self.ops:
-            lines.append(f"  {name}({len(in_uids)} in) -> "
-                         f"{len(out_uids)} out")
+
+        def emit(ops, indent):
+            for entry in ops:
+                name, in_uids, out_uids = entry[0], entry[4], entry[7]
+                lines.append(f"{indent}{name}({len(in_uids)} in) -> "
+                             f"{len(out_uids)} out")
+                for tag, sub in getattr(entry, "regions", ()):
+                    lines.append(f"{indent}  region[{tag}] "
+                                 f"({len(sub.ops)} ops):")
+                    emit(sub.ops, indent + "    ")
+
+        emit(self.ops, "  ")
         return "\n".join(lines)
+
+
+class RegionEntry(tuple):
+    """A recorded op that CONTAINS sub-programs — the PIR Region/Block
+    analog (reference: paddle/pir/include/core/region.h, operation.h —
+    an Operation owning regions of blocks, so control flow lives inside
+    the IR and passes can traverse it).
+
+    Layout-compatible with plain 8-tuple entries (name, fn, entry_flat,
+    tensor_pos, in_uids, treedef, out_positions, out_uids), plus
+    `.regions`: a list of (tag, Program) — e.g. [("true", p), ("false",
+    p)] for a cond, [("test", p), ("body", p)] for a while. The entry's
+    executable `fn` REPLAYS the sub-programs under lax.cond/while_loop,
+    so pass edits inside a region change what executes."""
+
+    def __new__(cls, entry, regions):
+        self = super().__new__(cls, entry)
+        self.regions = list(regions)
+        return self
+
+
+@contextlib.contextmanager
+def _sub_recorder(sub):
+    """Route dispatcher recording into `sub` (a fresh Program) without
+    touching the default main/startup globals."""
+    from ..core import tensor as _tensor_mod
+    from ..core.dispatch import _ProgramRecorder
+
+    prev = _ProgramRecorder.active
+    prev_t = _tensor_mod._prog_recording[0]
+    _ProgramRecorder.active = sub
+    _tensor_mod._prog_recording[0] = sub
+    try:
+        yield sub
+    finally:
+        _ProgramRecorder.active = prev
+        _tensor_mod._prog_recording[0] = prev_t
+
+
+def capture_region(branch_fn, state_tensors):
+    """Run `branch_fn` over fresh placeholder wrappers of
+    `state_tensors` while recording into a new sub-Program. Returns
+    (sub_program, in_uids, out_uids, outputs). The sub-program's
+    fetch_targets are the branch outputs so region-aware passes
+    (dead_op_elimination) have their roots."""
+    sub = Program()
+    ph = [Tensor(t._value if isinstance(t, Tensor) else t)
+          for t in state_tensors]
+    in_uids = [Program._uid(p) for p in ph]
+    for p, u in zip(ph, in_uids):
+        sub._live.setdefault(u, p)
+    with _sub_recorder(sub):
+        outs = branch_fn(*ph)
+    outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+    out_uids = [Program._uid(o) for o in outs if isinstance(o, Tensor)]
+    sub.fetch_targets = [o for o in outs if isinstance(o, Tensor)]
+    # output avals: lets region_replay zero-fill an output whose
+    # producers a region-aware pass pruned because nothing outside the
+    # region consumes it (the zeros are then never observed)
+    sub._out_avals = [o._value.aval for o in sub.fetch_targets]
+    return sub, in_uids, out_uids, outs
+
+
+def region_replay(sub, in_uids, out_uids):
+    """A pure array function replaying `sub`'s CURRENT op list (reads
+    sub.ops at trace time, so pass edits take effect on the next outer
+    compile): (state_arrays...) -> (out_arrays...)."""
+    import jax
+
+    def run(*arrays):
+        env = {u: (t._value if isinstance(t, Tensor) else t)
+               for u, t in sub._live.items()}
+        env.update(zip(in_uids, arrays))
+        for entry in sub.ops:
+            (name, fn, entry_flat, tpos, e_in, treedef, out_positions,
+             e_out) = entry[:8]
+            flat2 = list(entry_flat)
+            for i, u in zip(tpos, e_in):
+                flat2[i] = env[u]
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            leaves = jax.tree_util.tree_leaves(out)
+            for pos, u in zip(out_positions, e_out):
+                env[u] = leaves[pos]
+        import jax.numpy as jnp
+
+        avals = getattr(sub, "_out_avals", [None] * len(out_uids))
+        return tuple(
+            env[u] if u in env else jnp.zeros(a.shape, a.dtype)
+            for u, a in zip(out_uids, avals))
+
+    return run
+
+
+def promote_last_to_region(program, regions):
+    """Upgrade the most recently recorded entry of `program` into a
+    RegionEntry carrying `regions` ([(tag, sub_program), ...])."""
+    entry = program.ops[-1]
+    program.ops[-1] = RegionEntry(tuple(entry), regions)
+    program._compiled.clear()
+    return program.ops[-1]
 
 
 _main_program = Program()

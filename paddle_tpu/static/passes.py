@@ -70,6 +70,24 @@ def dead_op_elimination(program, fetch_list=None):
     for entry in reversed(program.ops):
         (_, _, _, _, in_uids, _, _, out_uids) = entry
         if any(u in needed for u in out_uids):
+            # PIR-region analog: walk INTO a surviving control-flow
+            # entry and prune dead ops inside each sub-program; the
+            # entry replays sub.ops, so the pruning is effective. For a
+            # cond, roots narrow to the outputs the OUTER graph still
+            # needs (replay zero-fills the unobserved rest); a while's
+            # body outputs are its own carry and stay fully rooted.
+            live_pos = [i for i, u in enumerate(out_uids) if u in needed]
+            for _tag, sub in getattr(entry, "regions", ()):
+                n_before = len(sub.ops)
+                if entry[0] == "cond" and _tag in ("true", "false"):
+                    roots = [sub.fetch_targets[i] for i in live_pos
+                             if i < len(sub.fetch_targets)]
+                    dead_op_elimination(sub, fetch_list=roots)
+                else:
+                    dead_op_elimination(sub)
+                if len(sub.ops) != n_before:
+                    # the outer executable baked in the old sub trace
+                    program._compiled.clear()
             needed.update(in_uids)
             kept.append(entry)
     removed = len(program.ops) - len(kept)
