@@ -581,12 +581,17 @@ def _split_state(body_stmts, extra_stmts=()):
 
 
 def _load_names(stmts):
-    """Every name Loaded anywhere in the statements."""
+    """Every name READ anywhere in the statements — including the
+    implicit read of an AugAssign target (`t += 1` loads t even though
+    its Name node carries a Store ctx)."""
     out = set()
     for s in stmts:
         for n in ast.walk(s):
             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
                 out.add(n.id)
+            elif isinstance(n, ast.AugAssign) \
+                    and isinstance(n.target, ast.Name):
+                out.add(n.target.id)
     return out
 
 
@@ -598,9 +603,13 @@ def _body_local_ok(stmts, name):
     model)."""
     stored = False
     for s in stmts:
-        loads = any(isinstance(n, ast.Name) and n.id == name
-                    and isinstance(n.ctx, ast.Load)
-                    for n in ast.walk(s))
+        loads = any(
+            (isinstance(n, ast.Name) and n.id == name
+             and isinstance(n.ctx, ast.Load))
+            or (isinstance(n, ast.AugAssign)
+                and isinstance(n.target, ast.Name)
+                and n.target.id == name)
+            for n in ast.walk(s))
         if loads and not stored:
             return False
         if name in _definite_names([s]):

@@ -89,6 +89,19 @@ LLAMA_PRESETS = {
 }
 
 
+def _i64(v):
+    """Loop counters enter ops as DYNAMIC scalars: a python int would
+    bake into the dispatch-cache key, minting one entry per step. Under
+    a lowered loop the counter arrives as a raw traced jax value."""
+    import numpy as np
+
+    if isinstance(v, Tensor):
+        return v
+    if isinstance(v, (jax.Array, jax.core.Tracer)):
+        return Tensor(v)
+    return Tensor(np.int64(v))
+
+
 def _mp_active():
     from ..distributed.topology import get_hybrid_communicate_group
 
@@ -139,10 +152,14 @@ class LlamaAttention(nn.Layer):
         # prev_len cached tokens rotates at prev_len..prev_len+s-1
         pos_ids = None
         if prev_len or position_offset:
-            off = prev_len + position_offset
-            from ..ops.creation import arange
+            import numpy as np
 
-            pos_ids = (arange(s, dtype="int64") + off).reshape([1, s])
+            off = prev_len + position_offset
+            # host-built position Tensor (a DYNAMIC dispatch leaf): the
+            # per-step int offset must not enter the op-cache key, or
+            # every decode position would mint a fresh cache entry
+            pos_ids = Tensor(np.arange(s, dtype=np.int64)
+                             .reshape(1, s) + off)
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=pos_ids,
             rotary_emb_base=cfg.rope_theta)
@@ -348,12 +365,14 @@ class LlamaForCausalLM(nn.Layer):
         pad = zeros([b, max_new_tokens], dtype="int64")
         buf = concat([input_ids.astype("int64"), pad], axis=1)
         zero_idx = zeros([b, 1], dtype="int64")
+        zero_read = zeros([b, 1, 1], dtype="int64")
         for i in range(max_new_tokens):
             logits = self.forward(buf)               # causal: tail inert
-            read = zeros([b, 1, 1], dtype="int64") + (i + s0 - 1)
+            read = zero_read + _i64(i + s0 - 1)
             last = take_along_axis(logits, read, axis=1)   # [b, 1, V]
             nxt = argmax(last, axis=-1)                    # [b, 1]
-            buf = put_along_axis(buf, zero_idx + (i + s0), nxt, axis=1)
+            buf = put_along_axis(buf, zero_idx + _i64(i + s0), nxt,
+                                 axis=1)
             if eos_token_id is not None:
                 if (nxt == eos_token_id).all():
                     break

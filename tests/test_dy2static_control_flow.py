@@ -523,3 +523,47 @@ def test_llama_kv_cache_matches_full_forward():
     _, caches = m.forward(paddle.to_tensor(ids[:, :6]), kv_caches=empty)
     lg2, _ = m.forward(paddle.to_tensor(ids[:, 6:]), kv_caches=caches)
     np.testing.assert_allclose(lg2.numpy()[0, -1], full, atol=1e-4)
+
+
+def test_augassign_read_keeps_branch_state_carried():
+    """Review regression (r4): `t += 1` after a branch IS a read of t —
+    the block-local analysis must not drop t from carried state (here
+    the safe outcome is declining conversion: t is unbound pre-branch)."""
+    from paddle_tpu.jit.dy2static import convert_function
+
+    f = _mod_fn(
+        "def f(x):\n"
+        "    if x.sum() > 0:\n"
+        "        t = x * 1.0\n"
+        "    else:\n"
+        "        t = x * 2.0\n"
+        "    t += 1.0\n"
+        "    return t\n", "f")
+    g = convert_function(f)
+    run = g if g is not None else f
+    for v in (np.ones(2, np.float32), -np.ones(2, np.float32)):
+        t = paddle.to_tensor(v)
+        np.testing.assert_allclose(run(t).numpy(), f(t).numpy())
+
+
+def test_generate_loops_reuse_dispatch_cache_entries():
+    """Review regression (r4): eager decode loops must not mint one
+    op-cache entry per position (python-int offsets were entering the
+    static fingerprint)."""
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    paddle.seed(0)
+    m = LlamaForCausalLM.from_preset("debug")
+    m.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 250, (1, 4)).astype(np.int64))
+    m.generate(ids, max_new_tokens=2)          # warm all signatures
+    m.generate_static(ids, max_new_tokens=2)
+    n0 = dispatch.op_cache_stats()["entries"]
+    m.generate(ids, max_new_tokens=8)
+    m.generate_static(ids, max_new_tokens=8)
+    n1 = dispatch.op_cache_stats()["entries"]
+    # longer generations may add a couple of shape-variant entries, but
+    # not O(steps) new ones
+    assert n1 - n0 <= 6, (n0, n1)
