@@ -521,7 +521,7 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                               use_dynamic_cachekv_quant=False,
                               quant_round_type=1, quant_max_bound=127.0,
                               quant_min_bound=-127.0, out_scale=-1,
-                              compute_dtype="default"):
+                              compute_dtype="default", layer_idx=None):
     """Paged-KV-cache attention (reference block_multihead_attention):
     qkv [token_num, (HQ+2*HKV)*D] packs each batch row's tokens this step
     (prefill rows contribute seq_lens_encoder[b] tokens at positions
@@ -544,11 +544,19 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                                   "masks beyond the built-in causal/"
                                   "length masking are not supported")
 
-    def fn(qkva, kc, vc, enc, dec, this, cu_q, bt, *rest):
+    def fn(qkva, kc_in, vc_in, enc, dec, this, cu_q, bt, *rest):
         it = iter(rest)
         b = next(it) if qkv_bias is not None else None
         rope = next(it) if rope_emb is not None else None
         T = qkva.shape[0]
+        if layer_idx is None:
+            kc, vc = kc_in, vc_in
+        else:
+            # stacked-cache mode: caches are [L, num_blocks, H, bs, D];
+            # operate on this layer's slice and write it back with ONE
+            # dynamic-update-slice so the whole layer loop aliases into
+            # a single pair of buffers
+            kc, vc = kc_in[layer_idx], vc_in[layer_idx]
         num_blocks, HKV, bs, D = kc.shape
         B, max_blocks = bt.shape
         max_seq = max_blocks * bs
@@ -605,14 +613,23 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         vd = jnp.swapaxes(vd, 1, 2)
         G = HQ // HKV
         qg = q.reshape(T, HKV, G, D)
-        logits = jnp.einsum("tkgd,tksd->tkgs", qg.astype(jnp.float32),
-                            kd[t2b].astype(jnp.float32)) \
+        # MXU dots take the low-precision operands directly with f32
+        # ACCUMULATION (preferred_element_type) — operand .astype(f32)
+        # casts materialized an f32 copy of every gathered KV view
+        # (~1.6 GB/step at flagship decode dims)
+        logits = jnp.einsum("tkgd,tksd->tkgs", qg, kd[t2b],
+                            preferred_element_type=jnp.float32) \
             / jnp.sqrt(jnp.float32(D))
         valid = seqpos[None, :] <= pos[:, None]              # [T, S]
         logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("tkgs,tksd->tkgd", probs,
-                         vd[t2b].astype(jnp.float32)).astype(qkva.dtype)
+        out = jnp.einsum("tkgs,tksd->tkgd", probs.astype(qkva.dtype),
+                         vd[t2b],
+                         preferred_element_type=jnp.float32) \
+            .astype(qkva.dtype)
+        if layer_idx is not None:
+            kc = kc_in.at[layer_idx].set(kc)
+            vc = vc_in.at[layer_idx].set(vc)
         return out.reshape(T, HQ * D), qkva, kc, vc
 
     args = [qkv, key_cache, value_cache, seq_lens_encoder,

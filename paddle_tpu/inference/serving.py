@@ -272,18 +272,20 @@ class PagedCausalLM(Layer):
                                    // 2))
 
         rope = apply(rope_emb_arg, op_name="rope_table")
-        new_kc, new_vc = [], []
+        new_kc, new_vc = key_caches, value_caches
         for li in range(cfg.num_layers):
             h = self.ln1[li](x)
             qkv = self.qkv[li](h)                      # [T, (HQ+2HKV)*D]
-            out, _, kc, vc = IF.block_multihead_attention(
-                qkv, key_caches[li], value_caches[li],
+            # stacked-cache mode: each layer reads/writes its slice of
+            # the ONE [L, pool] cache pair (single dynamic-update-slice
+            # chain — the list+jnp.stack pattern rebuilt the full cache
+            # every step)
+            out, _, new_kc, new_vc = IF.block_multihead_attention(
+                qkv, new_kc, new_vc,
                 seq_lens_encoder, seq_lens_decoder,
                 seq_lens_this_time, None, None, cu_seqlens_q, None,
-                block_tables, rope_emb=rope,
+                block_tables, rope_emb=rope, layer_idx=li,
                 max_seq_len=cfg.max_seq, block_size=cfg.block_size)
-            new_kc.append(kc)
-            new_vc.append(vc)
             x = x + self.proj[li](out)
             h = self.ln2[li](x)
             x = x + self._mlp(li, h)
@@ -296,7 +298,7 @@ class PagedCausalLM(Layer):
 
         last = apply(pick_last, x, cu_seqlens_q, op_name="pick_last")
         logits = self.head(last)                             # [B+1, V]
-        return logits, _stack(new_kc), _stack(new_vc)
+        return logits, new_kc, new_vc
 
     # -- stateless dense reference over the same weights -----------------
     def forward_dense(self, input_ids):
@@ -350,8 +352,6 @@ class PagedCausalLM(Layer):
         return self.head(x).reshape([1, S, cfg.vocab_size])
 
 
-def _stack(tensors):
-    return apply(lambda *ts: jnp.stack(ts), *tensors, op_name="stack_caches")
 
 
 class _Request:

@@ -22,6 +22,7 @@ Two coordinated implementations share the same math:
 """
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
@@ -89,6 +90,17 @@ LLAMA_PRESETS = {
 }
 
 
+@functools.lru_cache(maxsize=1024)
+def _position_ids(s, off):
+    """Host-built position Tensor (a DYNAMIC dispatch leaf): the
+    per-step int offset must not enter the op-cache key, or every decode
+    position would mint a fresh cache entry. Memoized so an L-layer
+    forward uploads ONE array per step, not L."""
+    import numpy as np
+
+    return Tensor(np.arange(s, dtype=np.int64).reshape(1, s) + off)
+
+
 def _i64(v):
     """Loop counters enter ops as DYNAMIC scalars: a python int would
     bake into the dispatch-cache key, minting one entry per step. Under
@@ -152,14 +164,7 @@ class LlamaAttention(nn.Layer):
         # prev_len cached tokens rotates at prev_len..prev_len+s-1
         pos_ids = None
         if prev_len or position_offset:
-            import numpy as np
-
-            off = prev_len + position_offset
-            # host-built position Tensor (a DYNAMIC dispatch leaf): the
-            # per-step int offset must not enter the op-cache key, or
-            # every decode position would mint a fresh cache entry
-            pos_ids = Tensor(np.arange(s, dtype=np.int64)
-                             .reshape(1, s) + off)
+            pos_ids = _position_ids(s, prev_len + position_offset)
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, position_ids=pos_ids,
             rotary_emb_base=cfg.rope_theta)

@@ -775,12 +775,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(i for i in range(a.ndim) if i != ch_axis)
         shape = [1] * a.ndim
         shape[ch_axis] = -1
-        if use_batch_stats:
+        lowp = a.dtype in (jnp.bfloat16, jnp.float16)
+        if use_batch_stats and not lowp:
             mean = jnp.mean(a.astype(jnp.float32), axis=axes)
             var = jnp.var(a.astype(jnp.float32), axis=axes)
-        else:
+        elif not use_batch_stats:
             mean, var = rm, rv
-        lowp = a.dtype in (jnp.bfloat16, jnp.float16)
         if lowp and use_batch_stats:
             # bf16 training regime: the fused-backward core (f32 stats,
             # input-dtype normalize, 2-pass hand-written vjp)
