@@ -260,15 +260,18 @@ class PagedCausalLM(Layer):
 
         cfg = self.cfg
         x = self.embed(tokens)                               # [T, H]
+        # batch/seq dims come from the INPUTS, not cfg: one model serves
+        # engines of different max_batch/max_seq (each jit-specializes)
+        B1 = int(seq_lens_encoder.shape[0])
+        max_seq = int(block_tables.shape[1]) * cfg.block_size
 
         def rope_emb_arg():
-            B1 = cfg.max_batch + 1
-            pos = jnp.arange(cfg.max_seq)
+            pos = jnp.arange(max_seq)
             cos, sin = self._rope_table(pos)                 # [S, D/2]
             cos = jnp.broadcast_to(cos[None], (B1,) + cos.shape)
             sin = jnp.broadcast_to(sin[None], (B1,) + sin.shape)
             return Tensor(jnp.stack([cos, sin])
-                          .reshape(2, B1, 1, cfg.max_seq, cfg.head_dim
+                          .reshape(2, B1, 1, max_seq, cfg.head_dim
                                    // 2))
 
         rope = apply(rope_emb_arg, op_name="rope_table")
