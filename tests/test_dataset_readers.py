@@ -1,0 +1,148 @@
+"""paddle.dataset reader-family parity (reference python/paddle/dataset/:
+mnist/cifar/uci_housing/imdb/imikolov/movielens/conll05/flowers/voc2012/
+wmt14/wmt16/image/common). Readers keep the reference generator contract;
+offline they synthesize deterministic data (reader.synthetic == True) and
+parse the REAL standard formats when the files exist (exercised here by
+fabricating standard-format files on disk)."""
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dataset
+
+
+def test_uci_housing_shapes():
+    r = dataset.uci_housing.train()
+    x, y = next(r())
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_mnist_synthetic_and_real_idx(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_DATASET_HOME", str(tmp_path))
+    r = dataset.mnist.train()
+    assert r.synthetic
+    x, y = next(r())
+    assert x.shape == (784,) and 0 <= y < 10
+
+    # fabricate standard idx-gzip files: 3 tiny images
+    d = tmp_path / "mnist"
+    d.mkdir()
+    imgs = np.arange(3 * 784, dtype=np.uint8).reshape(3, 28, 28)
+    labels = np.array([3, 1, 4], np.uint8)
+    with gzip.open(d / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write((2051).to_bytes(4, "big") + (3).to_bytes(4, "big")
+                + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                + imgs.tobytes())
+    with gzip.open(d / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write((2049).to_bytes(4, "big") + (3).to_bytes(4, "big")
+                + labels.tobytes())
+    r2 = dataset.mnist.train()
+    assert not r2.synthetic
+    samples = list(r2())
+    assert len(samples) == 3
+    assert [s[1] for s in samples] == [3, 1, 4]
+    np.testing.assert_allclose(samples[0][0],
+                               imgs[0].reshape(784) / 127.5 - 1.0,
+                               atol=1e-6)
+
+
+def test_cifar_real_tarball(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_DATASET_HOME", str(tmp_path))
+    assert dataset.cifar.train10().synthetic
+    d = tmp_path / "cifar"
+    d.mkdir()
+    batch = {"data": np.arange(2 * 3072, dtype=np.uint8)
+             .reshape(2, 3072), "labels": [7, 2]}
+    inner = pickle.dumps(batch)
+    tar_path = d / "cifar-10-python.tar.gz"
+    import io
+
+    with tarfile.open(tar_path, "w:gz") as tf:
+        info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(inner)
+        tf.addfile(info, io.BytesIO(inner))
+    r = dataset.cifar.train10()
+    assert not r.synthetic
+    samples = list(r())
+    assert len(samples) == 2 and samples[0][1] == 7
+    assert samples[0][0].shape == (3072,)
+
+
+def test_imikolov_ngram_and_seq():
+    word_idx = dataset.imikolov.build_dict()
+    r = dataset.imikolov.train(word_idx, 5)
+    grams = [g for g, _ in zip(r(), range(20))]
+    assert all(len(g) == 5 for g in grams)
+    rs = dataset.imikolov.train(
+        word_idx, 5, dataset.imikolov.DataType.SEQ)
+    src, trg = next(rs())
+    assert src[1:] == trg[:-1]
+
+
+def test_movielens_contract():
+    samples = [s for s, _ in zip(dataset.movielens.train()(), range(10))]
+    assert samples, "train reader empty"
+    uid, gender, age, job, mid, cats, title, score = samples[0]
+    assert uid <= dataset.movielens.max_user_id()
+    assert mid <= dataset.movielens.max_movie_id()
+    assert job <= dataset.movielens.max_job_id()
+    assert 1.0 <= score <= 5.0
+    assert isinstance(cats, list) and isinstance(title, list)
+    assert len(dataset.movielens.movie_categories()) == 18
+    # train/test split is disjoint and deterministic
+    tr = {(s[0], s[4]) for s in dataset.movielens.train()()}
+    te = {(s[0], s[4]) for s in dataset.movielens.test()()}
+    assert te and not (tr & te)
+
+
+def test_conll05_layout():
+    w, v, l = dataset.conll05.get_dict()
+    s = next(dataset.conll05.test()())
+    assert len(s) == 9
+    assert len(s[0]) == len(s[8])          # words align with labels
+
+
+def test_wmt_readers():
+    src, trg = dataset.wmt16.get_dict()
+    assert "<unk>" in src and "<e>" in trg
+    s, t, t_next = next(dataset.wmt14.train()())
+    assert t_next[:-1] == t[1:]
+
+
+def test_image_utilities():
+    im = np.arange(20 * 30 * 3, dtype=np.uint8).reshape(20, 30, 3)
+    short = dataset.image.resize_short(im, 10)
+    assert min(short.shape[:2]) == 10
+    crop = dataset.image.center_crop(short, 8)
+    assert crop.shape[:2] == (8, 8)
+    chw = dataset.image.to_chw(crop)
+    assert chw.shape == (3, 8, 8)
+    out = dataset.image.simple_transform(im, 12, 8, is_train=False,
+                                         mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 8, 8) and out.dtype == np.float32
+
+
+def test_common_split_and_cluster_reader(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    files = dataset.common.split(
+        dataset.uci_housing.train(n=10), 4,
+        suffix=str(tmp_path / "part-%05d.pickle"))
+    assert len(files) == 3                 # 4+4+2
+    got = list(dataset.common.cluster_files_reader(
+        str(tmp_path / "part-*.pickle"), 2, 0)())
+    assert len(got) == 6                   # parts 0 (4) and 2 (2)
+
+
+def test_common_download_offline_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("PPTPU_DATASET_HOME", str(tmp_path))
+    with pytest.raises(RuntimeError, match="no network egress"):
+        dataset.common.download("http://x/y.tgz", "mod", "0")
+    p = tmp_path / "mod"
+    p.mkdir()
+    (p / "y.tgz").write_bytes(b"ok")
+    assert dataset.common.download("http://x/y.tgz", "mod", "0") \
+        == str(p / "y.tgz")
