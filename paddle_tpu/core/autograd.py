@@ -182,7 +182,8 @@ def _ones_like_aval(t):
 
 
 def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
-                target_set=None, create_graph=False):
+                target_set=None, create_graph=False,
+                target_points=None):
     """Core reverse sweep. Returns dict id(tensor)->cotangent for tensors in
     target_set (when provided); otherwise accumulates into leaf .grad.
 
@@ -234,10 +235,21 @@ def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
             cot = node.cotangents[i]
             if cot is None:
                 continue
+            if target_points is not None:
+                # capture by the TARGET's (current producer, out_index)
+                # pointers — an in-place-rebound tensor also appears as
+                # the output of its pre-rebind producer (old value), and
+                # its current producer's output weakref names the
+                # internal rebind tensor, so neither identity check works
+                tid = target_points.get((id(node), i))
+                if tid is not None:
+                    prev = captured.get(tid)
+                    captured[tid] = cot if prev is None else prev + cot
             ref = node.outputs[i]
             out_t = ref() if ref is not None else None
             if out_t is not None:
-                if target_set is not None and id(out_t) in target_set:
+                if target_points is None and target_set is not None \
+                        and id(out_t) in target_set:
                     prev = captured.get(id(out_t))
                     captured[id(out_t)] = cot if prev is None else prev + cot
                 for hook in out_t._hooks:
@@ -262,7 +274,9 @@ def _run_engine(roots, grad_tensors, retain_graph, accumulate_to_grad,
 
     # finalize leaves: capture + hooks once + accumulate
     for tensor, cot in leaf_buf.values():
-        if target_set is not None and id(tensor) in target_set:
+        if target_set is not None and id(tensor) in target_set \
+                and (target_points is None
+                     or tensor._grad_node is None):
             prev = captured.get(id(tensor))
             captured[id(tensor)] = cot if prev is None else prev + cot
         for hook in tensor._hooks:
@@ -338,10 +352,14 @@ _sweep_cache: dict = {}
 _SWEEP_MAX = 1024
 
 
-def _make_sweep(specs, root_specs, n_leaves):
+def _make_sweep(specs, root_specs, n_leaves, captures=()):
     """specs: per node (out_treedef, out_avals, pull_treedef, routes);
     root_specs: per root (kind, aval, route) with kind 'ones'|'arg';
-    routes: ('n', node_pos, out_idx) | ('l', leaf_slot) | ('x',)."""
+    routes: ('n', node_pos, out_idx) | ('l', leaf_slot) | ('x',);
+    captures: grad()-target read points ('n', pos, oidx) | ('l', slot) —
+    their fully-accumulated cotangents are returned alongside the leaf
+    gradients (nothing writes into a node's store after its processing,
+    so end-of-sweep reads equal processing-time captures)."""
 
     def _route(store, leaf, route, c):
         tag = route[0]
@@ -372,14 +390,27 @@ def _make_sweep(specs, root_specs, n_leaves):
             for route, c in zip(routes, input_cots):
                 if c is not None:
                     _route(store, leaf, route, c)
-        return leaf
+        caps = [store[c[1]][c[2]] if c[0] == "n" else leaf[c[1]]
+                for c in captures]
+        return leaf, caps
 
     return jax.jit(sweep)
 
 
-def _sweep_backward(roots, grad_tensors, retain_graph):
-    """Try the whole-sweep cached backward; returns True when handled."""
+_NOT_HANDLED = object()
+
+
+def _sweep_backward(roots, grad_tensors, retain_graph, targets=None):
+    """Whole-sweep cached backward.
+
+    targets=None (backward mode): accumulate into leaf .grad; returns
+    True when handled, False to fall back to the per-node engine.
+    targets=list (grad mode): no .grad mutation; returns the list of
+    fully-accumulated cotangent arrays (None for unreached targets), or
+    _NOT_HANDLED to fall back."""
     import numpy as _np
+
+    fail = False if targets is None else _NOT_HANDLED
 
     # ---- structural walk (mirrors _run_engine's max-heap order) --------
     heap = []
@@ -413,13 +444,13 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
         if node is None:
             route = leaf_route(t)
             if route is None:
-                return False
+                return fail
         else:
             push(node)
             route = ("n", node.id, t._out_index)   # id fixed to pos below
         if g is None:
             if t._value.size != 1:
-                return False                       # engine raises properly
+                return fail                        # engine raises properly
             root_specs.append(("ones", t._value.aval, route))
         else:
             root_specs.append(("arg", None, route))
@@ -432,13 +463,13 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
         _, node = heapq.heappop(heap)
         in_heap.discard(node.id)
         if node.released:
-            return False                           # engine raises properly
+            return fail                            # engine raises properly
         node_pos[node.id] = len(order)
         order.append(node)
         for ref in node.outputs:
             out_t = ref() if ref is not None else None
             if out_t is not None and out_t._hooks:
-                return False
+                return fail
         pull = node.vjp_fn
         # Only cached-dispatch pullbacks participate: their Partial
         # treedefs come from one jitted lowering and are STABLE across
@@ -449,13 +480,13 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
         from .dispatch import _CachedPullback
 
         if not isinstance(pull, _CachedPullback):
-            return False
+            return fail
         pull = pull.pull
         leaves, pull_td = jax.tree.flatten(pull)
         for lf in leaves:
             if not isinstance(lf, (jax.Array, _np.ndarray, float, int,
                                    _np.generic)):
-                return False
+                return fail
         routes = []
         for (t, pnode, pidx) in node.inputs:
             if pnode is None or t.stop_gradient:
@@ -464,7 +495,7 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
                 else:
                     r = leaf_route(t)
                     if r is None:
-                        return False
+                        return fail
                     routes.append(r)
             else:
                 push(pnode)
@@ -480,16 +511,46 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
             return ("n", node_pos[route[1]], route[2])
         return route
 
-    # the key is exactly (specs, root_specs, n_leaves): root avals are
+    # the key is (specs, root_specs, n_leaves, captures): root avals are
     # included so two node-less leaf roots of different shape/dtype
     # cannot share a sweep; pull treedefs embed the pullback function
-    # identity, which pins the computation
+    # identity, which pins the computation; captures distinguish grad()
+    # sweeps from backward() sweeps over the same graph
     root_specs = tuple((k, a, resolve(r)) for k, a, r in root_specs)
     specs = tuple(
         (td, avals, ptd, tuple(resolve(r) for r in routes))
         for (td, avals, ptd), routes in zip(key_nodes, node_routes)
     )
-    key = (specs, root_specs, len(leaf_tensors))
+    # grad mode: map each target to its capture point. Whether a point
+    # ever RECEIVES a cotangent is static (the union of all routes), so
+    # unreached targets resolve to None without running anything.
+    captures = []
+    cap_of_target = []                  # per target: capture index | None
+    if targets is not None:
+        received = {r[1:] for _, _, r in root_specs if r[0] == "n"}
+        for (_, _, _, routes) in specs:
+            received |= {r[1:] for r in routes if r[0] == "n"}
+        for t in targets:
+            # ONE capture point per target: the tensor's CURRENT
+            # producer's output, or its leaf slot. (An in-place-rebound
+            # tensor also appears as the output of its pre-rebind
+            # producer; that cotangent belongs to the OLD value — the
+            # engine applies the same current-producer rule.)
+            node = t._grad_node
+            cap = None
+            if node is not None and node.id in node_pos:
+                pt = (node_pos[node.id], t._out_index)
+                if pt in received:
+                    cap = ("n",) + pt
+            elif id(t) in leaf_slots:
+                cap = ("l", leaf_slots[id(t)])
+            if cap is None:
+                cap_of_target.append(None)
+            else:
+                cap_of_target.append((len(captures),))
+                captures.append(cap)
+    captures = tuple(captures)
+    key = (specs, root_specs, len(leaf_tensors), captures)
     hit = _sweep_cache.get(key)
     if hit is None:
         if len(_sweep_cache) >= _SWEEP_MAX:
@@ -499,12 +560,18 @@ def _sweep_backward(roots, grad_tensors, retain_graph):
             for k, _ in by_heat[: len(by_heat) // 2 or 1]:
                 del _sweep_cache[k]
         hit = _sweep_cache[key] = [
-            _make_sweep(specs, root_specs, len(leaf_tensors)), 0]
+            _make_sweep(specs, root_specs, len(leaf_tensors), captures),
+            0]
     hit[1] += 1
-    grads = hit[0](pull_leaves_all, seed_args)
+    grads, caps = hit[0](pull_leaves_all, seed_args)
     if not retain_graph:
         for node in order:
             node.release()
+    if targets is not None:
+        return [None if ci is None
+                else (caps[ci[0]] if len(ci) == 1
+                      else sum(caps[i] for i in ci))
+                for ci in cap_of_target]
     for t, g in zip(leaf_tensors, grads):
         t._accumulate_grad(g)
     return True
@@ -578,18 +645,32 @@ def grad(
         else:
             seeds.append(g if create_graph else _unwrap(g))
     targets = {id(t) for t in inputs}
+    target_points = {(id(t._grad_node), t._out_index): id(t)
+                     for t in inputs if t._grad_node is not None}
     if create_graph:
         with enable_grad():
             captured = _run_engine(
                 outputs, seeds, retain_graph, accumulate_to_grad=False,
                 target_set=targets, create_graph=True,
+                target_points=target_points,
             )
     else:
         with no_grad():
-            captured = _run_engine(
-                outputs, seeds, retain_graph, accumulate_to_grad=False,
-                target_set=targets,
-            )
+            # fast path: the whole-sweep cached backward with capture
+            # points for the requested inputs (ONE executable per graph
+            # signature; jacobian/hessian loops hit the cache every row);
+            # seeds here are already raw arrays
+            res = _sweep_backward(outputs, seeds, retain_graph,
+                                  targets=list(inputs))
+            if res is not _NOT_HANDLED:
+                captured = {id(t): c for t, c in zip(inputs, res)
+                            if c is not None}
+            else:
+                captured = _run_engine(
+                    outputs, seeds, retain_graph,
+                    accumulate_to_grad=False, target_set=targets,
+                    target_points=target_points,
+                )
     result = []
     for t in inputs:
         c = captured.get(id(t))

@@ -109,3 +109,72 @@ def test_sweep_cache_reused_across_iterations():
     # one signature -> at most a couple of cache entries (cold-start
     # iterations may record legacy nodes with a different pull structure)
     assert 1 <= len(autograd._sweep_cache) <= 2
+
+
+def test_grad_uses_sweep_and_matches_engine():
+    """paddle.grad rides the whole-sweep cache (capture points instead of
+    .grad accumulation): values equal the per-node engine, unreached
+    inputs honor allow_unused, and repeated calls (jacobian-style loops)
+    reuse one cache entry."""
+    autograd._sweep_cache.clear()
+    x = paddle.to_tensor(_r((6, 6), 10))
+    y = paddle.to_tensor(_r((6, 6), 11))
+    unused = paddle.to_tensor(_r((3,), 12))
+    x.stop_gradient = False
+    y.stop_gradient = False
+    unused.stop_gradient = False
+
+    def build():
+        h = paddle.matmul(x, y)
+        return (h * h).sum(), h
+
+    for it in range(4):           # cold, trace, cached+sweep, cached
+        z, h = build()
+        gx, gh, gu = paddle.grad([z], [x, h, unused], retain_graph=False,
+                                 allow_unused=True)
+        assert gu is None
+        # reference: d z/d h = 2h, d z/d x = 2h @ y^T
+        np.testing.assert_allclose(gh.numpy(), 2 * h.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(gx.numpy(),
+                                   (2 * h.numpy()) @ y.numpy().T,
+                                   rtol=1e-4)
+    assert len(autograd._sweep_cache) >= 1
+    with pytest.raises(RuntimeError, match="allow_unused"):
+        z, h = build()
+        paddle.grad([z], [unused])
+
+
+def test_grad_inplace_rebound_target_uses_current_value():
+    """Review regression (r4): a target rebound in place gets the
+    gradient of the value it holds NOW (its current producer's output);
+    the pre-rebind flow belongs to the old value. Sweep and engine must
+    agree: dz/dx2 = 3 here (y2 = 3*x2_post), not 2+3."""
+    def run(force_engine):
+        paddle.seed(4)
+        x = paddle.to_tensor(_r((4, 4), 20))
+        b = paddle.to_tensor(_r((4, 4), 21))
+        x.stop_gradient = False
+        outs = []
+        for _ in range(3):            # cold/trace/steady
+            x2 = x * 1.0              # leaf-like intermediate to rebind
+            y1 = x2 * 2.0
+            x2.add_(b)                # rebinds x2._grad_node
+            y2 = x2 * 3.0
+            z = (y1 + y2).sum()
+            if force_engine:
+                orig = autograd._sweep_backward
+                autograd._sweep_backward = \
+                    lambda *a, **k: autograd._NOT_HANDLED
+                try:
+                    g = paddle.grad([z], [x2])[0].numpy()
+                finally:
+                    autograd._sweep_backward = orig
+            else:
+                g = paddle.grad([z], [x2])[0].numpy()
+            outs.append(g)
+        return outs[-1]
+
+    g_engine = run(True)
+    g_sweep = run(False)
+    np.testing.assert_allclose(g_sweep, g_engine, rtol=1e-5)
+    np.testing.assert_allclose(g_sweep, np.full((4, 4), 3.0), rtol=1e-5)
