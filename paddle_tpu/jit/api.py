@@ -86,15 +86,45 @@ def _try_convert_target(target) -> bool:
     return False
 
 
-def _warn_graph_break(name: str, exc: Exception):
+def _warn_graph_break(name: str, exc: Exception, n_regions: int = 0):
     import warnings
 
+    if n_regions:
+        tail = (f"Partial-graph capture installed {n_regions} compiled "
+                f"sublayer region(s); only the breaking code runs eagerly "
+                f"(SOT-analog graph break).")
+    else:
+        tail = ("Falling back to EAGER execution for this callable "
+                "(graph break). Use jax-compatible control flow "
+                "(lax.cond/where) to recover whole-graph compilation.")
     warnings.warn(
         f"to_static: '{name}' contains Python that cannot be traced "
         f"({type(exc).__name__}: {str(exc).splitlines()[0][:120]}). "
-        f"Falling back to EAGER execution for this callable (graph break). "
-        f"Use jax-compatible control flow (lax.cond/where) to recover "
-        f"whole-graph compilation.", RuntimeWarning, stacklevel=3)
+        + tail, RuntimeWarning, stacklevel=3)
+
+
+def _reachable_layers(fn):
+    from ..nn.layer.layers import Layer
+
+    return [v for v in _reachable_values(fn) if isinstance(v, Layer)]
+
+
+def _enable_partial_capture_for(target, is_layer: bool) -> int:
+    """On a whole-graph break, keep every convertible sublayer compiled
+    (jit/partial_capture.py — the SOT partial-graph analog). Plain-
+    function targets reach models through closures/globals; capture any
+    Layer they can see. Returns the number of ACTIVE regions (newly
+    installed plus any already present from an earlier break), and never
+    raises — the caller is the last-resort eager fallback."""
+    from .partial_capture import enable_partial_capture, region_count
+
+    try:
+        roots = [target] if is_layer else _reachable_layers(target)
+        for r in roots:
+            enable_partial_capture(r)
+        return sum(region_count(r) for r in roots)
+    except Exception:
+        return 0
 
 _tracing = threading.local()
 
@@ -227,24 +257,24 @@ class StaticFunction:
                         # undo the instance rebinds before surfacing
                         self._restore_converted()
                         raise
+            n_regions = _enable_partial_capture_for(self._target,
+                                                    self._is_layer)
             _warn_graph_break(getattr(self._target, "__name__",
-                                      type(self._target).__name__), e)
+                                      type(self._target).__name__), e,
+                              n_regions)
             self._fallback = True
             return self._eager_call(*args, **kwargs)
 
     def _restore_converted(self):
-        from ..nn.layer.layers import Layer
         from .dy2static import restore_layer_tree
 
         targets = [self._target] if self._is_layer else \
-            [v for v in _reachable_values(self._target)
-             if isinstance(v, Layer)]
+            _reachable_layers(self._target)
         for t in targets:
             restore_layer_tree(t)
         self._compiled = None
 
     def _convert_target(self):
-        from ..nn.layer.layers import Layer
         from .dy2static import convert_function, convert_layer_tree
 
         if self._is_layer:
@@ -258,9 +288,8 @@ class StaticFunction:
         # model through its closure, its bound self, or a referenced
         # global — convert any Layer it can see so sublayer forwards
         # lower too
-        for v in _reachable_values(self._target):
-            if isinstance(v, Layer):
-                converted = convert_layer_tree(v) or converted
+        for v in _reachable_layers(self._target):
+            converted = convert_layer_tree(v) or converted
         return converted
 
     @staticmethod
@@ -513,7 +542,12 @@ class TrainStep:
                         self._compiled = None
                         raise
             if not retried:
-                _warn_graph_break(type(self.model).__name__, e)
+                n_regions = _enable_partial_capture_for(self.model, True)
+                if self.loss_fn is not None and hasattr(self.loss_fn,
+                                                        "_sub_layers"):
+                    n_regions += _enable_partial_capture_for(self.loss_fn,
+                                                             True)
+                _warn_graph_break(type(self.model).__name__, e, n_regions)
                 self._fallback = True
                 self.optimizer._step_count -= 1   # eager step re-counts
                 return self._eager_step(*batch)

@@ -1,0 +1,215 @@
+"""SOT-equivalent partial-graph capture (sublayer-granular regions).
+
+Reference analog: paddle.jit.sot — the bytecode-level graph capture
+(/root/reference/python/paddle/jit/sot/opcode_translator/eval_frame_callback.py)
+that, on a graph break, compiles the convertible subgraphs and runs the
+unconvertible bytecode eagerly between them, so `to_static` never
+silently loses the whole graph.
+
+TPU-native shape: instead of bytecode surgery, regions are SUBLAYERS.
+When a whole-function trace breaks (even after the dy2static AST
+lowering), each sublayer of the broken callable becomes a candidate
+compiled REGION: its forward is rebound to a single
+`core.dispatch.apply` call over its functional form, so the entire
+sublayer executes as one cached XLA executable — forward AND backward
+ride the per-signature jit cache and the whole-sweep cached backward.
+A region whose own body graph-breaks splits recursively into ITS
+children; only the truly unconvertible code (plus per-op glue in parent
+forwards) runs eagerly. A model with one `.item()` in one branch keeps
+every other block compiled instead of forfeiting the whole step.
+
+Traceability is validated with `jax.eval_shape` on first call (abstract
+trace, no compile, no execution on device), so the decision to split is
+made cheaply and deterministically.
+"""
+from __future__ import annotations
+
+import itertools
+import types
+import warnings
+
+import jax
+
+from ..core.dispatch import apply, _fp_value, _Uncacheable
+from ..core.tensor import Tensor
+from . import functional as FB
+
+__all__ = ["enable_partial_capture", "disable_partial_capture",
+           "region_count"]
+
+_region_ids = itertools.count(1)
+
+
+def _break_errors():
+    from .api import _trace_break_errors
+
+    return _trace_break_errors()
+
+
+def _has_own_forward(layer):
+    from ..nn.layer.layers import Layer
+
+    fwd = getattr(type(layer), "forward", None)
+    return fwd is not None and fwd is not Layer.forward
+
+
+def _tracer_in(values):
+    for v in values:
+        a = v._value if isinstance(v, Tensor) else v
+        if isinstance(a, jax.core.Tracer):
+            return True
+    return False
+
+
+class _Region:
+    """Instance-level forward replacement: one compiled region per
+    sublayer. States: unvalidated -> compiled (routes through apply) or
+    broken (restored to eager body, children become regions)."""
+
+    def __init__(self, layer, orig_forward):
+        self.layer = layer
+        self.orig = orig_forward
+        self.validated = False
+        self.broken = False
+        self.entered = 0
+        self.rid = next(_region_ids)
+
+    # -- the pure functional form (one apply call == one region) --------
+    def _region_fn(self, kwargs, train):
+        layer = self.layer
+
+        def region_fn(p, b, *ins):
+            # reentrancy guard: the region's own body invoking
+            # layer.forward must run the plain body, not this region
+            # again (apply's first-call probe runs region_fn with
+            # CONCRETE arrays, so the tracer check alone can't stop it)
+            self.entered += 1
+            try:
+                out, new_buf = FB.call_functional(layer, p, b, ins,
+                                                  kwargs, train=train)
+            finally:
+                self.entered -= 1
+            return out, new_buf
+
+        return region_fn
+
+    def _validate(self, params, buffers, args, kwargs, train):
+        """Abstract-trace the region once; a trace-break here means the
+        region must split into its children."""
+        layer = self.layer
+        tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        concrete = list(args)
+
+        def probe(p, b, tarrs):
+            full = list(concrete)
+            for i, ta in zip(tpos, tarrs):
+                full[i] = ta
+            out, _ = FB.call_functional(layer, p, b, full, kwargs,
+                                        train=train)
+            return out
+
+        sds = lambda t: jax.ShapeDtypeStruct(t.shape, t._value.dtype) \
+            if isinstance(t, Tensor) else t
+        jax.eval_shape(probe,
+                       {k: sds(v) for k, v in params.items()},
+                       {k: sds(v) for k, v in buffers.items()},
+                       tuple(sds(args[i]) for i in tpos))
+
+    def __call__(self, *args, **kwargs):
+        layer = self.layer
+        if self.broken or self.entered:
+            return self.orig(*args, **kwargs)
+        params, buffers = FB.layer_state(layer)
+        leaves = [a for a in jax.tree.leaves(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))]
+        if _tracer_in(leaves) or _tracer_in(params.values()):
+            # inside an outer trace (a parent region or a full to_static
+            # trace is in flight): run the plain body
+            return self.orig(*args, **kwargs)
+        train = bool(layer.training)
+        try:
+            kw_fp = _fp_value(kwargs, 0) if kwargs else ()
+        except _Uncacheable:
+            return self.orig(*args, **kwargs)
+        if not self.validated:
+            try:
+                self._validate(params, buffers, args, kwargs, train)
+            except _break_errors() as e:
+                self.broken = True
+                n = _split_into_children(layer)
+                warnings.warn(
+                    f"partial capture: region '{type(layer).__name__}' "
+                    f"graph-breaks ({type(e).__name__}); split into {n} "
+                    f"child region(s), its own glue runs eagerly",
+                    RuntimeWarning, stacklevel=2)
+                return self.orig(*args, **kwargs)
+            self.validated = True
+        out, new_buf = apply(
+            self._region_fn(kwargs, train), dict(params), dict(buffers),
+            *args, op_name=f"region:{type(layer).__name__}",
+            op_key=("partial_region", self.rid, train, kw_fp))
+        if new_buf:
+            FB.write_back(layer, {}, {
+                k: (t._value if isinstance(t, Tensor) else t)
+                for k, t in new_buf.items()})
+        return out
+
+
+def _split_into_children(layer) -> int:
+    """Install regions on every direct child (recursing through
+    containers without a forward of their own, e.g. LayerList)."""
+    n = 0
+    for child in getattr(layer, "_sub_layers", {}).values():
+        if child is None:
+            continue
+        if _has_own_forward(child):
+            n += _install(child)
+        else:
+            n += _split_into_children(child)
+    return n
+
+
+def _install(layer) -> int:
+    if "__pt_region__" in layer.__dict__:
+        return 0
+    region = _Region(layer, layer.forward)
+    layer.__dict__["__pt_region__"] = region
+    layer.forward = region
+    return 1
+
+
+def enable_partial_capture(root) -> int:
+    """Give every direct sublayer of `root` a compiled-region forward
+    (the root's own body — the code that graph-broke — stays eager).
+    Returns the number of regions installed. Idempotent."""
+    return _split_into_children(root)
+
+
+def disable_partial_capture(root) -> None:
+    """Remove every region installed under `root` (tests / undo)."""
+    stack = [root]
+    seen = set()
+    while stack:
+        l = stack.pop()
+        if id(l) in seen or l is None:
+            continue
+        seen.add(id(l))
+        region = l.__dict__.pop("__pt_region__", None)
+        if region is not None:
+            l.forward = region.orig
+        stack.extend(getattr(l, "_sub_layers", {}).values())
+
+
+def region_count(root) -> int:
+    n = 0
+    stack = [root]
+    seen = set()
+    while stack:
+        l = stack.pop()
+        if id(l) in seen or l is None:
+            continue
+        seen.add(id(l))
+        if "__pt_region__" in l.__dict__:
+            n += 1
+        stack.extend(getattr(l, "_sub_layers", {}).values())
+    return n

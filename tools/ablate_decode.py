@@ -1,0 +1,178 @@
+"""Decode-step ablation on the real chip (VERDICT r4 weak #1).
+
+Times each component of the bs-16 flagship decode step in isolation:
+  full      — the engine's decode_run window (reproduces BENCH step_ms)
+  greedy    — same window with greedy sampling (isolates the sampler)
+  no_attn   — block_multihead_attention stubbed to a pass-through
+              (isolates the paged-cache gather + attention math)
+  weights   — bare 16-layer matmul stack on T=16 tokens in a 16-step
+              scan (the weight-streaming floor as XLA actually runs it)
+  sampler   — 16-step scan of the top-k sampler alone on [17, 32000]
+
+Run on an idle host. Prints one JSON line.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, n=2):
+    fn()  # warm/compile
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import sys
+    stages = set(sys.argv[1:]) or {"full", "greedy", "no_attn", "weights",
+                                   "sampler"}
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import serving as S
+
+    B, win, prompt_len = 16, 16, 128
+    paddle.seed(0)
+    cfg = S.PagedServingConfig.llama_1b(max_batch=B, num_blocks=B * 6 + 16)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = S.PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    sp = S.SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+
+    def mk_engine(m):
+        eng = S.ServingEngine.from_model(m, cfg, seed=0)
+        for _ in range(B):
+            eng.add_request(list(rng.randint(1, cfg.vocab_size, prompt_len)),
+                            max_new_tokens=60, sampling=sp)
+        while any(r.length - r.cached > 1 for r in eng.pending()):
+            eng.step()
+        return eng
+
+    res = {}
+
+    # -- full window ------------------------------------------------------
+    if "full" in stages:
+        eng = mk_engine(model)
+        eng.decode_run(2)  # warm
+        dt = timed(lambda: eng.decode_run(win) or eng._kc)
+        res["full_ms_per_step"] = round(dt / win * 1e3, 3)
+
+    # -- greedy window (no top-k sampler) ---------------------------------
+    if "greedy" in stages:
+        eng2 = S.ServingEngine.from_model(model, cfg, seed=0)
+        for _ in range(B):
+            eng2.add_request(
+                list(rng.randint(1, cfg.vocab_size, prompt_len)),
+                max_new_tokens=60, sampling=S.GREEDY)
+        while any(r.length - r.cached > 1 for r in eng2.pending()):
+            eng2.step()
+        eng2.decode_run(2)
+        dt = timed(lambda: eng2.decode_run(win) or eng2._kc)
+        res["greedy_ms_per_step"] = round(dt / win * 1e3, 3)
+
+    # -- no-attention window ---------------------------------------------
+    if "no_attn" in stages:
+        from paddle_tpu.incubate.nn import functional as IF
+        orig = IF.block_multihead_attention
+
+        def stub(qkv, kc, vc, *a, layer_idx=None, **kw):
+            def fn(q):
+                D = cfg.head_dim
+                HQ, HKV = cfg.num_heads, cfg.num_kv_heads
+                return q[:, :HQ * D]
+            from paddle_tpu.core.dispatch import apply
+            return apply(fn, qkv, op_name="attn_stub"), qkv, kc, vc
+
+        IF.block_multihead_attention = stub
+        try:
+            with jax.default_device(jax.devices("cpu")[0]):
+                model2 = S.PagedCausalLM(cfg)
+            model2.eval()
+            eng3 = mk_engine(model2)
+            eng3.decode_run(2)
+            dt = timed(lambda: eng3.decode_run(win) or eng3._kc)
+            res["no_attn_ms_per_step"] = round(dt / win * 1e3, 3)
+        finally:
+            IF.block_multihead_attention = orig
+
+    if not stages & {"weights", "sampler"}:
+        dev = jax.devices()[0]
+        res["device"] = str(getattr(dev, "device_kind", dev))
+        print(json.dumps(res))
+        return
+
+    # -- bare weight-streaming scan --------------------------------------
+    h, f, V = cfg.hidden_size, cfg.ffn_size, cfg.vocab_size
+    L = cfg.num_layers
+    key = jax.random.key(0)
+    Ws = {
+        "qkv": jnp.zeros((L, h, h + 2 * cfg.num_kv_heads * cfg.head_dim),
+                         jnp.bfloat16),
+        "proj": jnp.zeros((L, h, h), jnp.bfloat16),
+        "gu": jnp.zeros((L, h, 2 * f), jnp.bfloat16),
+        "down": jnp.zeros((L, f, h), jnp.bfloat16),
+        "head": jnp.zeros((h, V), jnp.bfloat16),
+        "emb": jnp.zeros((V, h), jnp.bfloat16),
+    }
+    Ws = jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            jax.random.normal(key, a.shape, jnp.float32).astype(a.dtype)
+            * 0.02, jax.devices()[0]), Ws)
+
+    def wstep(carry, _):
+        x = carry  # [T, h]
+        T = x.shape[0]
+        def layer(xc, w):
+            qkvw, projw, guw, downw = w
+            a = xc @ qkvw
+            xc = xc + a[:, :h] @ projw
+            g = xc @ guw
+            xc = xc + (jax.nn.silu(g[:, :f]) * g[:, f:]) @ downw
+            return xc, None
+        x, _ = jax.lax.scan(layer, x,
+                            (Ws["qkv"], Ws["proj"], Ws["gu"], Ws["down"]))
+        logits = x @ Ws["head"]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        x = Ws["emb"][nxt]
+        return x, nxt
+
+    if "weights" in stages:
+        x0 = jnp.zeros((B, h), jnp.bfloat16)
+        wrun = jax.jit(lambda x: jax.lax.scan(wstep, x, None, length=win))
+        dt = timed(lambda: wrun(x0))
+        res["weights_ms_per_step"] = round(dt / win * 1e3, 3)
+
+    # -- sampler alone ----------------------------------------------------
+    logits = jax.device_put(
+        jax.random.normal(key, (B + 1, V), jnp.float32))
+    temps = jnp.full((B + 1,), 0.8, jnp.float32)
+    topks = jnp.full((B + 1,), 50, jnp.int32)
+    topps = jnp.full((B + 1,), 0.95, jnp.float32)
+
+    if "sampler" in stages:
+        def srun(lg):
+            def body(c, j):
+                salts = jnp.full((B + 1,), j, jnp.int32)
+                s = S._sample_topk_core(lg + c[:, None] * 0, temps, topks,
+                                        topps, salts)
+                return s, s
+            return jax.lax.scan(body, jnp.zeros((B + 1,), jnp.int32),
+                                jnp.arange(win))
+        srun_j = jax.jit(srun)
+        dt = timed(lambda: srun_j(logits))
+        res["sampler_ms_per_step"] = round(dt / win * 1e3, 3)
+
+    dev = jax.devices()[0]
+    res["device"] = str(getattr(dev, "device_kind", dev))
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
