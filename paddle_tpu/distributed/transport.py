@@ -373,12 +373,18 @@ def _master_endpoint() -> Tuple[str, int]:
 
 def init_transport(rank: Optional[int] = None,
                    world_size: Optional[int] = None,
-                   timeout: float = 300.0) -> Optional[TensorTransport]:
+                   timeout: Optional[float] = None) \
+        -> Optional[TensorTransport]:
     """Bring up the eager tensor transport for this process. No-op (returns
-    None) for single-process jobs."""
+    None) for single-process jobs. When the caller leaves `timeout` unset,
+    PADDLE_STORE_TIMEOUT (seconds) overrides the 300 s default — an
+    explicit argument always wins."""
     global _transport
     if _transport is not None:
         return _transport
+    if timeout is None:
+        env_t = os.environ.get("PADDLE_STORE_TIMEOUT", "").strip()
+        timeout = float(env_t) if env_t else 300.0
     if rank is None:
         rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
     if world_size is None:

@@ -118,11 +118,19 @@ def _enable_partial_capture_for(target, is_layer: bool) -> int:
     raises — the caller is the last-resort eager fallback."""
     from .partial_capture import enable_partial_capture, region_count
 
+    roots = []
     try:
         roots = [target] if is_layer else _reachable_layers(target)
         for r in roots:
             enable_partial_capture(r)
-        return sum(region_count(r) for r in roots)
+    except Exception:
+        pass
+    # count AFTER install attempts (a partial failure may still have
+    # installed regions); the shared `seen` set dedupes overlapping
+    # roots (a closure can expose both a model and its own sublayers)
+    try:
+        seen = set()
+        return sum(region_count(r, seen) for r in roots)
     except Exception:
         return 0
 

@@ -95,16 +95,22 @@ class _Region:
 
     def _validate(self, params, buffers, args, kwargs, train):
         """Abstract-trace the region once; a trace-break here means the
-        region must split into its children."""
+        region must split into its children. Tensors ANYWHERE in the
+        (args, kwargs) pytree are abstracted — apply() flattens nested
+        tensors as dynamic leaves, so validating with a nested tensor
+        left concrete would pass here and then trace-break (and silently
+        disable the cache entry) on the real call."""
         layer = self.layer
-        tpos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
-        concrete = list(args)
+        flat, tree = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tpos = [i for i, a in enumerate(flat) if isinstance(a, Tensor)]
 
         def probe(p, b, tarrs):
-            full = list(concrete)
+            full = list(flat)
             for i, ta in zip(tpos, tarrs):
                 full[i] = ta
-            out, _ = FB.call_functional(layer, p, b, full, kwargs,
+            a2, kw2 = jax.tree.unflatten(tree, full)
+            out, _ = FB.call_functional(layer, p, b, a2, kw2,
                                         train=train)
             return out
 
@@ -113,7 +119,7 @@ class _Region:
         jax.eval_shape(probe,
                        {k: sds(v) for k, v in params.items()},
                        {k: sds(v) for k, v in buffers.items()},
-                       tuple(sds(args[i]) for i in tpos))
+                       tuple(sds(flat[i]) for i in tpos))
 
     def __call__(self, *args, **kwargs):
         layer = self.layer
@@ -200,10 +206,13 @@ def disable_partial_capture(root) -> None:
         stack.extend(getattr(l, "_sub_layers", {}).values())
 
 
-def region_count(root) -> int:
+def region_count(root, seen=None) -> int:
+    """Active regions under `root`. Pass a shared `seen` set to count
+    overlapping roots without double-counting."""
     n = 0
     stack = [root]
-    seen = set()
+    if seen is None:
+        seen = set()
     while stack:
         l = stack.pop()
         if id(l) in seen or l is None:

@@ -25,11 +25,7 @@ def _base(rank):
     return np.arange(6, dtype=np.float32).reshape(2, 3) + 10 * (rank + 1)
 
 
-@pytest.fixture(scope="module")
-def cluster_results(tmp_path_factory):
-    out_dir = str(tmp_path_factory.mktemp("collective"))
-    port = _free_port()
-    worker = os.path.join(os.path.dirname(__file__), "collective_worker.py")
+def _spawn_cluster(out_dir, worker, port):
     procs = []
     for rank in range(2):
         env = dict(os.environ)
@@ -43,25 +39,57 @@ def cluster_results(tmp_path_factory):
             "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:617{rank}",
             "PADDLE_MASTER": f"127.0.0.1:{port}",
             "COLLECTIVE_OUT_DIR": out_dir,
+            # fail fast inside the workers so a dead rendezvous surfaces
+            # as a retryable error, not a fixture-killing 300 s hang
+            # (120 s: a loaded CI box can take >60 s just importing jax
+            # in the peer, and the store wait covers that window)
+            "PADDLE_STORE_TIMEOUT": "120",
         })
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
             [sys.executable, worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     outs = []
+    hung = False
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=180)
+            out, _ = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()
-            pytest.fail("collective worker hung:\n" + out.decode())
+            hung = True
         outs.append(out.decode())
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-    return {r: dict(np.load(os.path.join(out_dir, f"rank{r}.npz"),
-                            allow_pickle=True))
-            for r in range(2)}
+    ok = not hung and all(p.returncode == 0 for p in procs)
+    # transient = hang, or a rendezvous/connect error (stolen master
+    # port); a deterministic worker bug should fail immediately, not
+    # burn two more 240 s attempts
+    transient = hung or any(
+        ("ConnectionError" in o or "TimeoutError" in o
+         or "cannot reach" in o or "Connection refused" in o)
+        for o in outs)
+    return ok, transient, outs
+
+
+@pytest.fixture(scope="module")
+def cluster_results(tmp_path_factory):
+    worker = os.path.join(os.path.dirname(__file__), "collective_worker.py")
+    # The master port comes from a close-then-rebind probe, so another
+    # process can steal it in the window (rank 0 then degrades to client
+    # and both workers wait on a master that never exists). Retry the
+    # whole spawn on a fresh port — rendezvous failures are transient.
+    last = None
+    for attempt in range(3):
+        out_dir = str(tmp_path_factory.mktemp(f"collective{attempt}"))
+        ok, transient, outs = _spawn_cluster(out_dir, worker, _free_port())
+        if ok:
+            return {r: dict(np.load(os.path.join(out_dir, f"rank{r}.npz"),
+                                    allow_pickle=True))
+                    for r in range(2)}
+        last = outs
+        if not transient:
+            break
+    pytest.fail("collective cluster failed; last outputs:\n"
+                + "\n----\n".join(last))
 
 
 def test_all_reduce(cluster_results):

@@ -40,9 +40,11 @@ def main():
     B, win, prompt_len = 16, 16, 128
     paddle.seed(0)
     cfg = S.PagedServingConfig.llama_1b(max_batch=B, num_blocks=B * 6 + 16)
-    with jax.default_device(jax.devices("cpu")[0]):
-        model = S.PagedCausalLM(cfg)
-    model.eval()
+    model = None
+    if stages & {"full", "greedy", "no_attn"}:
+        with jax.default_device(jax.devices("cpu")[0]):
+            model = S.PagedCausalLM(cfg)
+        model.eval()
     rng = np.random.RandomState(0)
     sp = S.SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
 
@@ -112,19 +114,10 @@ def main():
     h, f, V = cfg.hidden_size, cfg.ffn_size, cfg.vocab_size
     L = cfg.num_layers
     key = jax.random.key(0)
-    Ws = {
-        "qkv": jnp.zeros((L, h, h + 2 * cfg.num_kv_heads * cfg.head_dim),
-                         jnp.bfloat16),
-        "proj": jnp.zeros((L, h, h), jnp.bfloat16),
-        "gu": jnp.zeros((L, h, 2 * f), jnp.bfloat16),
-        "down": jnp.zeros((L, f, h), jnp.bfloat16),
-        "head": jnp.zeros((h, V), jnp.bfloat16),
-        "emb": jnp.zeros((V, h), jnp.bfloat16),
-    }
-    Ws = jax.tree_util.tree_map(
-        lambda a: jax.device_put(
-            jax.random.normal(key, a.shape, jnp.float32).astype(a.dtype)
-            * 0.02, jax.devices()[0]), Ws)
+    if "weights" not in stages:
+        Ws = None
+    else:
+        Ws = _make_ws(cfg, key)
 
     def wstep(carry, _):
         x = carry  # [T, h]
@@ -149,14 +142,13 @@ def main():
         dt = timed(lambda: wrun(x0))
         res["weights_ms_per_step"] = round(dt / win * 1e3, 3)
 
-    # -- sampler alone ----------------------------------------------------
-    logits = jax.device_put(
-        jax.random.normal(key, (B + 1, V), jnp.float32))
-    temps = jnp.full((B + 1,), 0.8, jnp.float32)
-    topks = jnp.full((B + 1,), 50, jnp.int32)
-    topps = jnp.full((B + 1,), 0.95, jnp.float32)
-
     if "sampler" in stages:
+        logits = jax.device_put(
+            jax.random.normal(key, (B + 1, V), jnp.float32))
+        temps = jnp.full((B + 1,), 0.8, jnp.float32)
+        topks = jnp.full((B + 1,), 50, jnp.int32)
+        topps = jnp.full((B + 1,), 0.95, jnp.float32)
+
         def srun(lg):
             def body(c, j):
                 salts = jnp.full((B + 1,), j, jnp.int32)
@@ -172,6 +164,24 @@ def main():
     dev = jax.devices()[0]
     res["device"] = str(getattr(dev, "device_kind", dev))
     print(json.dumps(res))
+
+
+def _make_ws(cfg, key):
+    h, f, V = cfg.hidden_size, cfg.ffn_size, cfg.vocab_size
+    L = cfg.num_layers
+    Ws = {
+        "qkv": jnp.zeros((L, h, h + 2 * cfg.num_kv_heads * cfg.head_dim),
+                         jnp.bfloat16),
+        "proj": jnp.zeros((L, h, h), jnp.bfloat16),
+        "gu": jnp.zeros((L, h, 2 * f), jnp.bfloat16),
+        "down": jnp.zeros((L, f, h), jnp.bfloat16),
+        "head": jnp.zeros((h, V), jnp.bfloat16),
+        "emb": jnp.zeros((V, h), jnp.bfloat16),
+    }
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            jax.random.normal(key, a.shape, jnp.float32).astype(a.dtype)
+            * 0.02, jax.devices()[0]), Ws)
 
 
 if __name__ == "__main__":
