@@ -101,16 +101,32 @@ class SparseTable:
         return rng.uniform(-self.init_range, self.init_range,
                            self.dim).astype(np.float32)
 
+    # storage primitives — SSDSparseTable overrides these two
+    def _fetch(self, k: int):
+        """(row, slots) for key k, lazily initializing."""
+        row = self._rows.get(k)
+        if row is None:
+            row = self._init_row(k)
+            self._rows[k] = row
+        ns = self.rule.slots
+        slots = None
+        if ns:
+            slots = self._slots.get(k)
+            if slots is None:
+                slots = np.zeros((ns, self.dim), np.float32)
+                self._slots[k] = slots
+        return row, slots
+
+    def _commit(self, k: int, row, slots):
+        self._rows[k] = row
+        if slots is not None:
+            self._slots[k] = slots
+
     def pull(self, keys) -> np.ndarray:
         with self._lock:
             out = np.empty((len(keys), self.dim), np.float32)
             for i, k in enumerate(keys):
-                k = int(k)
-                row = self._rows.get(k)
-                if row is None:
-                    row = self._init_row(k)
-                    self._rows[k] = row
-                out[i] = row
+                out[i] = self._fetch(int(k))[0]
             return out
 
     def push(self, keys, grads: np.ndarray):
@@ -125,20 +141,13 @@ class SparseTable:
             rows = np.empty((len(uniq), self.dim), np.float32)
             slots = np.zeros((len(uniq), max(ns, 1), self.dim), np.float32)
             for i, k in enumerate(uniq):
-                k = int(k)
-                if k not in self._rows:
-                    self._rows[k] = self._init_row(k)
-                rows[i] = self._rows[k]
+                row, sl = self._fetch(int(k))
+                rows[i] = row
                 if ns:
-                    if k not in self._slots:
-                        self._slots[k] = np.zeros((ns, self.dim), np.float32)
-                    slots[i] = self._slots[k]
+                    slots[i] = sl
             rows, slots = self.rule.update(rows, slots, agg)
             for i, k in enumerate(uniq):
-                k = int(k)
-                self._rows[k] = rows[i]
-                if ns:
-                    self._slots[k] = slots[i]
+                self._commit(int(k), rows[i], slots[i] if ns else None)
 
     def size(self) -> int:
         with self._lock:
@@ -156,6 +165,119 @@ class SparseTable:
                           for k, v in st["rows"].items()}
             self._slots = {int(k): np.asarray(v, np.float32)
                            for k, v in st.get("slots", {}).items()}
+
+
+class SSDSparseTable(SparseTable):
+    """SparseTable with a bounded hot cache and disk spill — the
+    capability class of the reference's SSD table
+    (paddle/fluid/distributed/ps/table/ssd_sparse_table.h: RocksDB
+    behind MemorySparseTable's API, for row counts beyond DRAM).
+
+    Design: fixed-size records (row + optimizer slots) in one slot file;
+    the in-memory index is {key -> record offset} (16 B/key — 100B keys
+    would need ~1.6 GB of index, the same envelope as the reference's
+    in-memory RocksDB index/bloom). The hot set lives in an LRU dict;
+    eviction writes the record at its offset (append-on-first-spill).
+    pull/push touch only the LRU on a hit, one seek+read on a miss."""
+
+    def __init__(self, dim: int, rule: str = "sgd",
+                 init_range: float = 0.01, seed: int = 0,
+                 cache_rows: int = 100_000, path: Optional[str] = None,
+                 **rule_kw):
+        super().__init__(dim, rule, init_range, seed, **rule_kw)
+        import os
+        import tempfile
+        from collections import OrderedDict
+
+        self._rows = OrderedDict()      # LRU: oldest first
+        self._cap = int(cache_rows)
+        if path is None:
+            fd, path = tempfile.mkstemp(prefix="pt_ssd_table_",
+                                        suffix=".bin")
+            os.close(fd)
+            self._unlink = path
+        else:
+            self._unlink = None
+        self._path = path
+        self._file = open(path, "w+b")
+        self._off: Dict[int, int] = {}
+        ns = self.rule.slots
+        self._rec_elems = dim + ns * dim
+        self._rec_bytes = self._rec_elems * 4
+        self._end = 0
+
+    def __del__(self):
+        try:
+            self._file.close()
+            if self._unlink:
+                import os
+
+                os.unlink(self._unlink)
+        except Exception:
+            pass
+
+    def _spill(self, k: int, row, slots):
+        rec = row if slots is None else np.concatenate(
+            [row, slots.reshape(-1)])
+        off = self._off.get(k)
+        if off is None:
+            off = self._off[k] = self._end
+            self._end += self._rec_bytes
+        self._file.seek(off)
+        self._file.write(rec.astype(np.float32).tobytes())
+
+    def _evict_if_full(self):
+        while len(self._rows) > self._cap:
+            k, row = self._rows.popitem(last=False)
+            self._spill(k, row, self._slots.pop(k, None))
+
+    def _fetch(self, k: int):
+        ns = self.rule.slots
+        row = self._rows.get(k)
+        if row is not None:
+            self._rows.move_to_end(k)
+            return row, self._slots.get(k)
+        off = self._off.get(k)
+        if off is not None:
+            self._file.seek(off)
+            rec = np.frombuffer(
+                self._file.read(self._rec_bytes), np.float32).copy()
+            row = rec[:self.dim]
+            slots = rec[self.dim:].reshape(ns, self.dim) if ns else None
+        else:
+            row = self._init_row(k)
+            slots = np.zeros((ns, self.dim), np.float32) if ns else None
+        self._rows[k] = row
+        if slots is not None:
+            self._slots[k] = slots
+        # note: a tiny cache may evict k itself right here — the caller
+        # already holds the row/slots objects, and _commit re-inserts
+        self._evict_if_full()
+        return row, slots
+
+    def _commit(self, k: int, row, slots):
+        self._rows[k] = row
+        self._rows.move_to_end(k)
+        if slots is not None:
+            self._slots[k] = slots
+        self._evict_if_full()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(set(self._off) | set(self._rows))
+
+    def state(self):
+        # materialize disk + hot rows (test/ckpt path; heavy by design)
+        with self._lock:
+            rows = {}
+            slots = {}
+            ns = self.rule.slots
+            for k in set(self._off) | set(self._rows):
+                r, s = self._fetch(k)
+                rows[k] = np.asarray(r).copy()
+                if ns:
+                    slots[k] = np.asarray(s).copy()
+            return {"dim": self.dim, "rows": rows, "slots": slots}
 
 
 class DenseTable:

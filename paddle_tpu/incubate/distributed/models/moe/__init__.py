@@ -25,7 +25,8 @@ from .....core.dispatch import apply
 from .....core.tensor import Tensor
 from .....nn import functional as F
 
-__all__ = ["MoELayer", "TopKGate", "top2_gating"]
+__all__ = ["MoELayer", "TopKGate", "top2_gating", "topk_sort_dispatch",
+           "dispatch_to_experts", "combine_from_experts"]
 
 
 def top2_gating(logits, capacity_factor=1.5, top_k=2):
@@ -64,6 +65,68 @@ def top2_gating(logits, capacity_factor=1.5, top_k=2):
         jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32), axis=0)
     aux = jnp.sum(me * ce) * e
     return dispatch, combine, aux
+
+
+def topk_sort_dispatch(logits, capacity_factor=1.5, top_k=2):
+    """Count-based routing without the dense [S, E, C] one-hots: the TPU
+    mapping of the reference's ragged count-based exchange
+    (distributed/utils/moe_utils.py:20 global_scatter — counts +
+    all_to_all). Token-expert pairs are sorted by expert id (stable, in
+    round-then-token priority order — identical fill priority to
+    top2_gating's iterative loop), ranks within each expert come from the
+    bincount prefix, and pairs beyond capacity drop. O(S*K) index math
+    instead of O(S*E*C) masks.
+
+    Returns (slot [S, K] int32 into the [E*C] expert buffer, -1 =
+    dropped; gate [S, K] f32; capacity; aux_loss)."""
+    s, e = logits.shape
+    k = top_k
+    capacity = max(int(capacity_factor * s * k / e), 1)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate, exp_idx = jax.lax.top_k(probs, k)               # [S, K]
+    # priority order = (round, token): round-major flatten + stable sort
+    flat_e = exp_idx.T.reshape(-1)                        # [K*S]
+    order = jnp.argsort(flat_e, stable=True)
+    counts = jnp.bincount(flat_e, length=e)               # [E]
+    starts = jnp.cumsum(counts) - counts
+    sorted_rank = jnp.arange(s * k) - starts[flat_e[order]]
+    rank = jnp.zeros_like(sorted_rank).at[order].set(sorted_rank)
+    keep = rank < capacity
+    slot = jnp.where(keep, flat_e * capacity + rank, -1)
+    slot = slot.reshape(k, s).T.astype(jnp.int32)         # [S, K]
+    gate = gate * (slot >= 0)
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=jnp.float32),
+        axis=0)
+    aux = jnp.sum(me * ce) * e
+    return slot, gate, capacity, aux
+
+
+def dispatch_to_experts(x, slot, num_experts, capacity):
+    """x [S, D], slot [S, K] -> expert buffer [E, C, D] (dropped pairs
+    land on a discarded overflow row). Slots are unique by construction,
+    so a plain scatter-set suffices."""
+    s, d = x.shape
+    k = slot.shape[1]
+    xk = jnp.broadcast_to(x[:, None], (s, k, d)).reshape(s * k, d)
+    flat = slot.reshape(-1)
+    safe = jnp.where(flat >= 0, flat, num_experts * capacity)
+    buf = jnp.zeros((num_experts * capacity + 1, d), x.dtype) \
+        .at[safe].set(xk)
+    return buf[:-1].reshape(num_experts, capacity, d)
+
+
+def combine_from_experts(expert_out, slot, gate):
+    """expert_out [E, C, D], slot [S, K], gate [S, K] -> [S, D]."""
+    e, c, d = expert_out.shape
+    s, k = slot.shape
+    flat = slot.reshape(-1)
+    safe = jnp.where(flat >= 0, flat, 0)
+    vals = expert_out.reshape(e * c, d)[safe].reshape(s, k, d)
+    w = (gate * (slot >= 0)).astype(vals.dtype)
+    return jnp.einsum("skd,sk->sd", vals, w)
 
 
 class TopKGate(nn.Layer):
@@ -111,37 +174,48 @@ class MoELayer(nn.Layer):
         b, s, d = x.shape[0], x.shape[1], x.shape[2]
         flat = x.reshape([b * s, d])
         logits = self.gate(flat)
+        e, k = self.num_experts, self.top_k
+        capacity = max(int(self.capacity_factor * b * s * k / e), 1)
 
         def gating(lg):
-            return top2_gating(lg, self.capacity_factor, self.top_k)
+            slot, gate, _, aux = topk_sort_dispatch(
+                lg, self.capacity_factor, k)
+            return slot, gate, aux
 
-        dispatch, combine, aux = apply(gating, logits, op_name="moe_gate")
+        slot, gate, aux = apply(gating, logits, op_name="moe_gate_sort")
         self.aux_loss = aux
 
-        # [S,E,C] x [S,D] -> [E,C,D]
-        from .....ops.linalg import einsum
-
-        expert_in = einsum("sec,sd->ecd", dispatch, flat)
+        expert_in = apply(
+            lambda xa, sl: dispatch_to_experts(xa, sl, e, capacity),
+            flat, slot, op_name="moe_dispatch")
         outs = []
         for i, expert in enumerate(self.experts):
             outs.append(expert(expert_in[i]))
         from .....ops.manipulation import stack
 
         expert_out = stack(outs, axis=0)  # [E,C,D]
-        out = einsum("sec,ecd->sd", combine, expert_out)
-        return out.reshape([b, s, d])
+        out = apply(combine_from_experts, expert_out, slot, gate,
+                    op_name="moe_combine")
+        return out.astype(x.dtype).reshape([b, s, d])
 
 
 def moe_block_stacked(params, x, top_k=2, capacity_factor=1.5):
     """Functional MoE for the compiled path: params = {wg [D,E],
-    w1 [E,D,F], w2 [E,F,D]} with E sharded over the ep axis. One einsum
-    dispatch, grouped expert matmuls, one combine — all_to_all inserted by
-    GSPMD when tokens and experts live on different shards."""
+    w1 [E,D,F], w2 [E,F,D]} with E sharded over the ep axis. Sort-based
+    count dispatch (topk_sort_dispatch) scatters tokens into the
+    [E, C, D] expert buffer, grouped expert matmuls run on the MXU, and
+    the combine gathers back — GSPMD inserts the token<->expert
+    all_to_all when tokens and experts live on different shards. (The
+    earlier dense [S,E,C] einsum route cost O(S*E*C) memory — unusable
+    at E=64.)"""
     s, d = x.shape
+    e = params["wg"].shape[1]
     logits = x.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
-    dispatch, combine, aux = top2_gating(logits, capacity_factor, top_k)
-    expert_in = jnp.einsum("sec,sd->ecd", dispatch, x.astype(jnp.float32))
+    slot, gate, capacity, aux = topk_sort_dispatch(
+        logits, capacity_factor, top_k)
+    expert_in = dispatch_to_experts(x.astype(jnp.float32), slot, e,
+                                    capacity)
     h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, params["w1"]))
     expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
-    out = jnp.einsum("sec,ecd->sd", combine, expert_out)
+    out = combine_from_experts(expert_out, slot, gate)
     return out.astype(x.dtype), aux

@@ -215,6 +215,41 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     ck = np.asarray(cu_seqlens_k.numpy()
                     if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k)
 
+    # Pallas segment-ids kernel path: ONE static-shape program for every
+    # cu_seqlens pattern (the per-segment fallback below compiles one
+    # program per pattern). Identical q/k layouts make the kernel's
+    # packed-position causal exactly FA2's per-segment causal.
+    from ....ops.pallas import varlen_attention as VA
+    from ....ops.pallas import use_pallas as _use_pallas
+
+    d_head = int(query.shape[-1])
+    kernel_ok = ((dropout == 0.0 or not training)
+                 and scale is None
+                 and (_use_pallas() or VA._interpret())
+                 and d_head % 64 == 0
+                 and np.array_equal(cq, ck))
+    if kernel_ok:
+        total = int(query.shape[0])
+        padded = 128 * ((total + 127) // 128)
+        seg_np = VA.segment_ids_from_cu_seqlens(cq, padded)
+
+        def fnk(q, k, v, seg):
+            pad = padded - q.shape[0]
+            qp = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+            kp = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+            vp = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+            qt = qp.transpose(1, 0, 2)[None]        # [1, H, Tp, D]
+            kt = kp.transpose(1, 0, 2)[None]
+            vt = vp.transpose(1, 0, 2)[None]
+            o = VA.varlen_flash_attention_packed(
+                qt, kt, vt, seg[None], seg[None], is_causal=causal)
+            return o[0].transpose(1, 0, 2)[:q.shape[0]]
+
+        out = apply(fnk, query, key, value,
+                    jnp.asarray(seg_np),
+                    op_name="flash_attn_unpadded_pallas")
+        return out, None
+
     def fn(q, k, v):
         # per-segment dense attention (the reference kernel's memory
         # profile: logits bounded by the LARGEST segment, not total²;
@@ -442,8 +477,12 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     int8 path and must be None/-1 here."""
     if qkv_out_scale is not None or out_shift is not None \
             or out_smooth is not None or (out_scale or -1) > 0:
-        raise NotImplementedError("masked_multihead_attention: int8 cache "
-                                  "quantization is CUDA-specific")
+        raise NotImplementedError(
+            "masked_multihead_attention: static activation-scale int8 "
+            "(qkv_out_scale/out_shift/out_smooth) is CUDA-calibration-"
+            "specific; the TPU int8 KV-cache path is "
+            "block_multihead_attention(use_dynamic_cachekv_quant=True) "
+            "with per-slot dynamic scales")
     if cache_kv is None:
         raise ValueError("cache_kv is required")
 
@@ -521,7 +560,8 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                               use_dynamic_cachekv_quant=False,
                               quant_round_type=1, quant_max_bound=127.0,
                               quant_min_bound=-127.0, out_scale=-1,
-                              compute_dtype="default", layer_idx=None):
+                              compute_dtype="default", layer_idx=None,
+                              fresh_prefill=False):
     """Paged-KV-cache attention (reference block_multihead_attention):
     qkv [token_num, (HQ+2*HKV)*D] packs each batch row's tokens this step
     (prefill rows contribute seq_lens_encoder[b] tokens at positions
@@ -533,10 +573,25 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     are scattered into their pages, then each token attends its row's
     filled prefix (causal). Returns
     (out [token_num, HQ*D], qkv, key_cache, value_cache).
-    int8 cache quant and pre_caches are CUDA-path-only (must be None)."""
-    if cache_k_quant_scales is not None or use_dynamic_cachekv_quant:
-        raise NotImplementedError("block_multihead_attention: int8 cache "
-                                  "quantization is CUDA-specific")
+
+    Int8 KV cache (use_dynamic_cachekv_quant=True): caches are int8 page
+    pools and cache_k_quant_scales / cache_v_quant_scales are PER-SLOT
+    scale pools ([num_blocks, HKV, bs], or [L, ...] stacked) updated on
+    write — the TPU mapping of the reference's dynamic cachekv quant
+    (block_multi_head_attention.cu cache_k_quant_scales...): each
+    written token stores round(x / s) with s = max|x|/127 per head, and
+    the gather dequantizes s * int8 into the compute dtype. Cache HBM
+    traffic and footprint halve vs bf16. Returns
+    (out, qkv, key_cache, value_cache, k_scales, v_scales) in this mode.
+    Static per-tensor scale args (the non-dynamic CUDA path) and
+    pre_caches stay unsupported."""
+    if cache_k_quant_scales is not None and not use_dynamic_cachekv_quant:
+        raise NotImplementedError("block_multihead_attention: static "
+                                  "per-tensor cache scales are CUDA-"
+                                  "specific; use dynamic cachekv quant")
+    if use_dynamic_cachekv_quant and (cache_k_quant_scales is None
+                                      or cache_v_quant_scales is None):
+        raise ValueError("dynamic cachekv quant needs k/v scale pools")
     if pre_key_cache is not None:
         raise NotImplementedError("pre_caches not supported")
     if mask is not None or tgt_mask is not None:
@@ -544,20 +599,31 @@ def block_multihead_attention(qkv, key_cache, value_cache,
                                   "masks beyond the built-in causal/"
                                   "length masking are not supported")
 
+    quant = bool(use_dynamic_cachekv_quant)
+
     def fn(qkva, kc_in, vc_in, enc, dec, this, cu_q, bt, *rest):
         it = iter(rest)
+        ks_in = next(it) if quant else None
+        vs_in = next(it) if quant else None
         b = next(it) if qkv_bias is not None else None
         rope = next(it) if rope_emb is not None else None
         T = qkva.shape[0]
+        # stacked-cache mode (layer_idx given): caches are
+        # [L, num_blocks, H, bs, D] and every access uses a COMPOSITE
+        # (layer, ...) index — scatter straight into the stacked buffer,
+        # gather pages with (layer, block_table) start indices. The
+        # earlier slice-out / dynamic-update-slice-back pattern
+        # materialized a full per-layer cache copy each layer (decode
+        # step time scaled with the PAGE-POOL size: 2.3 ms at 88 pages
+        # vs 5.7 ms at 248, tools/ablate_cachesize.py).
         if layer_idx is None:
             kc, vc = kc_in, vc_in
+            ks, vs = ks_in, vs_in
+            num_blocks, HKV, bs, D = kc.shape
         else:
-            # stacked-cache mode: caches are [L, num_blocks, H, bs, D];
-            # operate on this layer's slice and write it back with ONE
-            # dynamic-update-slice so the whole layer loop aliases into
-            # a single pair of buffers
-            kc, vc = kc_in[layer_idx], vc_in[layer_idx]
-        num_blocks, HKV, bs, D = kc.shape
+            kc, vc = kc_in, vc_in
+            ks, vs = ks_in, vs_in
+            num_blocks, HKV, bs, D = kc.shape[1:]
         B, max_blocks = bt.shape
         max_seq = max_blocks * bs
         if b is not None:
@@ -599,18 +665,71 @@ def block_multihead_attention(qkv, key_cache, value_cache,
             # dtype so the page scatter below matches the cache dtype
             q = rope_t(q).astype(qkva.dtype)
             k = rope_t(k).astype(qkva.dtype)
-        # scatter new k/v into pages
+        # scatter new k/v into pages (straight into the stacked buffer
+        # via the composite (layer, page, :, slot) index in stacked mode)
         page = bt[t2b, pos // bs]                            # [T]
         slot = pos % bs
-        kc = kc.at[page, :, slot, :].set(k)
-        vc = vc.at[page, :, slot, :].set(v)
-        # dense view of each row's cache
-        seqpos = jnp.arange(max_seq)
-        page_of = bt[:, seqpos // bs]                        # [B, max_seq]
-        kd = kc[page_of, :, seqpos[None, :] % bs, :]         # [B, S, H, D]
-        vd = vc[page_of, :, seqpos[None, :] % bs, :]
-        kd = jnp.swapaxes(kd, 1, 2)                          # [B, HKV, S, D]
-        vd = jnp.swapaxes(vd, 1, 2)
+        li = (() if layer_idx is None else (layer_idx,))
+        if quant:
+            # dynamic int8: one scale per written (token, head) —
+            # s = max|x|/127, store round(x/s) int8 + s in the scale pool
+            def q8(x):
+                s = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1) \
+                    / 127.0                                  # [T, HKV]
+                s = jnp.maximum(s, 1e-8)
+                xi = jnp.clip(jnp.round(x.astype(jnp.float32)
+                                        / s[..., None]), -127, 127) \
+                    .astype(jnp.int8)
+                return xi, s.astype(jnp.float32)
+
+            k8, k_s = q8(k)
+            v8, v_s = q8(v)
+            kc = kc.at[li + (page, slice(None), slot)].set(k8)
+            vc = vc.at[li + (page, slice(None), slot)].set(v8)
+            ks = ks.at[li + (page, slice(None), slot)].set(k_s)
+            vs = vs.at[li + (page, slice(None), slot)].set(v_s)
+        else:
+            kc = kc.at[li + (page, slice(None), slot)].set(k)
+            vc = vc.at[li + (page, slice(None), slot)].set(v)
+        if fresh_prefill:
+            # every scheduled row starts at cache position 0, so keys ==
+            # this step's packed tokens: block-diagonal varlen flash over
+            # the pack (segment id = batch row; trash row = -1), skipping
+            # the full page-pool gather below entirely
+            from ....ops.pallas.varlen_attention import \
+                varlen_flash_attention_packed
+
+            seg = jnp.where(t2b == B - 1, -1, t2b).astype(jnp.int32)
+            G = HQ // HKV
+            kr = jnp.repeat(k, G, axis=1) if G > 1 else k    # [T, HQ, D]
+            vr = jnp.repeat(v, G, axis=1) if G > 1 else v
+            o = varlen_flash_attention_packed(
+                q.transpose(1, 0, 2)[None], kr.transpose(1, 0, 2)[None],
+                vr.transpose(1, 0, 2)[None], seg[None], seg[None],
+                is_causal=True)
+            out = o[0].transpose(1, 0, 2)                    # [T, HQ, D]
+            if quant:
+                return out.reshape(T, HQ * D), qkva, kc, vc, ks, vs
+            return out.reshape(T, HQ * D), qkva, kc, vc
+        # dense view of each row's cache — gather WHOLE pages ([B, MB]
+        # indices, 64 KB contiguous slices) instead of per-(row, pos)
+        # strided element slices: the [B, S] advanced-index gather
+        # lowered to a scalar-slice gather that dominated the decode and
+        # chunked-prefill steps on TPU
+        kp = kc[li + (bt,)]                          # [B, MB, HKV, bs, D]
+        vp = vc[li + (bt,)]
+        kd = kp.transpose(0, 2, 1, 3, 4).reshape(
+            B, HKV, max_seq, D)                      # [B, HKV, S, D]
+        vd = vp.transpose(0, 2, 1, 3, 4).reshape(B, HKV, max_seq, D)
+        if quant:
+            # dequant the gathered view: int8 pages * per-slot scales
+            # (cache HBM traffic already halved at this point)
+            ksd = ks[li + (bt,)].transpose(0, 2, 1, 3).reshape(
+                B, HKV, max_seq)[..., None]          # [B, HKV, S, 1]
+            vsd = vs[li + (bt,)].transpose(0, 2, 1, 3).reshape(
+                B, HKV, max_seq)[..., None]
+            kd = (kd.astype(jnp.float32) * ksd).astype(qkva.dtype)
+            vd = (vd.astype(jnp.float32) * vsd).astype(qkva.dtype)
         G = HQ // HKV
         qg = q.reshape(T, HKV, G, D)
         # MXU dots take the low-precision operands directly with f32
@@ -620,22 +739,22 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         logits = jnp.einsum("tkgd,tksd->tkgs", qg, kd[t2b],
                             preferred_element_type=jnp.float32) \
             / jnp.sqrt(jnp.float32(D))
-        valid = seqpos[None, :] <= pos[:, None]              # [T, S]
+        valid = jnp.arange(max_seq)[None, :] <= pos[:, None]   # [T, S]
         logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("tkgs,tksd->tkgd", probs.astype(qkva.dtype),
                          vd[t2b],
                          preferred_element_type=jnp.float32) \
             .astype(qkva.dtype)
-        if layer_idx is not None:
-            kc = kc_in.at[layer_idx].set(kc)
-            vc = vc_in.at[layer_idx].set(vc)
+        if quant:
+            return out.reshape(T, HQ * D), qkva, kc, vc, ks, vs
         return out.reshape(T, HQ * D), qkva, kc, vc
 
     args = [qkv, key_cache, value_cache, seq_lens_encoder,
             seq_lens_decoder, seq_lens_this_time, cu_seqlens_q,
-            block_tables] + [t for t in (qkv_bias, rope_emb)
-                             if t is not None]
+            block_tables] \
+        + ([cache_k_quant_scales, cache_v_quant_scales] if quant else []) \
+        + [t for t in (qkv_bias, rope_emb) if t is not None]
     return apply(fn, *args, op_name="block_multihead_attention")
 
 

@@ -49,7 +49,7 @@ class PagedServingConfig:
     def __init__(self, vocab_size=256, hidden_size=64, num_layers=2,
                  num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
-                 num_kv_heads=None, dtype="float32"):
+                 num_kv_heads=None, dtype="float32", cache_quant=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -63,6 +63,11 @@ class PagedServingConfig:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.token_budget = token_budget
         self.dtype = dtype
+        # cache_quant="int8": pages stored int8 with per-(token, head)
+        # dynamic scales — cache memory and HBM decode traffic halve
+        if cache_quant not in (None, "int8"):
+            raise ValueError("cache_quant must be None or 'int8'")
+        self.cache_quant = cache_quant
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -245,7 +250,7 @@ class PagedCausalLM(Layer):
     # -- exported paged step ---------------------------------------------
     def forward(self, tokens, seq_lens_encoder, seq_lens_decoder,
                 seq_lens_this_time, cu_seqlens_q, block_tables,
-                key_caches, value_caches):
+                key_caches, value_caches, k_scales=None, v_scales=None):
         """One engine step.
 
         tokens [T] int32 packed (each scheduled row contributes its
@@ -276,6 +281,8 @@ class PagedCausalLM(Layer):
 
         rope = apply(rope_emb_arg, op_name="rope_table")
         new_kc, new_vc = key_caches, value_caches
+        new_ks, new_vs = k_scales, v_scales
+        quant = k_scales is not None
         for li in range(cfg.num_layers):
             h = self.ln1[li](x)
             qkv = self.qkv[li](h)                      # [T, (HQ+2HKV)*D]
@@ -283,12 +290,22 @@ class PagedCausalLM(Layer):
             # the ONE [L, pool] cache pair (single dynamic-update-slice
             # chain — the list+jnp.stack pattern rebuilt the full cache
             # every step)
-            out, _, new_kc, new_vc = IF.block_multihead_attention(
+            outs = IF.block_multihead_attention(
                 qkv, new_kc, new_vc,
                 seq_lens_encoder, seq_lens_decoder,
                 seq_lens_this_time, None, None, cu_seqlens_q, None,
-                block_tables, rope_emb=rope, layer_idx=li,
-                max_seq_len=cfg.max_seq, block_size=cfg.block_size)
+                block_tables,
+                cache_k_quant_scales=new_ks if quant else None,
+                cache_v_quant_scales=new_vs if quant else None,
+                use_dynamic_cachekv_quant=quant,
+                rope_emb=rope, layer_idx=li,
+                max_seq_len=cfg.max_seq, block_size=cfg.block_size,
+                fresh_prefill=getattr(self, "_step_mode", None)
+                == "fresh_prefill")
+            if quant:
+                out, _, new_kc, new_vc, new_ks, new_vs = outs
+            else:
+                out, _, new_kc, new_vc = outs
             x = x + self.proj[li](out)
             h = self.ln2[li](x)
             x = x + self._mlp(li, h)
@@ -301,6 +318,8 @@ class PagedCausalLM(Layer):
 
         last = apply(pick_last, x, cu_seqlens_q, op_name="pick_last")
         logits = self.head(last)                             # [B+1, V]
+        if quant:
+            return logits, new_kc, new_vc, new_ks, new_vs
         return logits, new_kc, new_vc
 
     # -- stateless dense reference over the same weights -----------------
@@ -409,12 +428,20 @@ class ServingEngine:
             self._fixed_token_len = cfg.token_budget
         else:
             self._fixed_token_len = None
+        self._compiled_fresh = None   # set by from_model (jit engines)
         self.seed = seed
         self.cfg = cfg
         L = cfg.num_layers
         shape = (L, cfg.num_blocks, cfg.num_kv_heads, cfg.block_size,
                  cfg.head_dim)
-        cache_dt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        if cfg.cache_quant == "int8":
+            cache_dt = jnp.int8
+            self._ks = jnp.zeros(shape[:-1], jnp.float32)
+            self._vs = jnp.zeros(shape[:-1], jnp.float32)
+        else:
+            cache_dt = jnp.bfloat16 if cfg.dtype == "bfloat16" \
+                else jnp.float32
+            self._ks = self._vs = None
         self._cache_dt = cache_dt
         self._kc = jnp.zeros(shape, cache_dt)
         self._vc = jnp.zeros(shape, cache_dt)
@@ -437,8 +464,10 @@ class ServingEngine:
 
         eng = cls(None, cfg, seed=seed)
         cached = getattr(model, "_serving_shared", None)
-        if cached is not None and cached[0] == cfg.dtype:
-            _, eng._compiled, eng._params, eng._buffers = cached
+        if cached is not None and cached[0] == (cfg.dtype,
+                                                cfg.cache_quant):
+            (_, eng._compiled, eng._compiled_fresh, eng._params,
+             eng._buffers) = cached
             return eng
         params = FB.current_params(model)
         buffers = FB.current_buffers(model)
@@ -456,11 +485,23 @@ class ServingEngine:
             out, _ = FB.call_functional(model, ps, bs, ins, train=False)
             return tuple(out)
 
+        def pure_fresh(fp, fb, *ins):
+            # trace-time flag: every scheduled row starts at cache pos 0,
+            # so attention is block-diagonal varlen flash over the packed
+            # step (no page-pool gather)
+            object.__setattr__(model, "_step_mode", "fresh_prefill")
+            try:
+                return pure(fp, fb, *ins)
+            finally:
+                object.__setattr__(model, "_step_mode", None)
+
         eng._params = jax.device_put(flat_p)
         eng._buffers = jax.device_put(flat_b)
         eng._compiled = jax.jit(pure)
+        eng._compiled_fresh = jax.jit(pure_fresh)
         object.__setattr__(model, "_serving_shared",
-                           (cfg.dtype, eng._compiled, eng._params,
+                           ((cfg.dtype, cfg.cache_quant), eng._compiled,
+                            eng._compiled_fresh, eng._params,
                             eng._buffers))
         return eng
 
@@ -574,10 +615,20 @@ class ServingEngine:
         cu = np.zeros(B1 + 1, np.int32)
         cu[1:] = np.cumsum(this)
 
-        out = self._compiled(self._params, self._buffers, tokens,
-                             enc, dec, this, cu, bt, self._kc, self._vc)
+        # fresh-prefill steps (every scheduled row starts at cache pos 0)
+        # run the varlen-flash specialization: block-diagonal attention
+        # over the packed tokens instead of the page-pool gather
+        fresh = self._compiled_fresh is not None \
+            and all(r.cached == 0 for r, _ in rows)
+        compiled = self._compiled_fresh if fresh else self._compiled
+        extra = (self._ks, self._vs) if self._ks is not None else ()
+        out = compiled(self._params, self._buffers, tokens,
+                       enc, dec, this, cu, bt, self._kc, self._vc,
+                       *extra)
         logits = out[0]
         self._set_caches(out[1], out[2])
+        if self._ks is not None:
+            self._ks, self._vs = out[3], out[4]
 
         # device-side sampling for rows that reached their sequence tip
         temps = np.zeros(B1, np.float32)
@@ -641,16 +692,18 @@ class ServingEngine:
         B1 = self.cfg.max_batch + 1
         cache_dt = self._cache_dt
         compiled = self._compiled
+        quant = self._ks is not None
 
         def window(fp, fb, tokens, enc, dec, this, cu, bt, kc, vc,
-                   temps, topks, topps, salts):       # salts [n, B1]
+                   scales, temps, topks, topps, salts):  # salts [n, B1]
             live = (jnp.arange(B1) < n_rows).astype(jnp.int32)
 
             def body(carry, salts_j):
-                tokens, dec, kc, vc = carry
+                tokens, dec, kc, vc, scales = carry
                 out = compiled(fp, fb, tokens, enc, dec, this, cu, bt,
-                               kc, vc)
+                               kc, vc, *scales)
                 logits, kc, vc = out[0], out[1], out[2]
+                scales = tuple(out[3:5]) if quant else ()
                 kc = kc.astype(cache_dt)
                 vc = vc.astype(cache_dt)
                 if sample_mode == "topk":
@@ -664,11 +717,11 @@ class ServingEngine:
                 tokens = jnp.concatenate(
                     [sampled[:n_rows],
                      jnp.zeros((tok_len - n_rows,), jnp.int32)])
-                return (tokens, dec + live, kc, vc), sampled
+                return (tokens, dec + live, kc, vc, scales), sampled
 
-            (_, _, kc, vc), samples = jax.lax.scan(
-                body, (tokens, dec, kc, vc), salts)
-            return samples, kc, vc
+            (_, _, kc, vc, scales), samples = jax.lax.scan(
+                body, (tokens, dec, kc, vc, scales), salts)
+            return samples, kc, vc, scales
 
         fn = self._window_fns[key] = jax.jit(window)
         return fn
@@ -751,11 +804,13 @@ class ServingEngine:
         dec[:B] = dec0
 
         window = self._decode_window_fn(B, n, sample_mode)
-        samples, kc, vc = window(self._params, self._buffers, tokens,
-                                 enc, dec, this, cu, bt,
-                                 self._kc, self._vc,
-                                 temps, topks, topps, salts)
+        scales = (self._ks, self._vs) if self._ks is not None else ()
+        samples, kc, vc, scales = window(
+            self._params, self._buffers, tokens, enc, dec, this, cu, bt,
+            self._kc, self._vc, scales, temps, topks, topps, salts)
         self._kc, self._vc = kc, vc
+        if self._ks is not None:
+            self._ks, self._vs = scales
         fetched = np.asarray(samples)                    # [n, B1] — sync
         produced = []
         for j in range(n):

@@ -1,0 +1,111 @@
+"""Expert parallelism made real (VERDICT r4 #6): sort-based count
+dispatch equivalence vs the dense gating masks, and a multi-device MoE
+training leg with the expert dim sharded over an 'ep' mesh axis."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.incubate.distributed.models.moe import (
+    combine_from_experts, dispatch_to_experts, moe_block_stacked,
+    top2_gating, topk_sort_dispatch)
+
+
+def test_sort_dispatch_equals_dense_masks():
+    """The sort-based routing must reproduce the dense [S,E,C] one-hot
+    gating exactly: same expert assignment, same capacity drops, same
+    gate weights."""
+    rng = np.random.RandomState(0)
+    s, e, k, cf = 64, 8, 2, 1.25
+    logits = jnp.asarray(rng.randn(s, e), jnp.float32)
+    dispatch, combine, aux_d = top2_gating(logits, cf, k)
+    slot, gate, cap, aux_s = topk_sort_dispatch(logits, cf, k)
+    x = jnp.asarray(rng.randn(s, 4), jnp.float32)
+
+    ein_in = jnp.einsum("sec,sd->ecd", dispatch, x)
+    srt_in = dispatch_to_experts(x, slot, e, cap)
+    np.testing.assert_allclose(np.asarray(ein_in), np.asarray(srt_in),
+                               rtol=1e-6, atol=1e-6)
+
+    eo = jnp.asarray(rng.randn(e, cap, 4), jnp.float32)
+    ein_out = jnp.einsum("sec,ecd->sd", combine, eo)
+    srt_out = combine_from_experts(eo, slot, gate)
+    np.testing.assert_allclose(np.asarray(ein_out), np.asarray(srt_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_d), float(aux_s), rtol=1e-6)
+
+
+def test_sort_dispatch_capacity_drops():
+    """Over-capacity pairs drop in (round, token) priority order."""
+    s, e, k = 8, 2, 1
+    # every token picks expert 0
+    logits = jnp.asarray(
+        np.stack([np.full(s, 5.0), np.full(s, -5.0)], 1), jnp.float32)
+    slot, gate, cap, _ = topk_sort_dispatch(logits, capacity_factor=0.5,
+                                            top_k=k)
+    assert cap == 2
+    kept = np.asarray(slot[:, 0] >= 0)
+    assert kept.tolist() == [True, True] + [False] * 6
+    assert np.all(np.asarray(gate[2:, 0]) == 0.0)
+
+
+def _mk_params(rng, d, f, e):
+    return {
+        "wg": jnp.asarray(rng.randn(d, e) * 0.1, jnp.float32),
+        "w1": jnp.asarray(rng.randn(e, d, f) * 0.05, jnp.float32),
+        "w2": jnp.asarray(rng.randn(e, f, d) * 0.05, jnp.float32),
+    }
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_moe_ep_sharded_training_parity():
+    """3 training steps of a stacked MoE block on (dp=2, ep=4) must match
+    the same model on (dp=8, ep=1) step for step — the ep all_to_all
+    inserted by GSPMD is numerically transparent."""
+    rng = np.random.RandomState(0)
+    d, f, e, s = 16, 32, 8, 64
+    x = jnp.asarray(rng.randn(s, d), jnp.float32)
+    y = jnp.asarray(rng.randn(s, d), jnp.float32)
+
+    def loss_fn(params, x, y):
+        out, aux = moe_block_stacked(params, x)
+        return jnp.mean((out - y) ** 2) + 0.01 * aux
+
+    def make_step(mesh):
+        pspec = {"wg": P(None, "ep"), "w1": P("ep"), "w2": P("ep")}
+        shardings = {kk: NamedSharding(mesh, vv)
+                     for kk, vv in pspec.items()}
+        xs = NamedSharding(mesh, P("dp"))
+
+        @jax.jit
+        def step(params, x, y):
+            l, g = jax.value_and_grad(loss_fn)(params, x, y)
+            params = jax.tree_util.tree_map(
+                lambda p, gg: p - 1.0 * gg, params, g)
+            return l, params
+
+        def run(params):
+            params = {kk: jax.device_put(vv, shardings[kk])
+                      for kk, vv in params.items()}
+            xd = jax.device_put(x, xs)
+            yd = jax.device_put(y, xs)
+            traj = []
+            for _ in range(3):
+                l, params = step(params, xd, yd)
+                traj.append(float(l))
+            return traj
+
+        return run
+
+    devs = np.asarray(jax.devices()[:8])
+    mesh_ep = Mesh(devs.reshape(2, 4), ("dp", "ep"))
+    mesh_dp = Mesh(devs.reshape(8, 1), ("dp", "ep"))
+    p0 = _mk_params(rng, d, f, e)
+    traj_ep = make_step(mesh_ep)(dict(p0))
+    traj_dp = make_step(mesh_dp)(dict(p0))
+    assert traj_ep[-1] < traj_ep[0], traj_ep
+    for a, b in zip(traj_ep, traj_dp):
+        assert abs(a - b) < 5e-4 * max(1.0, abs(b)), (traj_ep, traj_dp)

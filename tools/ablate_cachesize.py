@@ -1,0 +1,56 @@
+"""Does decode-step cost scale with PAGE-POOL size? If yes, something
+copies the whole cache per step (scan-carry aliasing failure); if no,
+the cost is per-token attention work. Two engines, same model, same
+max_blocks_per_seq, different num_blocks. Prints one JSON line."""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import serving as S
+
+    B, prompt_len = 16, 64
+    paddle.seed(0)
+    base = S.PagedServingConfig.llama_1b(max_batch=B)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = S.PagedCausalLM(base)
+    model.eval()
+    rng = np.random.RandomState(0)
+    sp = S.SamplingParams(temperature=0.8, top_k=50, top_p=0.95)
+    res = {}
+    for tag, nb in (("small", B * 5 + 8), ("large", B * 15 + 8)):
+        cfg = S.PagedServingConfig.llama_1b(max_batch=B, num_blocks=nb)
+        model._serving_shared = None   # page-pool size changes shapes
+        eng = S.ServingEngine.from_model(model, cfg, seed=0)
+        for _ in range(B):
+            eng.add_request(list(rng.randint(1, cfg.vocab_size,
+                                             prompt_len)),
+                            max_new_tokens=126, sampling=sp)
+        while any(r.length - r.cached > 1 for r in eng.pending()):
+            eng.step()
+        eng.decode_run(2)
+        pts = []
+        for n in (8, 32):
+            dt = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = eng.decode_run(n)
+                assert len(out) == n * B, (len(out), n * B)
+                dt = min(dt, time.perf_counter() - t0)
+            pts.append((n, dt))
+        (n1, d1), (n2, d2) = pts
+        slope = (d2 - d1) / (n2 - n1)
+        res[f"{tag}_pool_pages"] = nb
+        res[f"{tag}_ms_per_step_slope"] = round(slope * 1e3, 3)
+        cache_gb = 2 * 16 * nb * 8 * 32 * 128 * 2 / 1e9
+        res[f"{tag}_cache_gb"] = round(cache_gb, 3)
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()
