@@ -7,7 +7,9 @@ config sized for a single v5e chip, and reports tokens/sec/chip and MFU.
 vs_baseline: achieved MFU / 0.45 (the BASELINE.md north-star MFU target for
 Llama-2-13B on v5p; same metric, single-chip proxy).
 
-Prints ONE JSON line.
+Prints ONE JSON line at the end, AND streams each benchmark's result to
+BENCH_partial.jsonl the moment it completes (fsync'd append), so a
+timeout or kill preserves every finished row instead of losing the run.
 """
 import json
 import os
@@ -19,6 +21,32 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_partial.jsonl")
+
+
+def emit_partial(name, payload):
+    """Append one benchmark's finished result as a JSONL line, durably:
+    write + flush + fsync per line, so a killed process loses at most
+    the row in flight — nothing already measured."""
+    line = json.dumps({"bench": name, "t": round(time.time(), 3),
+                       "result": payload})
+    try:
+        with open(PARTIAL_PATH, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+    except OSError:
+        pass
+
+
+def reset_partial():
+    try:
+        with open(PARTIAL_PATH, "w") as f:
+            f.write("")
+    except OSError:
+        pass
 
 
 def peak_flops_per_chip():
@@ -409,8 +437,11 @@ def bench_serving(on_tpu):
             prefill_dt = time.perf_counter() - t_submit
             ttft_v = sorted(ttft.values())
 
-            # decode TPOT percentiles over full windows (a tail window
-            # shrunken by the remaining-token budget would skew /win)
+            # decode TPOT spread over full windows (a tail window
+            # shrunken by the remaining-token budget would skew /win).
+            # Only a handful of windows fit the max_new budget, so the
+            # honest fields are min/max per-step time, not percentiles
+            # (two samples gave a meaningless "p95").
             win_ms = []
             for _ in range(2):
                 t0 = time.perf_counter()
@@ -424,10 +455,8 @@ def bench_serving(on_tpu):
             rows[key] = {
                 "decode_tokens_per_sec": round(win * B / dt, 1),
                 "step_ms": round(win_ms[0], 2) if win_ms else None,
-                "tpot_ms_p50": round(np.percentile(win_ms, 50), 2)
-                if win_ms else None,
-                "tpot_ms_p95": round(np.percentile(win_ms, 95), 2)
-                if win_ms else None,
+                "tpot_ms_min": round(win_ms[0], 2) if win_ms else None,
+                "tpot_ms_max": round(win_ms[-1], 2) if win_ms else None,
                 "ttft_s_p50": round(float(np.percentile(ttft_v, 50)), 3)
                 if ttft_v else None,
                 "ttft_s_p95": round(float(np.percentile(ttft_v, 95)), 3)
@@ -605,6 +634,17 @@ def bench_second_order(on_tpu):
 
 def main():
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    reset_partial()
+    # crash-safe metrics: periodic atomic snapshots next to the bench
+    # results, so a timed-out run still shows what the framework did
+    try:
+        from paddle_tpu.profiler import metrics as _metrics
+
+        _metrics.enable_periodic_flush(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_metrics.json"), interval_s=15.0)
+    except Exception:
+        _metrics = None
     from paddle_tpu.models import llama
     from jax.sharding import Mesh
 
@@ -652,6 +692,10 @@ def main():
     achieved = tokens_per_sec * flops_per_token
     mfu = achieved / peak_flops_per_chip()
     loss_val = float(jax.device_get(loss))
+    emit_partial("llama_train", {
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 4), "n_params": n_params, "batch": batch,
+        "seq": seq, "loss": loss_val})
 
     import gc
 
@@ -660,45 +704,25 @@ def main():
     gc.collect()
     jax.clear_caches()
 
-    try:
-        resnet = bench_resnet50(on_tpu)
-    except Exception as e:  # never let a secondary row kill the bench
-        resnet = {"error": str(e)[:200]}
-    gc.collect()
-    jax.clear_caches()
-    try:
-        bert = bench_bert(on_tpu)
-    except Exception as e:
-        bert = {"error": str(e)[:200]}
-    gc.collect()
-    jax.clear_caches()
-    try:
-        unet = bench_sd_unet(on_tpu)
-    except Exception as e:
-        unet = {"error": str(e)[:200]}
-    gc.collect()
-    try:
-        eager = bench_eager_dispatch(on_tpu)
-    except Exception as e:
-        eager = {"error": str(e)[:200]}
-    gc.collect()
-    jax.clear_caches()
-    try:
-        blk13b = bench_llama13b_block(on_tpu)
-    except Exception as e:
-        blk13b = {"error": str(e)[:200]}
-    gc.collect()
-    jax.clear_caches()
-    try:
-        serving = bench_serving(on_tpu)
-    except Exception as e:
-        serving = {"error": str(e)[:200]}
-    gc.collect()
-    jax.clear_caches()
-    try:
-        second_order = bench_second_order(on_tpu)
-    except Exception as e:
-        second_order = {"error": str(e)[:200]}
+    def run_row(name, fn):
+        """One secondary bench row: never kills the run, and its result
+        hits BENCH_partial.jsonl the moment it finishes."""
+        try:
+            payload = fn(on_tpu)
+        except Exception as e:
+            payload = {"error": str(e)[:200]}
+        emit_partial(name, payload)
+        gc.collect()
+        jax.clear_caches()
+        return payload
+
+    resnet = run_row("resnet50_dp", bench_resnet50)
+    bert = run_row("bert_base_pretrain", bench_bert)
+    unet = run_row("sd_unet", bench_sd_unet)
+    eager = run_row("eager_dispatch", bench_eager_dispatch)
+    blk13b = run_row("llama13b_block", bench_llama13b_block)
+    serving = run_row("serving", bench_serving)
+    second_order = run_row("second_order", bench_second_order)
 
     result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -733,6 +757,9 @@ def main():
             update_readme_table(result)
         except Exception:
             pass
+    emit_partial("final", result)
+    if _metrics is not None:
+        _metrics.disable_periodic_flush()   # final atomic snapshot
     print(json.dumps(result))
 
 

@@ -27,12 +27,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import amp_state, autograd
+from ..profiler import RecordEvent, host_tracing_active
+from ..profiler import metrics as _metrics
 from ..utils import flags as _flags
 from .dtype import FLOATING, COMPLEX
 from .tensor import Tensor
 
 __all__ = ["apply", "defop", "param_capture", "clear_op_cache",
            "op_cache_stats"]
+
+# always-on dispatch metrics (profiler/metrics.py): objects held directly
+# so the hot path pays one lock+add, no registry lookup
+_m_calls = _metrics.counter("dispatch/calls")
+_m_hit = _metrics.counter("dispatch/cache_hit")
+_m_miss = _metrics.counter("dispatch/cache_miss")
+_m_uncacheable = _metrics.counter("dispatch/uncacheable")
+_m_disabled = _metrics.counter("dispatch/cache_disabled_calls")
+_m_evicted = _metrics.counter("dispatch/cache_evictions")
+_m_fallback = _metrics.counter("dispatch/cache_fallbacks")
 
 
 def _is_tensor(x):
@@ -174,7 +186,9 @@ def op_cache_stats():
     ready = sum(1 for e in _op_cache.values()
                 if e.fwd is not None or e.vjp is not None)
     disabled = sum(1 for e in _op_cache.values() if e.disabled)
-    return {"entries": len(_op_cache), "ready": ready, "disabled": disabled}
+    return {"entries": len(_op_cache), "ready": ready, "disabled": disabled,
+            "hits": _m_hit.value, "misses": _m_miss.value,
+            "evictions": _m_evicted.value}
 
 
 def set_op_cache_enabled(on: bool):
@@ -216,8 +230,10 @@ def _evict_cold_entries():
     """Drop the half of the cache with the fewest calls (keeps hot
     steady-state executables alive instead of a full flush)."""
     by_heat = sorted(_op_cache.items(), key=lambda kv: kv[1].calls)
-    for k, _ in by_heat[: len(by_heat) // 2 or 1]:
+    victims = by_heat[: len(by_heat) // 2 or 1]
+    for k, _ in victims:
         del _op_cache[k]
+    _m_evicted.inc(len(victims))
 
 
 def _build_fwd(fn, treedef, static_vals, dyn_pos, uses_rng):
@@ -266,8 +282,34 @@ def _build_vjp(rebuild, diff_mask, uses_rng):
     return jax.jit(vjp)
 
 
-def apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
-          cacheable: bool = True, op_key=None, **kwargs):
+_registry_mod = None
+
+
+def _reg():
+    global _registry_mod
+    if _registry_mod is None:
+        from ..ops import registry as _r
+
+        _registry_mod = _r
+    return _registry_mod
+
+
+def apply(fn: Callable, *args, op_name: str = None, **kwargs):
+    """Instrumented funnel over `_apply`: every op call counts into the
+    always-on metrics registry (`dispatch/*`, per-op tallies in
+    ops/registry), and opens a host `RecordEvent` span when a Profiler
+    is collecting (checked first — zero-cost when idle)."""
+    name = op_name or getattr(fn, "__name__", "op")
+    _m_calls.inc()
+    _reg().record_call(name)
+    if host_tracing_active():
+        with RecordEvent("op::" + name):
+            return _apply(fn, *args, op_name=name, **kwargs)
+    return _apply(fn, *args, op_name=name, **kwargs)
+
+
+def _apply(fn: Callable, *args, op_name: str = None, differentiable: bool = True,
+           cacheable: bool = True, op_key=None, **kwargs):
     """Run `fn` (a pure jax function) on Tensor/array args.
 
     Tensors anywhere in the (args, kwargs) pytree are unwrapped; if any of
@@ -383,6 +425,7 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
                     static_items.append(
                         (i, type(x).__name__, _fp_value(x, 0)))
                 except _Uncacheable:
+                    _m_uncacheable.inc()
                     return _MISS
             static_vals.append((i, x))
             continue
@@ -397,12 +440,14 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
         try:
             fp = _fp_fn(fn)
         except _Uncacheable:
+            _m_uncacheable.inc()
             return _MISS
     key = (fp, treedef, tuple(static_items), tuple(dyn_pos),
            tuple(diff_mask), record)
     entry = _op_cache.get(key)
     rnd = _rand()
     if entry is None:
+        _m_miss.inc()
         if len(_op_cache) >= _MAX_ENTRIES:
             _evict_cold_entries()
         d0 = rnd.draw_count()
@@ -415,8 +460,10 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
         _op_cache[key] = _Entry(uses_rng=rnd.draw_count() != d0)
         return result
     if entry.disabled:
+        _m_disabled.inc()
         return _MISS
     entry.calls += 1
+    _m_hit.inc()
     try:
         if record:
             if entry.vjp is None:
@@ -442,6 +489,7 @@ def _apply_cached(fn, name, flat, treedef, tensor_pos, diff_pos, record,
         else:
             out = entry.fwd(dyn_vals)
     except Exception as cache_exc:
+        _m_fallback.inc()
         entry.disabled = True
         try:
             result = _apply_legacy(fn, name, flat, treedef, diff_pos, record)

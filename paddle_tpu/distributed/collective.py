@@ -20,6 +20,7 @@ to a mesh axis. Collectives have two execution paths:
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -28,8 +29,17 @@ import numpy as np
 
 from ..core.dispatch import apply
 from ..core.tensor import Tensor
+from ..profiler import RecordEvent, host_tracing_active
+from ..profiler import metrics as _metrics
 from . import env as _env
 from .watchdog import comm_task_manager
+
+# always-on collective metrics (profiler/metrics.py): aggregate count and
+# bytes plus per-op `comm/{op}_count` / `comm/{op}_bytes`; latency is the
+# host-observed span from issue to dispatch-complete (attach/mark_done)
+_m_coll_count = _metrics.counter("comm/collective_count")
+_m_coll_bytes = _metrics.counter("comm/collective_bytes")
+_m_coll_latency = _metrics.histogram("comm/latency_ms")
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
            "is_initialized", "all_reduce", "all_gather", "all_gather_object",
@@ -223,20 +233,86 @@ def _apply_inplace(tensor, fn, op_name):
     return tensor
 
 
-def _track(op_name, group, tensor=None):
-    """Register this collective with the desync watchdog (reference:
+def _tensor_nbytes(tensor) -> int:
+    if tensor is None:
+        return 0
+    try:
+        v = tensor._value
+        return int(np.prod(v.shape)) * np.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
+
+
+class _CommRecord:
+    """Per-collective instrumentation handle, created for EVERY issued
+    collective: folds (count, bytes, host latency) into the always-on
+    metrics registry and the CommTaskManager's cumulative per-group
+    stats, opens a host RecordEvent span when a Profiler is collecting,
+    and wraps the watchdog CommTask when the watchdog is enabled.
+    Latency is issue -> attach/mark_done: the host-side span of the op
+    (eager transport ops block, so it IS the op; in-graph ops measure
+    dispatch, the part Python can stall on)."""
+
+    __slots__ = ("task", "op", "gid", "nbytes", "t0", "_finished", "_span")
+
+    def __init__(self, task, op, gid, nbytes):
+        self.task = task
+        self.op = op
+        self.gid = gid
+        self.nbytes = nbytes
+        self.t0 = time.monotonic()
+        self._finished = False
+        if host_tracing_active():
+            self._span = RecordEvent("comm::" + op)
+            self._span.__enter__()
+        else:
+            self._span = None
+
+    def _finish(self):
+        if self._finished:
+            return
+        self._finished = True
+        dt_ms = (time.monotonic() - self.t0) * 1e3
+        _m_coll_count.inc()
+        _m_coll_bytes.inc(self.nbytes)
+        _metrics.inc(f"comm/{self.op}_count")
+        if self.nbytes:
+            _metrics.inc(f"comm/{self.op}_bytes", self.nbytes)
+        _m_coll_latency.observe(dt_ms)
+        comm_task_manager.record_stats(self.op, self.gid, self.nbytes,
+                                       dt_ms)
+        if self._span is not None:
+            self._span.end()
+            self._span = None
+
+    def mark_done(self):
+        self._finish()
+        if self.task is not None:
+            self.task.mark_done()
+
+    def attach(self, value):
+        self._finish()
+        if self.task is not None:
+            self.task.attach(value)
+
+
+def _track(op_name, group, tensor=None) -> _CommRecord:
+    """Instrument this collective (always) and register it with the
+    desync watchdog when enabled (reference:
     CommTaskManager::CommTaskEnqueue, comm_task_manager.h)."""
-    if not comm_task_manager.enabled:
-        return None
     g = group or _get_default_group()
-    shape = dtype = None
-    if tensor is not None:
-        try:
-            shape, dtype = tuple(tensor.shape), tensor.dtype
-        except Exception:
-            pass
-    return comm_task_manager.start_task(
-        op_name, g.id, g.ranks, _env.global_rank(), shape=shape, dtype=dtype)
+    task = None
+    if comm_task_manager.enabled:
+        shape = dtype = None
+        if tensor is not None:
+            try:
+                shape, dtype = tuple(tensor.shape), tensor.dtype
+            except Exception:
+                pass
+        task = comm_task_manager.start_task(
+            op_name, g.id, g.ranks, _env.global_rank(),
+            shape=shape, dtype=dtype)
+    return _CommRecord(task, op_name, g.id, _tensor_nbytes(tensor))
 
 
 # ---------------------------------------------------------------------------
@@ -534,12 +610,14 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor.set_value(tensor_list[0])
         return Task(tensor)
+    ct = _track("scatter", g, tensor)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         parts = [_np(t) for t in tensor_list] \
             if _env.global_rank() == src and tensor_list else None
         tensor.set_value(tp.scatter(parts, src, g.ranks, g.id))
-        return Task(tensor)
+        ct.mark_done()
+        return Task(tensor, ct)
 
     def fn(x):
         if _in_shard_map(x, group):
@@ -554,7 +632,10 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         out = apply(fn, stacked, op_name="scatter")
         tensor._value = out._value
         tensor.stop_gradient = out.stop_gradient
-    return Task(tensor)
+        ct.attach(tensor._value)
+    else:
+        ct.mark_done()
+    return Task(tensor, ct)
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
@@ -572,11 +653,13 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     g = group or _get_default_group()
     tp = _eager_tp(tensor, g)
     if tp is not None:
+        ct = _track("gather", g, tensor)
         parts = tp.gather(_np(tensor), dst, g.ranks, g.id)
         if gather_list is not None and parts is not None:
             gather_list.clear()
             gather_list.extend(Tensor(p) for p in parts)
-        return Task(tensor)
+        ct.mark_done()
+        return Task(tensor, ct)
     tl = gather_list if gather_list is not None else []
     all_gather(tl, tensor, group, sync_op)
     return Task(tensor)
@@ -588,25 +671,31 @@ def send(tensor, dst=0, group=None, sync_op=True):
     the peer (reference ProcessGroup::Send, process_group.h:162). Eager
     single-process: local buffer (world of 1)."""
     g = group or _get_default_group()
+    ct = _track("send", g, tensor)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         tp.send(_np(tensor), dst, channel=f"p2p:{g.id}")
-        return Task(tensor)
+        ct.mark_done()
+        return Task(tensor, ct)
     _p2p_buffer.setdefault(dst, []).append(Tensor(tensor._value))
-    return Task(tensor)
+    ct.mark_done()
+    return Task(tensor, ct)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
+    ct = _track("recv", g, tensor)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         tensor.set_value(tp.recv(src, channel=f"p2p:{g.id}"))
-        return Task(tensor)
+        ct.mark_done()
+        return Task(tensor, ct)
     me = _env.global_rank()
     buf = _p2p_buffer.get(me) or []
     if buf:
         tensor.set_value(buf.pop(0))
-    return Task(tensor)
+    ct.mark_done()
+    return Task(tensor, ct)
 
 
 _p2p_buffer = {}
@@ -670,15 +759,18 @@ def batch_isend_irecv(p2p_op_list):
 
 def barrier(group=None):
     g = group or _get_default_group()
+    ct = _track("barrier", g)
     tp = _eager_tp(None, g)
     if tp is not None:
         tp.barrier(f"collective_barrier/{g.id}", g.ranks)
-        return Task()
+        ct.mark_done()
+        return Task(comm_task=ct)
     if _env.is_initialized() and _env.get_world_size() > 1:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
-    return Task()
+    ct.mark_done()
+    return Task(comm_task=ct)
 
 
 def wait(tensor, group=None, use_calc_stream=True):

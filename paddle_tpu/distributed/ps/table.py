@@ -279,6 +279,27 @@ class SSDSparseTable(SparseTable):
                     slots[k] = np.asarray(s).copy()
             return {"dim": self.dim, "rows": rows, "slots": slots}
 
+    def load_state(self, st):
+        """Checkpoint restore. The base-class version would replace the
+        LRU OrderedDict with a plain dict (breaking move_to_end) and
+        leave `_off` pointing at PRE-load spill records — a later miss
+        would resurrect stale rows. Rebuild the LRU, drop every spill
+        offset, restart the spill file, and evict back down to the hot
+        cache budget."""
+        from collections import OrderedDict
+
+        with self._lock:
+            self._rows = OrderedDict(
+                (int(k), np.asarray(v, np.float32))
+                for k, v in st["rows"].items())
+            self._slots = {int(k): np.asarray(v, np.float32)
+                           for k, v in st.get("slots", {}).items()}
+            self._off.clear()
+            self._end = 0
+            self._file.seek(0)
+            self._file.truncate()
+            self._evict_if_full()
+
 
 class DenseTable:
     """One contiguous parameter block (reference MemoryDenseTable)."""

@@ -124,6 +124,12 @@ class CommTaskManager:
         self._lock = threading.Lock()
         self._tasks: List[CommTask] = []
         self._seq: Dict[int, int] = {}          # group_id -> last seq issued
+        # cumulative per-group stats — ALWAYS on (unlike the watchdog
+        # thread): group_id -> op -> {count, bytes, total_ms, max_ms}.
+        # Fed by every collective issued through distributed.collective,
+        # so a timeout dump shows each group's lifetime traffic, not
+        # just the in-flight task that stalled.
+        self._group_stats: Dict[int, Dict[str, dict]] = {}
         self._timeout_s = float(os.environ.get(
             "FLAGS_comm_watchdog_timeout", "0") or 0)
         self._thread: Optional[threading.Thread] = None
@@ -182,6 +188,32 @@ class CommTaskManager:
         with self._lock:
             return dict(self._seq)
 
+    # -- cumulative per-group stats (always on) ---------------------------
+    def record_stats(self, op_name: str, group_id: int, nbytes: int = 0,
+                     elapsed_ms: Optional[float] = None):
+        """Fold one completed collective into the per-group totals."""
+        with self._lock:
+            ops = self._group_stats.setdefault(group_id, {})
+            st = ops.get(op_name)
+            if st is None:
+                st = ops[op_name] = {"count": 0, "bytes": 0,
+                                     "total_ms": 0.0, "max_ms": 0.0}
+            st["count"] += 1
+            st["bytes"] += int(nbytes)
+            if elapsed_ms is not None:
+                st["total_ms"] = round(st["total_ms"] + elapsed_ms, 3)
+                if elapsed_ms > st["max_ms"]:
+                    st["max_ms"] = round(elapsed_ms, 3)
+
+    def group_stats(self) -> Dict[int, Dict[str, dict]]:
+        with self._lock:
+            return {gid: {op: dict(st) for op, st in ops.items()}
+                    for gid, ops in self._group_stats.items()}
+
+    def reset_stats(self):
+        with self._lock:
+            self._group_stats.clear()
+
     def pending(self) -> List[CommTask]:
         with self._lock:
             return [t for t in self._tasks if not t.poll()]
@@ -207,6 +239,7 @@ class CommTaskManager:
             "timeout_s": self._timeout_s,
             "stalled": task.to_dict(),
             "group_seq_counters": self.seq_counters(),
+            "group_cumulative_stats": self.group_stats(),
             "hint": "compare group_seq_counters across ranks' dumps; a "
                     "rank whose counter trails issued fewer collectives "
                     "on that group (desync)",

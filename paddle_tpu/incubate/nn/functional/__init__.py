@@ -584,7 +584,21 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     traffic and footprint halve vs bf16. Returns
     (out, qkv, key_cache, value_cache, k_scales, v_scales) in this mode.
     Static per-tensor scale args (the non-dynamic CUDA path) and
-    pre_caches stay unsupported."""
+    pre_caches stay unsupported.
+
+    fresh_prefill=True asserts every scheduled row starts at cache
+    position 0 (seq_lens_decoder[b] == 0 for live rows), so this step's
+    packed tokens ARE each row's full key set: attention runs as
+    block-diagonal varlen flash over the pack, skipping the page-pool
+    gather. Padding-row contract: the LAST batch row (index B-1, where B
+    = block_tables.shape[0]) is the engine's trash row — its tokens get
+    segment id -1 and attend nothing. Padding cannot be derived from the
+    packed offsets alone: cu_seqlens_q[-1] equals the full token budget
+    because the trash row's count is included (tokens in
+    [cu_seqlens_q[B-1], cu_seqlens_q[B]) are the padding), so the
+    identification goes through the row INDEX, not through a
+    cu_q[-1]-vs-T comparison. Callers scheduling real work into row B-1
+    must not set fresh_prefill."""
     if cache_k_quant_scales is not None and not use_dynamic_cachekv_quant:
         raise NotImplementedError("block_multihead_attention: static "
                                   "per-tensor cache scales are CUDA-"

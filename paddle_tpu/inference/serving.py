@@ -30,6 +30,7 @@ production engines use, essential over high-latency links.
 from __future__ import annotations
 
 import math
+import time
 
 import numpy as np
 
@@ -39,6 +40,20 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 from ..core.dispatch import apply
+from ..profiler import RecordEvent, host_tracing_active
+from ..profiler import metrics as _metrics
+
+# always-on serving metrics (profiler/metrics.py): TTFT from request
+# submit to its first sampled token, TPOT from decode_run windows
+# (window wall / steps), plus scheduler gauges the capacity story needs
+_m_ttft = _metrics.histogram("serving/ttft_ms")
+_m_tpot = _metrics.histogram("serving/tpot_ms")
+_m_steps = _metrics.counter("serving/steps")
+_m_tokens = _metrics.counter("serving/tokens_generated")
+_m_requests = _metrics.counter("serving/requests")
+_m_preempt = _metrics.counter("serving/preemptions")
+_m_occupancy = _metrics.gauge("serving/batch_occupancy")
+_m_kv_util = _metrics.gauge("serving/kv_cache_utilization")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
@@ -378,7 +393,8 @@ class PagedCausalLM(Layer):
 
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "max_new", "pages",
-                 "cached", "done", "sampling", "eos_token_id")
+                 "cached", "done", "sampling", "eos_token_id",
+                 "submit_t", "first_tok_t")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id):
         self.rid = rid
@@ -390,6 +406,8 @@ class _Request:
         self.done = False
         self.sampling = sampling or GREEDY
         self.eos_token_id = eos_token_id
+        self.submit_t = time.perf_counter()
+        self.first_tok_t = None
 
     @property
     def length(self):
@@ -518,7 +536,19 @@ class ServingEngine:
         self._next_rid += 1
         self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens,
                                        sampling, eos_token_id)
+        _m_requests.inc()
         return rid
+
+    def _note_first_token(self, req, now):
+        if req.first_tok_t is None:
+            req.first_tok_t = now
+            _m_ttft.observe((now - req.submit_t) * 1e3)
+
+    def _update_pool_gauges(self, n_rows):
+        cfg = self.cfg
+        _m_occupancy.set(n_rows / max(cfg.max_batch, 1))
+        live = cfg.num_blocks - 1 - len(self._free_pages)  # page 0 = trash
+        _m_kv_util.set(live / max(cfg.num_blocks - 1, 1))
 
     def _ensure_pages(self, req, upto_len):
         need = math.ceil(upto_len / self.cfg.block_size)
@@ -572,6 +602,10 @@ class ServingEngine:
         (prefill chunks + decode mixed) within the token budget, run the
         step function once, sample one token per request that reached its
         sequence tip."""
+        with RecordEvent("serving::step"):
+            return self._step()
+
+    def _step(self):
         cfg = self.cfg
 
         rows = self._schedule()
@@ -590,9 +624,11 @@ class ServingEngine:
             victim = max(holders, key=lambda r: r.rid)
             self._release(victim)
             victim.cached = 0
+            _m_preempt.inc()
             rows = self._schedule()
         if not rows:
             return []
+        _m_steps.inc()
 
         B1 = cfg.max_batch + 1
         enc = np.zeros(B1, np.int32)
@@ -607,6 +643,7 @@ class ServingEngine:
             self._ensure_pages(r, r.cached + chunk)
             bt[i, :len(r.pages)] = r.pages
             packed.extend(seq[r.cached:r.cached + chunk])
+        self._update_pool_gauges(len(rows))
         # padding tokens -> trash row (index B1-1, block table all page 0)
         n_pad = cfg.token_budget - len(packed)
         this[B1 - 1] = n_pad
@@ -663,6 +700,7 @@ class ServingEngine:
                 logits, temps, topks, topps, salts))
 
         produced = []
+        now = time.perf_counter()
         for i, (r, chunk) in enumerate(rows):
             r.cached += chunk
             if not tip[i]:
@@ -670,11 +708,13 @@ class ServingEngine:
             nxt = int(sampled[i])
             r.generated.append(nxt)
             produced.append((r.rid, nxt))
+            self._note_first_token(r, now)
             if len(r.generated) >= r.max_new \
                     or (r.eos_token_id is not None
                         and nxt == r.eos_token_id):
                 r.done = True
                 self._release(r)
+        _m_tokens.inc(len(produced))
         return produced
 
     # -- multi-step decode (one device program per window) ---------------
@@ -733,7 +773,12 @@ class ServingEngine:
         Requests must be at their decode tip (fully prefilled); pages for
         the whole window are reserved up front so block tables stay
         static. Returns the produced (rid, token) list in step order."""
+        with RecordEvent("serving::decode_run"):
+            return self._decode_run(n_steps)
+
+    def _decode_run(self, n_steps):
         cfg = self.cfg
+        t_start = time.perf_counter()
         rows = [r for r in self.pending()
                 if r.length - r.cached == 1][:cfg.max_batch]
         if not rows:
@@ -760,6 +805,8 @@ class ServingEngine:
         B1 = cfg.max_batch + 1
         for r in rows:
             self._ensure_pages(r, r.cached + n)
+        self._update_pool_gauges(B)
+        _m_steps.inc(n)
 
         enc = np.zeros(B1, np.int32)
         this = np.zeros(B1, np.int32)
@@ -812,6 +859,8 @@ class ServingEngine:
         if self._ks is not None:
             self._ks, self._vs = scales
         fetched = np.asarray(samples)                    # [n, B1] — sync
+        now = time.perf_counter()
+        _m_tpot.observe((now - t_start) / n * 1e3)
         produced = []
         for j in range(n):
             for i, r in enumerate(rows):
@@ -821,11 +870,13 @@ class ServingEngine:
                 r.generated.append(nxt)
                 r.cached += 1
                 produced.append((r.rid, nxt))
+                self._note_first_token(r, now)
                 if len(r.generated) >= r.max_new \
                         or (r.eos_token_id is not None
                             and nxt == r.eos_token_id):
                     r.done = True
                     self._release(r)
+        _m_tokens.inc(len(produced))
         return produced
 
     def run_to_completion(self, max_steps=1000):

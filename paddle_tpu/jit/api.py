@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 import jax
@@ -21,7 +22,33 @@ import jax.numpy as jnp
 from ..core.autograd import no_grad
 from ..core.tensor import Tensor
 from ..framework.random import next_key, rng_guard
+from ..profiler import RecordEvent
+from ..profiler import metrics as _metrics
 from . import functional as FB
+
+# compile-bridge observability (profiler/metrics.py): compiles, their
+# wall time, trace-break retraces (with per-cause tallies) and whole-graph
+# breaks — the numbers that explain "why is this step slow / eager"
+_m_compile = _metrics.counter("jit/compile_count")
+_m_compile_ms = _metrics.histogram("jit/compile_ms")
+_m_retrace = _metrics.counter("jit/retrace_count")
+_m_graph_break = _metrics.counter("jit/graph_break_count")
+
+
+def _record_retrace(exc):
+    _m_retrace.inc()
+    _metrics.inc("jit/retrace_cause/" + type(exc).__name__)
+
+
+def _timed_first_call(callable_, *a, **kw):
+    """First call of a fresh jit entry = trace+lower+compile+run; count
+    it and histogram the wall time under a RecordEvent span."""
+    _m_compile.inc()
+    with RecordEvent("jit::compile"):
+        t0 = time.perf_counter()
+        out = callable_(*a, **kw)
+    _m_compile_ms.observe((time.perf_counter() - t0) * 1e3)
+    return out
 
 __all__ = ["to_static", "TrainStep", "in_to_static_tracing", "save", "load",
            "ignore_module", "not_to_static", "enable_to_static"]
@@ -97,6 +124,8 @@ def _warn_graph_break(name: str, exc: Exception, n_regions: int = 0):
         tail = ("Falling back to EAGER execution for this callable "
                 "(graph break). Use jax-compatible control flow "
                 "(lax.cond/where) to recover whole-graph compilation.")
+    _m_graph_break.inc()
+    _metrics.set_gauge("jit/partial_regions", n_regions)
     warnings.warn(
         f"to_static: '{name}' contains Python that cannot be traced "
         f"({type(exc).__name__}: {str(exc).splitlines()[0][:120]}). "
@@ -249,6 +278,7 @@ class StaticFunction:
         try:
             return self._run_compiled(seed, in_arrays, kwargs)
         except _trace_break_errors() as e:
+            _record_retrace(e)
             # dy2static retry: lower tensor-dependent control flow to
             # lax.cond/while_loop, then re-trace once
             if not getattr(self, "_converted", False):
@@ -320,19 +350,26 @@ class StaticFunction:
         if not isinstance(self._compiled, dict):
             self._compiled = {}
         jitted = self._compiled.get(static_pos)
+        fresh = jitted is None
         if self._is_layer:
-            if jitted is None:
+            if fresh:
                 jitted = self._compiled[static_pos] = \
                     self._build_layer_fn(static_pos)
             params = FB.current_params(self._target)
             buffers = FB.current_buffers(self._target)
-            out, new_buf = jitted(params, buffers, seed, *in_arrays)
+            if fresh:
+                out, new_buf = _timed_first_call(
+                    jitted, params, buffers, seed, *in_arrays)
+            else:
+                out, new_buf = jitted(params, buffers, seed, *in_arrays)
             FB.write_back(self._target, {}, new_buf)
         else:
-            if jitted is None:
+            if fresh:
                 jitted = self._compiled[static_pos] = \
                     self._build_fn(static_pos)
-            out = jitted(seed, *in_arrays, **kwargs)
+                out = _timed_first_call(jitted, seed, *in_arrays, **kwargs)
+            else:
+                out = jitted(seed, *in_arrays, **kwargs)
         return jax.tree.map(lambda x: Tensor(x), out)
 
     def _eager_call(self, *args, **kwargs):
@@ -514,7 +551,8 @@ class TrainStep:
     def __call__(self, *batch):
         if getattr(self, "_fallback", False):
             return self._eager_step(*batch)
-        if self._compiled is None:
+        fresh = self._compiled is None
+        if fresh:
             self._compiled = self._build()
         params = FB.current_params(self.model)
         buffers = FB.current_buffers(self.model)
@@ -526,9 +564,15 @@ class TrainStep:
         arrays = [b._value if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         try:
-            new_params, new_states, new_buf, loss = self._compiled(
-                params, opt_states, buffers, lr, step_i, seed, *arrays)
+            if fresh:
+                new_params, new_states, new_buf, loss = _timed_first_call(
+                    self._compiled, params, opt_states, buffers, lr,
+                    step_i, seed, *arrays)
+            else:
+                new_params, new_states, new_buf, loss = self._compiled(
+                    params, opt_states, buffers, lr, step_i, seed, *arrays)
         except _trace_break_errors() as e:
+            _record_retrace(e)
             retried = False
             if not getattr(self, "_converted", False):
                 self._converted = True

@@ -32,12 +32,18 @@ import jax
 
 from ..core.dispatch import apply, _fp_value, _Uncacheable
 from ..core.tensor import Tensor
+from ..profiler import metrics as _metrics
 from . import functional as FB
 
 __all__ = ["enable_partial_capture", "disable_partial_capture",
            "region_count"]
 
 _region_ids = itertools.count(1)
+
+# partial-capture observability: regions installed, and per-region graph
+# breaks (each break = one more sublayer whose glue runs eagerly)
+_m_regions = _metrics.counter("jit/partial_regions_installed")
+_m_region_break = _metrics.counter("jit/region_break_count")
 
 
 def _break_errors():
@@ -142,6 +148,8 @@ class _Region:
                 self._validate(params, buffers, args, kwargs, train)
             except _break_errors() as e:
                 self.broken = True
+                _m_region_break.inc()
+                _metrics.inc("jit/retrace_cause/" + type(e).__name__)
                 n = _split_into_children(layer)
                 warnings.warn(
                     f"partial capture: region '{type(layer).__name__}' "
@@ -181,6 +189,7 @@ def _install(layer) -> int:
     region = _Region(layer, layer.forward)
     layer.__dict__["__pt_region__"] = region
     layer.forward = region
+    _m_regions.inc()
     return 1
 
 

@@ -59,7 +59,10 @@ _MASK_MIN = -1e30
 
 
 def _dim_semantics(*sems):
-    return pltpu.CompilerParams(dimension_semantics=sems)
+    # jax renamed TPUCompilerParams -> CompilerParams; accept either
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(dimension_semantics=sems)
 
 
 # ---------------------------------------------------------------------------

@@ -316,9 +316,15 @@ def _varlen_attention(q, k, v, segq, segk, causal):
 
 
 def _vfa_block(s):
-    from .flash_attention import DEFAULT_BLOCK_Q
-
-    return min(DEFAULT_BLOCK_Q, s)
+    """Largest kernel block in (512, 256, 128) that DIVIDES the packed
+    length, or 0 when none does. The grid is `s // block` whole tiles, so
+    a block that merely fits (`min(512, s)`) silently dropped the
+    trailing `s % block` tokens for lengths like 640/768/896 — the block
+    must divide s exactly, and `_vfa_ok` gates on that."""
+    for b in (512, 256, 128):
+        if s % b == 0:
+            return b
+    return 0
 
 
 def _vfa_fwd(q, k, v, segq, segk, causal):
@@ -358,8 +364,11 @@ def _varlen_ref(q, k, v, segq, segk, causal):
 
 
 def _vfa_ok(q, k):
+    # a valid block must divide each packed length exactly (sq % block_q
+    # == 0 and sk % block_k == 0 by construction of _vfa_block); packed
+    # lengths with no such block (e.g. 600) fall back to _varlen_ref
     return ((use_pallas() or _interpret())
-            and q.shape[2] % 128 == 0 and k.shape[2] % 128 == 0
+            and _vfa_block(q.shape[2]) > 0 and _vfa_block(k.shape[2]) > 0
             and q.shape[-1] % 64 == 0)
 
 

@@ -14,11 +14,13 @@ jax-function table; XLA's own dispatch handles dtype/layout specialization.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 __all__ = ["OpInfo", "register", "get", "all_ops", "dump_yaml",
-           "EXCLUSIONS"]
+           "EXCLUSIONS", "record_call", "op_call_counts",
+           "reset_call_counts"]
 
 # ops.yaml entries deliberately NOT implemented, with the reason — audited
 # by dump_yaml so coverage vs the reference is named-exclusions-only.
@@ -141,6 +143,33 @@ def register(name: str, fn: Callable, differentiable: bool = True, tags=()):
 
 def get(name: str) -> Optional[OpInfo]:
     return _REGISTRY.get(name)
+
+
+# -- per-op dispatch tallies (observability layer) ---------------------------
+# Every call funneled through core.dispatch.apply lands here, including
+# inline lambdas that never registered an OpInfo — the op-level view the
+# reference gets from its OperatorView summary table.
+_call_counts: Dict[str, int] = {}
+_call_lock = threading.Lock()
+
+
+def record_call(name: str):
+    with _call_lock:
+        _call_counts[name] = _call_counts.get(name, 0) + 1
+
+
+def op_call_counts(top: Optional[int] = None) -> Dict[str, int]:
+    """Cumulative per-op dispatch counts, descending (optionally top-N)."""
+    with _call_lock:
+        items = sorted(_call_counts.items(), key=lambda kv: -kv[1])
+    if top is not None:
+        items = items[:top]
+    return dict(items)
+
+
+def reset_call_counts():
+    with _call_lock:
+        _call_counts.clear()
 
 
 def all_ops() -> Dict[str, OpInfo]:

@@ -17,9 +17,12 @@ from typing import Callable, Optional
 
 import jax
 
+from . import metrics
+
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
-           "load_profiler_result", "SummaryView"]
+           "load_profiler_result", "SummaryView", "metrics",
+           "host_tracing_active"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -73,6 +76,13 @@ class _HostEventCollector(threading.local):
 
 
 _collector = _HostEventCollector()
+
+
+def host_tracing_active() -> bool:
+    """True while a Profiler is collecting host spans — instrumented hot
+    paths check this before opening per-event RecordEvent spans so the
+    always-on cost is one attribute read."""
+    return _collector.active
 
 
 class RecordEvent:

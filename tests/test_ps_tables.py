@@ -45,3 +45,43 @@ def test_ssd_sparse_table_state_roundtrip():
     back = SparseTable(4, rule="adam")
     back.load_state(st)
     np.testing.assert_allclose(back.pull(ks), ssd.pull(ks))
+
+
+def test_ssd_table_checkpoint_roundtrip_into_ssd():
+    """Checkpoint round-trip THROUGH an SSD table (ADVICE medium): the
+    inherited load_state replaced the LRU OrderedDict with a plain dict
+    and left stale spill offsets live. Loading into a fresh (and a
+    dirty) SSDSparseTable must restore every row + optimizer slot, keep
+    the hot cache within budget, and keep updating correctly after."""
+    import numpy as np
+
+    from paddle_tpu.distributed.ps.table import SparseTable, SSDSparseTable
+
+    dim, n_keys, cache = 4, 300, 16
+    src = SSDSparseTable(dim, rule="adam", cache_rows=cache)
+    rng = np.random.RandomState(3)
+    ks = np.arange(n_keys, dtype=np.int64)
+    for _ in range(2):
+        src.push(ks, rng.randn(n_keys, dim).astype(np.float32))
+    st = src.state()
+
+    # load into a DIRTY SSD table (has its own spilled rows at other
+    # offsets) — stale offsets must not shadow the checkpoint
+    dst = SSDSparseTable(dim, rule="adam", cache_rows=cache)
+    other = np.arange(1000, 1000 + n_keys, dtype=np.int64)
+    dst.push(other, rng.randn(n_keys, dim).astype(np.float32))
+    dst.load_state(st)
+    assert dst.size() == n_keys
+    assert len(dst._rows) <= cache, "hot cache exceeded budget after load"
+    np.testing.assert_allclose(dst.pull(ks), src.pull(ks))
+
+    # post-load updates must keep matching a mirror table restored from
+    # the same checkpoint (optimizer slots restored, LRU functional)
+    mem = SparseTable(dim, rule="adam")
+    mem.load_state(st)
+    g = rng.randn(n_keys, dim).astype(np.float32)
+    dst.push(ks, g.copy())
+    mem.push(ks, g.copy())
+    probe = rng.choice(n_keys, 50, replace=False).astype(np.int64)
+    np.testing.assert_allclose(dst.pull(probe), mem.pull(probe),
+                               rtol=1e-6, atol=1e-6)

@@ -165,3 +165,40 @@ def test_incubate_api_kernel_path_matches_fallback():
     np.testing.assert_allclose(np.asarray(out_k.numpy()),
                                np.asarray(out_f.numpy()),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_block_selection_divides_packed_length():
+    """640-token packs (ADVICE high): the kernel block must DIVIDE the
+    packed length — min(512, 640) built a 1-tile grid that silently
+    dropped the trailing 128 tokens. 640/768/896 now pick block 128 and
+    run the kernel exactly; lengths with no divisor in (512, 256, 128)
+    fall back to _varlen_ref."""
+    assert VA._vfa_block(512) == 512
+    assert VA._vfa_block(256) == 256
+    assert VA._vfa_block(640) == 128     # 5 * 128
+    assert VA._vfa_block(768) == 256     # 3 * 256
+    assert VA._vfa_block(896) == 128     # 7 * 128
+    for s in (600, 130, 96):
+        assert VA._vfa_block(s) == 0
+
+    rng = np.random.RandomState(6)
+    lens = [300, 340]                          # 640 packed, no padding
+    q, k, v, seg, cu = _packed_case(rng, lens, total=640)
+    out = VA.varlen_flash_attention_packed(q, k, v, seg, seg,
+                                           is_causal=True)
+    want = _dense_per_seq(q, k, v, cu, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_unaligned_length_uses_ref_fallback():
+    """600 tokens: no block divides -> public entry must route to the
+    dense reference (not crash, not drop keys)."""
+    rng = np.random.RandomState(7)
+    lens = [250, 350]                          # 600 packed
+    q, k, v, seg, cu = _packed_case(rng, lens, total=600)
+    out = VA.varlen_flash_attention_packed(q, k, v, seg, seg,
+                                           is_causal=True)
+    want = _dense_per_seq(q, k, v, cu, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)

@@ -1,0 +1,142 @@
+"""Merge a host chrome-trace with a metrics snapshot into one report.
+
+Inputs:
+  --trace    chrome-trace JSON written by paddle.profiler.Profiler.export
+             (traceEvents with ph="X" duration spans)
+  --metrics  JSON snapshot written by paddle.profiler.metrics
+             (snapshot_to_file / enable_periodic_flush / PT_METRICS_FLUSH_PATH)
+
+Either input may be omitted; the report covers what it is given. Output
+is a human-readable text report: a span summary table (calls, total,
+avg, max per span name), the counters/gauges, and histogram summaries
+with bucket-estimated p50/p95 — the triage view that answers "where did
+the time go" without opening perfetto.
+
+Usage:
+  python tools/trace_report.py --trace /tmp/prof/worker.json \
+      --metrics /tmp/metrics.json [-o report.txt]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from collections import defaultdict
+
+
+def summarize_trace(trace: dict) -> str:
+    events = trace.get("traceEvents", [])
+    agg = defaultdict(lambda: [0, 0.0, 0.0])        # calls, total_us, max_us
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "?")
+        dur = float(ev.get("dur", 0.0))
+        a = agg[name]
+        a[0] += 1
+        a[1] += dur
+        if dur > a[2]:
+            a[2] = dur
+    if not agg:
+        return "  (no duration spans in trace)"
+    lines = [f"  {'Span':<44} {'Calls':>8} {'Total(ms)':>11} "
+             f"{'Avg(ms)':>9} {'Max(ms)':>9}"]
+    for name, (calls, total, mx) in sorted(agg.items(),
+                                           key=lambda kv: -kv[1][1]):
+        lines.append(f"  {name[:44]:<44} {calls:>8} {total / 1e3:>11.3f} "
+                     f"{total / calls / 1e3:>9.3f} {mx / 1e3:>9.3f}")
+    if trace.get("xplane_dir"):
+        lines.append(f"  device XPlane dir: {trace['xplane_dir']}")
+    return "\n".join(lines)
+
+
+def _hist_quantile(h: dict, q: float):
+    """Bucket-estimated quantile (upper bound of the covering bucket)."""
+    total = h.get("count", 0)
+    if not total:
+        return None
+    target = q * total
+    acc = 0
+    for bound, c in sorted(h.get("buckets", {}).items(),
+                           key=lambda kv: float(kv[0])):
+        acc += c
+        if acc >= target:
+            return float(bound)
+    return h.get("max")
+
+
+def summarize_metrics(snap: dict) -> str:
+    lines = []
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    if counters:
+        lines.append("  Counters:")
+        for name in sorted(counters):
+            lines.append(f"    {name:<44} {counters[name]}")
+    if gauges:
+        lines.append("  Gauges:")
+        for name in sorted(gauges):
+            v = gauges[name]
+            v = f"{v:.4f}" if isinstance(v, float) else v
+            lines.append(f"    {name:<44} {v}")
+    if hists:
+        lines.append("  Histograms:")
+        lines.append(f"    {'Name':<34} {'Count':>7} {'Avg':>10} "
+                     f"{'Min':>10} {'~p50':>10} {'~p95':>10} {'Max':>10}")
+        for name in sorted(hists):
+            h = hists[name]
+
+            def fmt(v):
+                return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+            lines.append(
+                f"    {name[:34]:<34} {h.get('count', 0):>7} "
+                f"{fmt(h.get('avg')):>10} {fmt(h.get('min')):>10} "
+                f"{fmt(_hist_quantile(h, 0.5)):>10} "
+                f"{fmt(_hist_quantile(h, 0.95)):>10} "
+                f"{fmt(h.get('max')):>10}")
+    return "\n".join(lines) if lines else "  (empty snapshot)"
+
+
+def build_report(trace: dict = None, metrics: dict = None) -> str:
+    parts = ["paddle_tpu trace report", "=" * 70]
+    if metrics is not None:
+        ts = metrics.get("ts")
+        head = "Metrics snapshot"
+        if ts:
+            import datetime
+
+            head += " @ " + datetime.datetime.fromtimestamp(ts).isoformat()
+        parts += [head, "-" * 70, summarize_metrics(metrics), ""]
+    if trace is not None:
+        parts += ["Host span summary", "-" * 70, summarize_trace(trace), ""]
+    if trace is None and metrics is None:
+        parts.append("(nothing to report: pass --trace and/or --metrics)")
+    return "\n".join(parts)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", help="chrome-trace JSON (Profiler.export)")
+    ap.add_argument("--metrics", help="metrics snapshot JSON")
+    ap.add_argument("-o", "--output", help="write report here "
+                                           "(default: stdout)")
+    args = ap.parse_args(argv)
+    trace = metrics = None
+    if args.trace:
+        with open(args.trace) as f:
+            trace = json.load(f)
+    if args.metrics:
+        with open(args.metrics) as f:
+            metrics = json.load(f)
+    report = build_report(trace, metrics)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report + "\n")
+    else:
+        print(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
