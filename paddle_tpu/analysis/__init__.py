@@ -1,0 +1,39 @@
+"""ptlint — framework-aware static analysis for paddle_tpu.
+
+Four rule families, each targeting a failure class that runtime testing
+on the CPU mesh structurally cannot catch:
+
+- **PT1xx trace-safety** — Python that silently mis-traces or breaks
+  ``@to_static`` capture (jit/api.py can only count the breakage at
+  runtime via ``jit/graph_break_count``).
+- **PT2xx SPMD-collective ordering** — collectives under rank-dependent
+  control flow: the single-controller test mesh executes them as local
+  identities, a v5p pod deadlocks.
+- **PT3xx Pallas grid contracts** — ``seq // block`` grids whose block
+  merely *fits* instead of *dividing* (the varlen 640/768/896
+  tail-truncation bug class), unguarded BlockSpec clamps, and
+  version-fragile ``pltpu`` attribute use.
+- **PT4xx registry consistency** — duplicate ``register()`` names,
+  entries the dispatcher funnel can't call, and metric names missing
+  from ``tools/trace_report.py``'s ``KNOWN_METRICS``.
+
+Usage::
+
+    python -m paddle_tpu.analysis paddle_tpu/          # or tools/ptlint.py
+    python -m paddle_tpu.analysis paddle_tpu/ --format json
+    python -m paddle_tpu.analysis paddle_tpu/ --write-baseline
+
+Suppress a finding in place with ``# ptlint: disable=PT105`` (family
+form ``PT1xx`` and ``all`` also work).  Grandfathered findings live in
+the committed ``.ptlint-baseline.json``; regenerate it with
+``--write-baseline`` after an intentional change, and shrink it over
+time — baselined findings never fail CI but still show in reports.
+"""
+from .engine import (BASELINE_NAME, Finding, Report, all_rules,
+                     load_baseline, render_json, render_text, run,
+                     write_baseline)
+from .main import main
+
+__all__ = ["BASELINE_NAME", "Finding", "Report", "all_rules",
+           "load_baseline", "main", "render_json", "render_text", "run",
+           "write_baseline"]

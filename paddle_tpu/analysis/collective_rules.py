@@ -1,0 +1,139 @@
+"""PT2xx — SPMD-collective ordering rules.
+
+Collectives from ``distributed/collective.py`` are *collective* by
+contract: every rank of the group must issue the same sequence of them,
+or the mesh deadlocks — and that deadlock only manifests on a real
+multi-host run (the single-process CPU test mesh reduces every
+collective to identity/local math, so tier-1 tests can never catch it).
+
+The SPMD-safe idioms are value-level selects (``jnp.where(stage == 0,
+...)``, as the compiled pipeline engines do) or *mirrored* branches
+(``if rank == 0: send(...) else: recv(...)``). What these rules catch
+is the broken middle ground: a collective issued under rank-dependent
+Python control flow with nothing matching it on the other side, and
+mirrored send/recv pairs wired to different groups.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import call_name, dotted_name, rule
+
+# the collective surface of distributed/collective.py (+ stream aliases)
+COLLECTIVE_NAMES = frozenset({
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "all_to_all", "all_to_all_single", "alltoall", "broadcast",
+    "broadcast_object_list", "reduce", "scatter", "scatter_object_list",
+    "gather", "send", "recv", "isend", "irecv", "barrier",
+})
+
+_SENDS = {"send", "isend"}
+_RECVS = {"recv", "irecv"}
+
+_RANK_NAMES = {"rank", "local_rank", "global_rank", "rank_id",
+               "stage_id", "pp_rank", "mp_rank", "dp_rank"}
+_RANK_CALLS = {"get_rank", "global_rank", "local_rank", "axis_index",
+               "get_group_rank", "get_stage_id"}
+_RANK_ATTRS = _RANK_NAMES | {"is_first_stage", "is_last_stage",
+                             "is_first_rank", "is_last_rank"}
+
+
+def _rank_dependent(test) -> bool:
+    """Does this branch condition read the process identity?"""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _RANK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _RANK_ATTRS:
+            return True
+        if isinstance(node, ast.Call):
+            cn = call_name(node)
+            if cn in _RANK_CALLS:
+                return True
+    return False
+
+
+def _collective_calls(stmts):
+    """All collective Call nodes in a statement list (subtree walk,
+    excluding nested function defs — those run on their own schedule)."""
+    out = []
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in COLLECTIVE_NAMES:
+                    out.append((cn, node))
+    return out
+
+
+def _group_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "group":
+            return dotted_name(kw.value) or ast.dump(kw.value)
+    return None
+
+
+def _rank_conditional_ifs(mod):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.If) and _rank_dependent(node.test):
+            yield node
+
+
+@rule("PT201", "error",
+      "collective under a rank-conditional branch with no matching "
+      "collective on the other side (SPMD deadlock)")
+def check_unmatched_collective(mod):
+    seen_ifs = set()
+    for if_node in _rank_conditional_ifs(mod):
+        if id(if_node) in seen_ifs:
+            continue
+        seen_ifs.add(id(if_node))
+        body_calls = _collective_calls(if_node.body)
+        else_calls = _collective_calls(if_node.orelse)
+        if body_calls and not else_calls:
+            flagged, missing = body_calls, "else"
+        elif else_calls and not body_calls:
+            flagged, missing = else_calls, "if"
+        else:
+            continue
+        for cn, node in flagged:
+            yield (node.lineno, node.col_offset,
+                   f"'{cn}' issued under a rank-dependent branch "
+                   f"(line {if_node.lineno}) with no collective in the "
+                   f"{missing} branch: ranks taking the other path never "
+                   f"enter the collective and the group deadlocks on a "
+                   f"real mesh; mirror the call in both branches or use "
+                   f"a value-level select (jnp.where / lax.cond)")
+
+
+@rule("PT202", "error",
+      "mirrored send/recv branches wired to different groups")
+def check_send_recv_group_mismatch(mod):
+    for if_node in _rank_conditional_ifs(mod):
+        body_calls = _collective_calls(if_node.body)
+        else_calls = _collective_calls(if_node.orelse)
+        if not body_calls or not else_calls:
+            continue
+        body_sends = [c for n, c in body_calls if n in _SENDS]
+        body_recvs = [c for n, c in body_calls if n in _RECVS]
+        else_sends = [c for n, c in else_calls if n in _SENDS]
+        else_recvs = [c for n, c in else_calls if n in _RECVS]
+        for sends, recvs in ((body_sends, else_recvs),
+                             (else_sends, body_recvs)):
+            if not sends or not recvs:
+                continue
+            send_groups = {_group_kwarg(c) for c in sends}
+            recv_groups = {_group_kwarg(c) for c in recvs}
+            # only meaningful when both sides name a group explicitly
+            if None in send_groups or None in recv_groups:
+                continue
+            if send_groups != recv_groups:
+                c = sends[0]
+                yield (c.lineno, c.col_offset,
+                       f"paired send/recv across the rank branch at line "
+                       f"{if_node.lineno} use different group= arguments "
+                       f"({sorted(send_groups)} vs {sorted(recv_groups)}): "
+                       f"the two sides rendezvous on different "
+                       f"communicators and hang")

@@ -1,0 +1,404 @@
+"""ptlint rule engine — AST-based static analysis for paddle_tpu.
+
+The three silent failure classes this framework is most exposed to are
+invisible to runtime tests until they run on real hardware:
+
+- Python that breaks ``@to_static`` tracing (jit/api.py can only *count*
+  graph breaks after the fact, via ``jit/graph_break_count``);
+- collectives issued under rank-dependent control flow (an SPMD deadlock
+  that only manifests on a multi-host mesh);
+- Pallas grid arithmetic that floor-truncates (the varlen-attention bug:
+  ``grid = seq // block`` with a block that merely *fits* silently drops
+  the trailing ``seq % block`` tokens).
+
+ptlint moves all three — plus registry/metrics drift — into a CI check
+that fails in seconds.  This module is the engine: rule registry with
+stable IDs (PT1xx trace-safety, PT2xx SPMD-collective ordering, PT3xx
+Pallas kernel contracts, PT4xx registry consistency), severities,
+``# ptlint: disable=PTxxx`` line suppressions, text + JSON reporters, and
+a committed-baseline workflow for grandfathered findings.
+
+Deliberately stdlib-only (``ast`` + ``json``): the linter never imports
+the code it checks, so it runs in milliseconds and can't be broken by a
+bug it is trying to find.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Finding", "Rule", "rule", "all_rules", "ModuleInfo",
+           "Project", "run", "load_baseline", "write_baseline",
+           "render_text", "render_json", "BASELINE_NAME"]
+
+BASELINE_NAME = ".ptlint-baseline.json"
+
+_SUPPRESS_RE = re.compile(r"#\s*ptlint:\s*disable=([A-Za-z0-9_,\sx]+)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*ptlint:\s*disable-file=([A-Za-z0-9_,\sx]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    severity: str            # "error" | "warning"
+    path: str                # relative, forward slashes
+    line: int                # 1-based
+    col: int
+    message: str
+    line_text: str = ""      # stripped source line (baseline fingerprint)
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-number-free identity used for baseline matching — stable
+        across unrelated edits that only shift the file."""
+        return (self.rule_id, self.path, self.line_text)
+
+    def to_dict(self) -> dict:
+        return {"id": self.rule_id, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message}
+
+
+@dataclass
+class Rule:
+    rule_id: str
+    severity: str
+    summary: str
+    scope: str               # "file" | "project"
+    fn: Callable
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, severity: str, summary: str, scope: str = "file"):
+    """Register a rule. File-scope rules receive one ModuleInfo and yield
+    (line, col, message); project-scope rules receive the Project and
+    yield (module, line, col, message)."""
+    assert severity in ("error", "warning"), severity
+    assert scope in ("file", "project"), scope
+
+    def deco(fn):
+        _RULES[rule_id] = Rule(rule_id, severity, summary, scope, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    _load_rule_modules()
+    return dict(_RULES)
+
+
+def _load_rule_modules():
+    # import for side effect of @rule registration; idempotent
+    from . import collective_rules  # noqa: F401
+    from . import pallas_rules      # noqa: F401
+    from . import registry_rules    # noqa: F401
+    from . import trace_safety      # noqa: F401
+
+
+class ModuleInfo:
+    """One parsed file: AST plus the derived tables every rule needs."""
+
+    def __init__(self, path: str, relpath: str, src: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src)
+        # parent links (ast has none); used for "is X inside Y" queries
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._pt_parent = node  # type: ignore[attr-defined]
+        # all function defs by name, module-wide (innermost wins on clash
+        # — rules only need a representative body to inspect)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+        # line -> set of suppressed rule ids / family patterns; plus a
+        # whole-file set from `# ptlint: disable-file=PTxxx` directives
+        self.suppressions: Dict[int, set] = {}
+        self.file_suppressions: set = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m:
+                self.file_suppressions |= {
+                    s.strip() for s in m.group(1).split(",") if s.strip()}
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self.suppressions[i] = ids
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        for ids in (self.file_suppressions,
+                    self.suppressions.get(lineno) or ()):
+            if not ids:
+                continue
+            if rule_id in ids or "all" in ids:
+                return True
+            # family form: disable=PT1xx covers PT101..PT199
+            for pat in ids:
+                if pat.endswith("xx") and rule_id.startswith(pat[:-2]):
+                    return True
+        return False
+
+    def enclosing_function(self, node) -> Optional[ast.FunctionDef]:
+        cur = getattr(node, "_pt_parent", None)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = getattr(cur, "_pt_parent", None)
+        return None
+
+
+class Project:
+    """The full analyzed file set — what project-scope rules see."""
+
+    def __init__(self, modules: List[ModuleInfo], root: str):
+        self.modules = modules
+        self.root = root
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git", ".ptlint")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return out
+
+
+def _common_root(paths: List[str]) -> str:
+    if not paths:
+        return os.getcwd()
+    root = os.path.commonpath([os.path.abspath(p) for p in paths])
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    return root
+
+
+def find_baseline(start: str) -> Optional[str]:
+    """Walk up from `start` looking for the committed baseline file."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        cand = os.path.join(cur, BASELINE_NAME)
+        if os.path.isfile(cand):
+            return cand
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    """Baseline as a multiset of (rule_id, path, line_text) keys."""
+    with open(path) as f:
+        data = json.load(f)
+    counts: Dict[Tuple[str, str, str], int] = {}
+    for e in data.get("entries", []):
+        k = (e["id"], e["path"], e.get("context", ""))
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def write_baseline(path: str, findings: List[Finding]):
+    entries = [{"id": f.rule_id, "path": f.path, "context": f.line_text}
+               for f in sorted(findings,
+                               key=lambda f: (f.path, f.line, f.rule_id))]
+    with open(path, "w") as f:
+        json.dump({"version": 1,
+                   "comment": "grandfathered ptlint findings; regenerate "
+                              "with: python -m paddle_tpu.analysis <paths> "
+                              "--write-baseline",
+                   "entries": entries}, f, indent=1)
+        f.write("\n")
+
+
+@dataclass
+class Report:
+    findings: List[Finding] = field(default_factory=list)   # active
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    files: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+def run(paths: Iterable[str], baseline: Optional[str] = None,
+        select: Optional[Iterable[str]] = None) -> Report:
+    """Lint `paths` (files or directories). `baseline` is a path to a
+    baseline JSON (entries there are reported separately and do not fail
+    the run). `select` optionally restricts to the given rule ids or
+    family patterns (e.g. "PT3xx")."""
+    _load_rule_modules()
+    files = iter_py_files(paths)
+    root = _common_root(files)
+    # relpaths are anchored at the repo/package parent so baselines match
+    # no matter which subtree was scanned
+    report = Report(files=len(files))
+    modules: List[ModuleInfo] = []
+    for fp in files:
+        try:
+            with open(fp, encoding="utf-8") as f:
+                src = f.read()
+            modules.append(ModuleInfo(fp, _repo_rel(fp), src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            report.parse_errors.append(f"{fp}: {e}")
+    project = Project(modules, root)
+
+    def selected(rid: str) -> bool:
+        if select is None:
+            return True
+        for s in select:
+            if rid == s or (s.endswith("xx") and rid.startswith(s[:-2])):
+                return True
+        return False
+
+    raw: List[Tuple[ModuleInfo, Finding]] = []
+    for r in _RULES.values():
+        if not selected(r.rule_id):
+            continue
+        if r.scope == "file":
+            for mod in modules:
+                for line, col, msg in r.fn(mod):
+                    raw.append((mod, Finding(
+                        r.rule_id, r.severity, mod.relpath, line, col, msg,
+                        mod.line_text(line))))
+        else:
+            for mod, line, col, msg in r.fn(project):
+                raw.append((mod, Finding(
+                    r.rule_id, r.severity, mod.relpath, line, col, msg,
+                    mod.line_text(line))))
+
+    base_counts = load_baseline(baseline) if baseline else {}
+    for mod, f in sorted(raw, key=lambda mf: (mf[1].path, mf[1].line,
+                                              mf[1].rule_id)):
+        if mod.suppressed(f.rule_id, f.line):
+            report.suppressed += 1
+            continue
+        k = f.key()
+        if base_counts.get(k, 0) > 0:
+            base_counts[k] -= 1
+            report.baselined.append(f)
+            continue
+        report.findings.append(f)
+    return report
+
+
+def _repo_rel(path: str) -> str:
+    """Path relative to the repo root (the dir holding the baseline or a
+    .git), else to cwd — keeps baseline entries location-stable."""
+    anchor = find_baseline(path)
+    if anchor:
+        root = os.path.dirname(anchor)
+    else:
+        root = _git_root(path) or os.getcwd()
+    try:
+        return os.path.relpath(os.path.abspath(path), root)
+    except ValueError:
+        return path
+
+
+def _git_root(path: str) -> Optional[str]:
+    cur = os.path.abspath(path)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.exists(os.path.join(cur, ".git")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+def render_text(report: Report) -> str:
+    lines = []
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule_id} "
+                     f"[{f.severity}] {f.message}")
+    for e in report.parse_errors:
+        lines.append(f"parse error: {e}")
+    lines.append(
+        f"ptlint: {report.files} file(s), "
+        f"{len(report.findings)} finding(s), "
+        f"{len(report.baselined)} baselined, "
+        f"{report.suppressed} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(report: Report) -> str:
+    return json.dumps({
+        "files": report.files,
+        "findings": [f.to_dict() for f in report.findings],
+        "baselined": [f.to_dict() for f in report.baselined],
+        "suppressed": report.suppressed,
+        "parse_errors": report.parse_errors,
+    }, indent=1)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several rule modules
+# ---------------------------------------------------------------------------
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Trailing name of the called function: f(...) -> 'f',
+    a.b.f(...) -> 'f'."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def dotted_name(node) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def match_known(name: str, known: Iterable[str]) -> bool:
+    for pat in known:
+        if name == pat or ("*" in pat and fnmatch.fnmatchcase(name, pat)):
+            return True
+    return False

@@ -1,0 +1,73 @@
+"""ptlint CLI (shared by ``python -m paddle_tpu.analysis`` and
+``tools/ptlint.py``)."""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import engine
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptlint",
+        description="paddle_tpu framework-aware static analysis "
+                    "(PT1xx trace-safety, PT2xx SPMD collectives, "
+                    "PT3xx Pallas grid contracts, PT4xx registry "
+                    "consistency)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint "
+                         "(default: paddle_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON path (default: nearest "
+                         f"{engine.BASELINE_NAME} above the first path)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore any baseline file")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write all current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE",
+                    help="restrict to rule id(s); family form PT3xx ok "
+                         "(repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, r in sorted(engine.all_rules().items()):
+            print(f"{rid}  [{r.severity:7s}] ({r.scope}) {r.summary}")
+        return 0
+
+    paths = args.paths or ["paddle_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"ptlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline = args.baseline or engine.find_baseline(paths[0])
+        if baseline and not os.path.isfile(baseline):
+            print(f"ptlint: baseline not found: {baseline}",
+                  file=sys.stderr)
+            return 2
+
+    report = engine.run(paths, baseline=baseline, select=args.select)
+
+    if args.write_baseline:
+        target = args.baseline or os.path.join(
+            os.path.dirname(engine.find_baseline(paths[0]) or
+                            os.path.join(os.getcwd(), "x")),
+            engine.BASELINE_NAME)
+        engine.write_baseline(target, report.findings)
+        print(f"ptlint: wrote {len(report.findings)} entr"
+              f"{'y' if len(report.findings) == 1 else 'ies'} to "
+              f"{target}")
+        return 0
+
+    out = engine.render_json(report) if args.format == "json" \
+        else engine.render_text(report)
+    print(out)
+    return report.exit_code

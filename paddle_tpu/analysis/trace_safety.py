@@ -1,0 +1,232 @@
+"""PT1xx — trace-safety rules for ``@to_static``-reachable functions.
+
+Cross-referenced with jit/api.py's graph-break machinery: a traced
+function runs ONCE under jax tracing (``StaticFunction._run_compiled``),
+and the constructs flagged here either raise one of
+``_trace_break_errors()`` (TracerBoolConversionError /
+ConcretizationTypeError / ...) — demoting the whole callable to eager
+with a RuntimeWarning — or, worse, trace *silently wrong*: a ``print``
+fires once at trace time and never again, ``time.time()`` freezes the
+timestamp of the first trace into the compiled graph forever, and
+``random.random()`` bakes one sample in as a constant.
+
+Reachability is static and module-local: functions decorated with
+``to_static`` (any dotted form), functions passed to a ``to_static(...)``
+call, plus everything they call *within the same module* (fixpoint).
+That is deliberately narrower than true reachability — cross-module
+tracing is gated at runtime by ``jit/graph_break_count`` — but it is
+exact for the kernel of the problem: the function the user handed to the
+compiler.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import call_name, names_in, rule
+
+_WALLCLOCK_ATTRS = {"time", "perf_counter", "monotonic", "process_time",
+                    "perf_counter_ns", "time_ns", "monotonic_ns"}
+
+
+def _is_to_static_ref(node) -> bool:
+    """`to_static`, `jit.to_static`, `paddle.jit.to_static`, ..."""
+    if isinstance(node, ast.Name):
+        return node.id == "to_static"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "to_static"
+    return False
+
+
+def reachable_functions(mod):
+    """FunctionDefs that to_static can trace, module-locally: decorated
+    ones, ones passed to a to_static(...) call, and their same-module
+    callees (transitive closure). Cached on the module — every PT1xx
+    rule shares one traversal."""
+    cached = getattr(mod, "_pt_reachable", None)
+    if cached is not None:
+        return cached
+    roots = set()
+    for fn in mod.functions.values():
+        for dec in fn.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _is_to_static_ref(target):
+                roots.add(fn.name)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _is_to_static_ref(node.func):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name) and arg.id in mod.functions:
+                    roots.add(arg.id)
+    # fixpoint over same-module calls
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        fn = mod.functions.get(name)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                cn = call_name(node)
+                if cn in mod.functions and cn not in roots:
+                    roots.add(cn)
+                    frontier.append(cn)
+    result = [mod.functions[n] for n in sorted(roots)
+              if n in mod.functions]
+    mod._pt_reachable = result
+    return result
+
+
+def _param_names(fn: ast.FunctionDef) -> set:
+    a = fn.args
+    names = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+def _walk_body(fn):
+    """Walk a function body including nested defs (they trace too when
+    called), excluding the decorator list and signature defaults."""
+    for stmt in fn.body:
+        yield from ast.walk(stmt)
+
+
+@rule("PT101", "warning",
+      "print() inside a to_static-reachable function fires once at "
+      "trace time, not per step")
+def check_print(mod):
+    for fn in reachable_functions(mod):
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                yield (node.lineno, node.col_offset,
+                       f"'print' in traced function '{fn.name}' executes "
+                       f"once at trace time and is absent from the "
+                       f"compiled graph; use jax.debug.print or log "
+                       f"outside the traced region")
+
+
+@rule("PT102", "warning",
+      "wall-clock read inside a traced function is frozen at trace time")
+def check_wallclock(mod):
+    for fn in reachable_functions(mod):
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "time" and \
+                    node.func.attr in _WALLCLOCK_ATTRS:
+                yield (node.lineno, node.col_offset,
+                       f"'time.{node.func.attr}()' in traced function "
+                       f"'{fn.name}' is evaluated once at trace time and "
+                       f"baked into the graph as a constant")
+
+
+@rule("PT103", "error",
+      "host RNG inside a traced function bakes one sample into the graph")
+def check_host_rng(mod):
+    for fn in reachable_functions(mod):
+        for node in _walk_body(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_py_random = (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == "random")
+            is_np_random = (isinstance(f, ast.Attribute)
+                            and isinstance(f.value, ast.Attribute)
+                            and f.value.attr == "random"
+                            and isinstance(f.value.value, ast.Name)
+                            and f.value.value.id in ("np", "numpy"))
+            if is_py_random or is_np_random:
+                yield (node.lineno, node.col_offset,
+                       f"host RNG call in traced function '{fn.name}' "
+                       f"samples once at trace time; use "
+                       f"paddle_tpu.framework.random (traced PRNG keys) "
+                       f"instead")
+
+
+@rule("PT104", "error",
+      "nonlocal/global mutation inside a traced function is a hidden "
+      "side effect the compiled graph replays never")
+def check_nonlocal_mutation(mod):
+    for fn in reachable_functions(mod):
+        declared = set()
+        for node in _walk_body(fn):
+            if isinstance(node, (ast.Nonlocal, ast.Global)):
+                declared.update(node.names)
+        if not declared:
+            continue
+        for node in _walk_body(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared:
+                    yield (node.lineno, node.col_offset,
+                           f"assignment to nonlocal/global '{t.id}' in "
+                           f"traced function '{fn.name}' happens at "
+                           f"trace time only; compiled calls never "
+                           f"update it")
+
+
+@rule("PT105", "error",
+      ".numpy() inside a traced function forces a device sync and "
+      "breaks the trace")
+def check_numpy_call(mod):
+    for fn in reachable_functions(mod):
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "numpy" and not node.args:
+                yield (node.lineno, node.col_offset,
+                       f"'.numpy()' in traced function '{fn.name}' "
+                       f"concretizes a tracer "
+                       f"(ConcretizationTypeError -> graph break, see "
+                       f"jit/api.py _trace_break_errors)")
+
+
+@rule("PT106", "error",
+      "float()/int()/bool() of a tensor argument concretizes the tracer")
+def check_scalar_coercion(mod):
+    for fn in reachable_functions(mod):
+        params = _param_names(fn)
+        if not params:
+            continue
+        for node in _walk_body(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int", "bool") and \
+                    len(node.args) == 1 and \
+                    names_in(node.args[0]) & params:
+                yield (node.lineno, node.col_offset,
+                       f"'{node.func.id}(...)' over argument data in "
+                       f"traced function '{fn.name}' raises "
+                       f"TracerBoolConversionError/Concretization at "
+                       f"trace time (jit/api.py graph break); keep the "
+                       f"value on-device or mark the argument static")
+
+
+@rule("PT107", "error",
+      "data-dependent Python if/while on tensor arguments breaks tracing")
+def check_data_dependent_branch(mod):
+    for fn in reachable_functions(mod):
+        params = _param_names(fn)
+        if not params:
+            continue
+        for node in _walk_body(fn):
+            if isinstance(node, (ast.If, ast.While)) and \
+                    names_in(node.test) & params:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield (node.lineno, node.col_offset,
+                       f"data-dependent '{kind}' on arguments of traced "
+                       f"function '{fn.name}': concrete branching on a "
+                       f"tracer raises TracerBoolConversionError and "
+                       f"falls back to eager (or dy2static retry); use "
+                       f"lax.cond/jnp.where")

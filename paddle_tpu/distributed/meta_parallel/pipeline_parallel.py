@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from .pp_layers import PipelineLayer
+from ...utils.jax_compat import axis_size as _axis_size
 
 __all__ = ["PipelineParallel", "PipelineParallelWithInterleave",
            "PipelineParallelZeroBubble", "spmd_pipeline",
@@ -335,7 +336,7 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, n_micro: int,
     Total steps = n_micro + P - 1; each step: compute on current buffer,
     then ppermute the activation ring one hop toward the next stage.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_steps = n_micro + p - 1
     mb_shape = x.shape[1:]
@@ -387,7 +388,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable, chunked_params, x,
     x              : [n_micro, mb, ...] (consumed on stage 0)
     Returns [n_micro, mb, ...] outputs valid on the LAST stage.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = _axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     v = n_chunks
     q = p * v

@@ -22,6 +22,13 @@ Topology per collective (eager path = small tensors, correctness first):
   - scatter: src sends piece i to rank i.
   - all_to_all: pairwise exchange, deterministic peer order.
   - barrier: generation-counted store barrier.
+
+The hub/star topologies above are rank-asymmetric BY DESIGN: this module
+is the transport that *implements* eager collectives, not SPMD-traced
+user code, and every branch's send is matched by the peer's recv at the
+protocol level. The SPMD-ordering lint (PT2xx) cannot see that pairing
+across ranks, so it is switched off for this file:
+# ptlint: disable-file=PT2xx
 """
 from __future__ import annotations
 

@@ -37,6 +37,7 @@ from ..nn import functional as F
 from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
 from ..ops.pallas import flash_attention as fa
 from ..ops.pallas import rms_norm as rn
+from ..utils.jax_compat import axis_size as _axis_size
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel",
            "forward_stacked", "loss_fn_stacked", "loss_fn_pipelined",
@@ -499,8 +500,10 @@ def _block(params, x, config: LlamaConfig, mesh=None):
             return ra.ring_attention_bshd(qq, kk, vv, axis_name="sep",
                                           is_causal=True)
 
+        from ..utils.jax_compat import shard_map as _shard_map
+
         seq_spec = P(None, "sep")
-        attn = jax.shard_map(
+        attn = _shard_map(
             ring_attn, mesh=mesh,
             in_specs=(seq_spec, seq_spec, seq_spec), out_specs=seq_spec,
             axis_names={"sep"}, check_vma=False)(q, k, v)
@@ -616,7 +619,7 @@ def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
         return y
 
     def ring(stage_blocks, xm):
-        p = jax.lax.axis_size("pp")
+        p = _axis_size("pp")
         stage = jax.lax.axis_index("pp")
         ys = spmd_pipeline(stage_fn, stage_blocks, xm, n_micro,
                            axis_name="pp")
@@ -625,8 +628,10 @@ def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
         return jax.lax.psum(
             jnp.where(stage == p - 1, ys, jnp.zeros_like(ys)), "pp")
 
+    from ..utils.jax_compat import shard_map as _shard_map
+
     block_specs = jax.tree.map(lambda _: P("pp"), params["blocks"])
-    ys = jax.shard_map(
+    ys = _shard_map(
         ring, mesh=mesh, in_specs=(block_specs, P()), out_specs=P(),
         axis_names={"pp"}, check_vma=False)(params["blocks"], x)
     return _head_loss(params, ys, labels, config)

@@ -240,5 +240,10 @@ def bincount(x, weights=None, minlength=0, name=None):
     return apply(fn2, x, *extra, op_name="bincount", differentiable=False)
 
 
-for _n in __all__:
+# index_sample / masked_select live in (and are registered by)
+# ops.manipulation; re-registering them here let import order pick the
+# surviving kernel (ptlint PT401) — they stay in __all__ for the
+# namespace surface but only this module's own ops register.
+for _n in [n for n in __all__
+           if n not in ("index_sample", "masked_select")]:
     registry.register(_n, globals()[_n], tags=("search",))

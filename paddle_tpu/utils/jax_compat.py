@@ -1,0 +1,63 @@
+"""Version-tolerant jax API shims.
+
+The framework rides jax across the window where APIs graduate from
+``jax.experimental`` to the top level with renamed keywords. The one
+that bit tier-1: ``shard_map`` is ``jax.shard_map(..., axis_names=...,
+check_vma=...)`` on new jax but only
+``jax.experimental.shard_map.shard_map(..., auto=..., check_rep=...)``
+on 0.4.x — 13 tests and the llama sep/pp engines failed on the import
+alone. Route every use through :func:`shard_map` here.
+"""
+from __future__ import annotations
+
+import jax
+
+try:                                    # jax >= 0.6-style top-level API
+    _native_shard_map = jax.shard_map
+    _IS_NATIVE = True
+except AttributeError:                  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _native_shard_map
+
+    _IS_NATIVE = False
+
+__all__ = ["shard_map", "axis_size"]
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (new jax) with the classic
+    ``psum(1, axis)`` constant-folded fallback on 0.4.x."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=None, check_rep=None, **kwargs):
+    """``jax.shard_map`` with the new-API surface on every jax version.
+
+    ``axis_names={'sep'}`` (manual only over those axes) maps to the old
+    API's complement ``auto=`` set; ``check_vma`` maps to the old
+    ``check_rep``. Extra kwargs pass through untouched.
+    """
+    kw = dict(kwargs)
+    if _IS_NATIVE:
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        elif check_rep is not None:
+            kw["check_vma"] = check_rep
+    else:
+        # 0.4.x's partial-auto mode cannot lower axis_index (PartitionId
+        # is unsupported under SPMD partitioning), so `axis_names` maps
+        # to FULL manual: unmentioned axes replicate inside the region
+        # (numerically identical — in_specs that don't name them already
+        # promise nothing about their placement — at some parallelism
+        # cost on 0.4.x only).
+        if check_rep is not None:
+            kw["check_rep"] = check_rep
+        elif check_vma is not None:
+            kw["check_rep"] = check_vma
+    return _native_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)

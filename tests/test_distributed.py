@@ -49,7 +49,7 @@ def test_hcg_modes():
 def test_collectives_in_shard_map():
     from functools import partial
 
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     mesh = _mesh((8,), ("world",))
     from paddle_tpu.distributed import collective
@@ -81,7 +81,7 @@ def test_collectives_in_shard_map():
 def test_ring_attention_matches_full():
     from functools import partial
 
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas.ring_attention import ring_attention_bhsd
@@ -109,7 +109,7 @@ def test_ring_attention_matches_full():
 def test_ring_attention_grad():
     from functools import partial
 
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas.ring_attention import ring_attention_bhsd
@@ -139,7 +139,7 @@ def test_ring_attention_grad_distinct_qkv():
     the custom VJP and must land home with full accumulation)."""
     from functools import partial
 
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas.ring_attention import ring_attention_bhsd
@@ -300,7 +300,7 @@ def test_distributed_checkpoint_roundtrip(tmp_path):
 def test_spmd_pipeline():
     from functools import partial
 
-    from jax import shard_map
+    from paddle_tpu.utils.jax_compat import shard_map
 
     from paddle_tpu.distributed.meta_parallel import spmd_pipeline
 

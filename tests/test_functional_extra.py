@@ -1,6 +1,7 @@
 """nn.functional extras (reference nn/functional exports): distances,
 losses (incl. exact RNN-T), unpooling with real argmax indices, in-place
 aliases."""
+import os
 import numpy as np
 import pytest
 
@@ -215,6 +216,9 @@ def test_py_func_host_callback():
     np.testing.assert_allclose(np.asarray(out.numpy()), 3.0)
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/python/paddle/nn/__init__.py"),
+    reason="reference Paddle checkout not present")
 def test_nn_export_parity_with_reference():
     import re
 

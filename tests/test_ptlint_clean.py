@@ -1,0 +1,42 @@
+"""CI gate: the full ptlint suite over paddle_tpu/ must be clean.
+
+This is the tier-1 enforcement of the static-analysis contract: zero
+non-baselined violations across the whole package. A new finding means
+either fix the code, suppress it in place with an explained
+``# ptlint: disable=PTxxx``, or (for intentional grandfathering only)
+regenerate ``.ptlint-baseline.json`` via
+``python -m paddle_tpu.analysis paddle_tpu/ --write-baseline``.
+"""
+import os
+
+from paddle_tpu.analysis import engine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_ptlint_clean_over_package():
+    baseline = os.path.join(REPO, engine.BASELINE_NAME)
+    report = engine.run([os.path.join(REPO, "paddle_tpu")],
+                        baseline=baseline if os.path.isfile(baseline)
+                        else None)
+    assert not report.parse_errors, report.parse_errors
+    assert not report.findings, "\n" + engine.render_text(report)
+    # the gate must actually have looked at the package
+    assert report.files > 100
+
+
+def test_baseline_entries_still_real():
+    """Every baseline entry must still match a live finding — stale
+    entries mean the underlying code was fixed and the baseline should
+    shrink (delete the entry), keeping the grandfather list honest."""
+    baseline = os.path.join(REPO, engine.BASELINE_NAME)
+    if not os.path.isfile(baseline):
+        return
+    entries = engine.load_baseline(baseline)
+    n_entries = sum(entries.values())
+    report = engine.run([os.path.join(REPO, "paddle_tpu")],
+                        baseline=baseline)
+    assert len(report.baselined) == n_entries, (
+        f"baseline has {n_entries} entries but only "
+        f"{len(report.baselined)} matched a live finding — remove the "
+        f"stale entries from {engine.BASELINE_NAME}")

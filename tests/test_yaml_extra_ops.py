@@ -1,5 +1,6 @@
 """Numeric checks for the yaml_extra / vision_ops surfaces vs NumPy
 references (reference: test/legacy_test per-op tests over ops.yaml)."""
+import os
 import numpy as np
 import pytest
 
@@ -13,6 +14,9 @@ def K(name):
     return info.fn
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/paddle/phi/ops/yaml"),
+    reason="reference Paddle checkout not present")
 def test_coverage_audit():
     import yaml
 

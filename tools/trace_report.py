@@ -22,6 +22,44 @@ import argparse
 import json
 from collections import defaultdict
 
+# The framework's metric-name inventory — the single known set shared by
+# this report, the README "Observability" section, and the PT403 lint
+# rule (paddle_tpu/analysis/registry_rules.py), which statically checks
+# every literal metric name emitted in paddle_tpu/ against it. '*'
+# entries cover dynamically-built families (f-string / concatenated
+# names). Names outside this set render with an "(unknown)" marker below
+# and fail ptlint at the emit site.
+KNOWN_METRICS = (
+    # op-dispatch funnel (core/dispatch.py, ops/registry.py)
+    "dispatch/calls", "dispatch/cache_hit", "dispatch/cache_miss",
+    "dispatch/uncacheable", "dispatch/cache_disabled_calls",
+    "dispatch/cache_evictions", "dispatch/cache_fallbacks",
+    # jit compile bridge (jit/api.py, jit/partial_capture.py)
+    "jit/compile_count", "jit/compile_ms", "jit/retrace_count",
+    "jit/retrace_cause/*", "jit/graph_break_count",
+    "jit/partial_regions", "jit/partial_regions_installed",
+    "jit/region_break_count",
+    # collectives (distributed/collective.py)
+    "comm/collective_count", "comm/collective_bytes", "comm/latency_ms",
+    "comm/*_count", "comm/*_bytes",
+    # serving engine (inference/serving.py)
+    "serving/ttft_ms", "serving/tpot_ms", "serving/steps",
+    "serving/tokens_generated", "serving/requests",
+    "serving/preemptions", "serving/batch_occupancy",
+    "serving/kv_cache_utilization",
+)
+
+
+def _known(name: str) -> bool:
+    import fnmatch
+
+    return any(name == p or ("*" in p and fnmatch.fnmatchcase(name, p))
+               for p in KNOWN_METRICS)
+
+
+def _tag(name: str) -> str:
+    return name if _known(name) else name + " (unknown)"
+
 
 def summarize_trace(trace: dict) -> str:
     events = trace.get("traceEvents", [])
@@ -72,13 +110,13 @@ def summarize_metrics(snap: dict) -> str:
     if counters:
         lines.append("  Counters:")
         for name in sorted(counters):
-            lines.append(f"    {name:<44} {counters[name]}")
+            lines.append(f"    {_tag(name):<44} {counters[name]}")
     if gauges:
         lines.append("  Gauges:")
         for name in sorted(gauges):
             v = gauges[name]
             v = f"{v:.4f}" if isinstance(v, float) else v
-            lines.append(f"    {name:<44} {v}")
+            lines.append(f"    {_tag(name):<44} {v}")
     if hists:
         lines.append("  Histograms:")
         lines.append(f"    {'Name':<34} {'Count':>7} {'Avg':>10} "
