@@ -14,7 +14,8 @@ invisible to runtime tests until they run on real hardware:
 ptlint moves all three — plus registry/metrics drift — into a CI check
 that fails in seconds.  This module is the engine: rule registry with
 stable IDs (PT1xx trace-safety, PT2xx SPMD-collective ordering, PT3xx
-Pallas kernel contracts, PT4xx registry consistency), severities,
+Pallas kernel contracts, PT4xx registry consistency, PT5xx
+error-surfacing in distributed/), severities,
 ``# ptlint: disable=PTxxx`` line suppressions, text + JSON reporters, and
 a committed-baseline workflow for grandfathered findings.
 
@@ -100,6 +101,7 @@ def _load_rule_modules():
     from . import collective_rules  # noqa: F401
     from . import pallas_rules      # noqa: F401
     from . import registry_rules    # noqa: F401
+    from . import resilience_rules  # noqa: F401
     from . import trace_safety      # noqa: F401
 
 

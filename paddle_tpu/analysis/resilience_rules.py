@@ -1,0 +1,90 @@
+"""PT5xx — error-surfacing rules for the distributed layer.
+
+The fault-tolerance contract (distributed/resilience/) is that failures
+surface as structured errors or at least as metric counts — never
+vanish. A ``try: ... except Exception: pass`` in transport, elastic, or
+the launch controller is exactly how a real failure mode (dead peer,
+store hiccup, torn frame) turns into an undebuggable hang three layers
+up: the recovery loop can only react to failures it can see.
+
+Scope: files under a ``distributed/`` directory (the subsystem where
+every swallowed error is a potential silent desync). Sites that are
+genuinely by-design (e.g. best-effort probes on a hot poll path) are
+grandfathered in ``.ptlint-baseline.json`` or suppressed in place with
+an explained ``# ptlint: disable=PT5xx``.
+"""
+from __future__ import annotations
+
+import ast
+
+from .engine import rule
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _in_scope(mod) -> bool:
+    return "distributed/" in ("/" + mod.relpath)
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    """except:, except Exception:, except BaseException:, or a tuple
+    containing one of those."""
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in _BROAD for n in names)
+
+
+def _body_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body does NOTHING with the error: only
+    pass / continue / a bare constant (docstring, Ellipsis). Any call,
+    assignment, return-of-a-fallback, raise, or logging counts as
+    surfacing/handling."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) \
+                and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@rule("PT501", "error",
+      "bare 'except:' in distributed/ — also traps SystemExit/"
+      "KeyboardInterrupt, so a killed rank can't even die")
+def check_bare_except(mod):
+    if not _in_scope(mod):
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield (node.lineno, node.col_offset,
+                   "bare 'except:' traps SystemExit and "
+                   "KeyboardInterrupt — in the distributed layer this "
+                   "can keep a rank half-alive after the launcher "
+                   "killed it; catch Exception (or narrower) instead")
+
+
+@rule("PT502", "warning",
+      "'except Exception: pass' in distributed/ — the error must be "
+      "surfaced (raise/log) or counted (profiler metrics)")
+def check_swallowed_exception(mod):
+    if not _in_scope(mod):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if _is_broad(node) and _body_swallows(node):
+            yield (node.lineno, node.col_offset,
+                   "broad except with a body that only passes: in the "
+                   "distributed layer a swallowed error here is a "
+                   "silent desync/hang later — surface it as a "
+                   "structured error (resilience/errors.py), log it, "
+                   "or count it via profiler metrics; if genuinely "
+                   "by-design, suppress with an explained "
+                   "'# ptlint: disable=PT502'")

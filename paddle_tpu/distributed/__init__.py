@@ -25,6 +25,9 @@ from .auto_parallel.process_mesh import ProcessMesh
 from .auto_parallel.placement import (Placement, Partial, Replicate, Shard)
 from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
+from . import resilience
+from .resilience.recovery import (latest_checkpoint, resume_from_latest,
+                                  save_checkpoint)
 from .parallel import DataParallel
 from . import utils
 from . import auto_tuner

@@ -10,6 +10,17 @@ nodes it signals ELASTIC_RESTART so the launch controller re-forms the pod
 (rank remap happens at the next rendezvous). etcd is optional — when an
 etcd endpoint is configured and the etcd3 client is importable it is used,
 otherwise the store backend serves the same role.
+
+Failure detection is the first half of the recovery loop (resilience/):
+a dead heartbeat drops the rank from ``alive_members()``, the membership
+change sets ``need_restart`` / fires ``on_membership_change``, the launch
+controller re-forms the pod, and the re-formed workers call
+``resilience.resume_from_latest`` to continue from the last complete
+checkpoint. The heartbeat thread itself is hardened: a store error (the
+store hiccuping, or dying with the master node) is counted in
+``elastic/heartbeat_errors`` and the thread KEEPS BEATING — a transient
+store failure must not silently turn this node into a corpse that the
+rest of the pod then evicts.
 """
 from __future__ import annotations
 
@@ -18,11 +29,18 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE", "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+from ..profiler import metrics as _metrics
+
+__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 # reference manager.py:32-33 exit codes
 ELASTIC_EXIT_CODE = 101
 ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+_m_hb_errors = _metrics.counter("elastic/heartbeat_errors")
+_m_last_beat = _metrics.gauge("elastic/last_beat_ts")
+_m_changes = _metrics.counter("elastic/membership_changes")
 
 
 class ElasticManager:
@@ -42,6 +60,9 @@ class ElasticManager:
         self._thread = None
         self._last_members: Optional[List[int]] = None
         self.need_restart = False
+        self.last_beat_ts: Optional[float] = None
+        self.heartbeat_errors = 0
+        self.last_error: Optional[str] = None
 
     # -- membership --------------------------------------------------------
     def register(self):
@@ -60,22 +81,42 @@ class ElasticManager:
                 members.append(r)
         return members
 
+    def dead_members(self) -> List[int]:
+        """Ranks whose heartbeat is stale (relative to the last known
+        membership) — what the launch controller treats as failed."""
+        alive = set(self.alive_members())
+        known = self._last_members or list(range(self.min_nodes))
+        return [r for r in known if r not in alive]
+
     # -- heartbeat loop ----------------------------------------------------
+    def _beat_once(self):
+        """One heartbeat + membership check. Split out from the loop so
+        tests can drive it synchronously."""
+        self.store.set(f"{self.job_id}/hb/{self.rank}",
+                       str(time.time()))
+        self.last_beat_ts = time.time()
+        _m_last_beat.set(self.last_beat_ts)
+        members = self.alive_members()
+        if self._last_members is not None and \
+                members != self._last_members:
+            _m_changes.inc()
+            if len(members) >= self.min_nodes:
+                self.need_restart = True
+                if self.on_change:
+                    self.on_change(members)
+        self._last_members = members
+
     def _loop(self):
         while not self._stop.is_set():
             try:
-                self.store.set(f"{self.job_id}/hb/{self.rank}",
-                               str(time.time()))
-                members = self.alive_members()
-                if self._last_members is not None and \
-                        members != self._last_members:
-                    if len(members) >= self.min_nodes:
-                        self.need_restart = True
-                        if self.on_change:
-                            self.on_change(members)
-                self._last_members = members
-            except Exception:
-                pass
+                self._beat_once()
+            except Exception as e:
+                # a store error must NOT kill the heartbeat thread: a
+                # silent death here reads as a dead node to every peer
+                # and evicts a healthy worker. Count it and keep beating.
+                self.heartbeat_errors += 1
+                self.last_error = repr(e)
+                _m_hb_errors.inc()
             self._stop.wait(self.interval)
 
     def start(self):

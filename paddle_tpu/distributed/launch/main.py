@@ -229,6 +229,20 @@ class Controller:
                     return ("reform",
                             self.store.add(f"{self.args.job_id}/gen_bump",
                                            1))
+                # watchdog escalation (distributed/watchdog.py) marks a
+                # stalled group unhealthy in the store — a hung rank
+                # still heartbeats, so this is the only signal that
+                # catches a desync/deadlock (vs a dead process)
+                unhealthy = self._unhealthy_group()
+                if unhealthy is not None:
+                    print(f"[launch] elastic: group {unhealthy} marked "
+                          f"unhealthy by comm watchdog; re-forming pod",
+                          file=sys.stderr)
+                    self.store.delete_key(f"__unhealthy__/{unhealthy}")
+                    self._kill(pod)
+                    return ("reform",
+                            self.store.add(f"{self.args.job_id}/gen_bump",
+                                           1))
                 # scale-out: a node joined this generation after we
                 # settled — re-form so it gets a rank
                 n_now = self.store.add(f"{self._ns()}/nodes", 0)
@@ -290,6 +304,16 @@ class Controller:
     def _heartbeat_now(self, rank: int):
         if self.store is not None:
             self.store.set(f"{self._ns()}/hb/{rank}", str(time.time()))
+
+    def _unhealthy_group(self):
+        """Group id marked unhealthy by a worker's watchdog escalation
+        (only the world group 0 is checked — sub-group desyncs stall
+        the world group's next collective anyway), or None."""
+        try:
+            self.store.get_nowait("__unhealthy__/0")
+            return 0
+        except Exception:
+            return None
 
     def _stale_peer(self, pod: Pod):
         now = time.time()

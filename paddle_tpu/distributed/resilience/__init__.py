@@ -1,0 +1,47 @@
+"""Fault tolerance for the distributed stack.
+
+Four pieces, one recovery loop (MegaScale-style per-rank failure
+detection, Gemini-style fast resume):
+
+- ``errors``: the structured failure taxonomy every layer raises from.
+- ``faults``: the deterministic chaos-injection harness (PT_FAULT_PLAN)
+  the transport consults, so pod failure modes run on the CPU mesh.
+- transport hardening lives in ``..transport`` (CRC32 frames, ack/
+  retransmit with seq dedup, redial with exponential backoff).
+- ``recovery``: checkpoint discovery + ``resume_from_latest`` restoring
+  the last complete atomic checkpoint via reshard-on-load, so a
+  re-formed pod continues bitwise-identically on the surviving config.
+
+``recovery`` is imported lazily: it pulls the checkpoint machinery
+(jax) while ``errors``/``faults`` stay importable from the no-jax
+transport layer.
+"""
+from __future__ import annotations
+
+from . import errors
+from . import faults
+from .errors import (CommTimeoutError, FrameCorruptError,
+                     PeerUnreachableError, TransportClosedError,
+                     TransportError, TransportTimeoutError)
+from .faults import FaultAction, FaultInjector, FaultPlan, FaultRule
+
+__all__ = [
+    "errors", "faults", "recovery",
+    "CommTimeoutError", "FrameCorruptError", "PeerUnreachableError",
+    "TransportClosedError", "TransportError", "TransportTimeoutError",
+    "FaultAction", "FaultInjector", "FaultPlan", "FaultRule",
+    "resume_from_latest", "save_checkpoint", "latest_checkpoint",
+]
+
+_LAZY_RECOVERY = ("recovery", "resume_from_latest", "save_checkpoint",
+                  "latest_checkpoint")
+
+
+def __getattr__(name):
+    if name in _LAZY_RECOVERY:
+        from . import recovery
+        if name == "recovery":
+            return recovery
+        return getattr(recovery, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

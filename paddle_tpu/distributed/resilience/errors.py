@@ -1,0 +1,89 @@
+"""Structured error taxonomy for the fault-tolerance subsystem.
+
+Every failure the transport / collectives / recovery loop can surface is
+a named class carrying the machine-readable context a controller needs
+to decide between retry, re-form, and abort — never a bare Exception
+with a free-text message. Deliberately stdlib-only: this module is
+imported by the transport (no jax) and by the chaos test harness.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = [
+    "TransportError", "TransportClosedError", "TransportTimeoutError",
+    "FrameCorruptError", "PeerUnreachableError", "CommTimeoutError",
+]
+
+
+class TransportError(RuntimeError):
+    """Base class for eager-transport failures."""
+
+
+class TransportClosedError(TransportError):
+    """The transport was shut down while an operation was in flight."""
+
+
+class TransportTimeoutError(TransportError, TimeoutError):
+    """recv() deadline expired. Names the missing tag and what IS
+    waiting in the mailbox, so a hang is debuggable from one rank's
+    traceback (a desync shows up as pending tags from the wrong
+    channel/sequence)."""
+
+    def __init__(self, tag: str, pending: Optional[List[str]] = None,
+                 timeout_s: Optional[float] = None):
+        self.tag = tag
+        self.pending = list(pending or [])
+        self.timeout_s = timeout_s
+        pend = ", ".join(repr(t) for t in self.pending) or "<none>"
+        super().__init__(
+            f"transport recv timed out after {timeout_s}s waiting for "
+            f"tag {tag!r}; tags pending in mailbox: {pend}")
+
+
+class FrameCorruptError(TransportError):
+    """A frame repeatedly failed CRC32 verification at the receiver and
+    the sender exhausted its retransmit budget."""
+
+    def __init__(self, peer: int, fseq: int, attempts: int):
+        self.peer = peer
+        self.fseq = fseq
+        self.attempts = attempts
+        super().__init__(
+            f"frame fseq={fseq} to rank {peer} failed CRC verification "
+            f"after {attempts} transmit attempts (payload corrupted in "
+            f"flight)")
+
+
+class PeerUnreachableError(TransportError, ConnectionError):
+    """Dial/redial to a peer kept failing past the retry budget."""
+
+    def __init__(self, peer: int, addr: Optional[str], attempts: int,
+                 last_error: Optional[BaseException] = None):
+        self.peer = peer
+        self.addr = addr
+        self.attempts = attempts
+        self.last_error = last_error
+        super().__init__(
+            f"cannot reach rank {peer} at {addr} after {attempts} "
+            f"dial attempts: {last_error!r}")
+
+
+class CommTimeoutError(TransportError):
+    """A collective stalled past the watchdog timeout. Raised on every
+    member of the group (the watchdog aborts local mailbox waiters and
+    marks the group unhealthy in the store) instead of hanging one
+    rank while the rest spin."""
+
+    def __init__(self, op: str, group_id: int, seq: Optional[int],
+                 rank: Optional[int], timeout_s: float):
+        self.op = op
+        self.group_id = group_id
+        self.seq = seq
+        self.rank = rank
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"collective '{op}' on group {group_id} (seq={seq}) stalled "
+            f"past the {timeout_s}s watchdog timeout on rank {rank}; "
+            f"group marked unhealthy — compare watchdog dumps across "
+            f"ranks to locate the desynced/dead member")

@@ -1,0 +1,253 @@
+"""Deterministic fault injection for the eager transport.
+
+The chaos harness: a process-wide ``FaultInjector`` that the transport
+consults at well-defined sites (``send`` per outgoing data-frame
+attempt, ``dial`` per connect attempt, ``recv`` per delivered frame).
+A ``FaultPlan`` names which fault fires where — armed from the
+``PT_FAULT_PLAN`` environment variable or programmatically — so the
+failure modes a TPU pod actually exhibits (dropped DCN connections,
+slow hosts, corrupted frames, killed ranks) are reproducible on the
+2-process CPU mesh in tier-1 tests.
+
+Plan DSL (comma/semicolon separated clauses)::
+
+    PT_FAULT_PLAN="drop@send#2,corrupt@send#4"
+    PT_FAULT_PLAN="kill@send#3:rank=1"
+    PT_FAULT_PLAN="delay@send#1:ms=250,dup@send#2"
+    PT_FAULT_PLAN="seed=7,drop@send%0.05"
+
+Each clause is ``<kind>@<site>`` plus either ``#n`` (fire on the n-th
+matching event, exactly once) or ``%p`` (fire each matching event with
+probability p from the seeded RNG — deterministic per ``seed=N``).
+Optional filters: ``:rank=R`` (only this global rank injects) and
+``:peer=P`` (only events involving that peer). Kinds:
+
+- ``drop``    close the peer connection (exercises redial + retransmit)
+- ``delay``   sleep ``ms`` (default 100) before the event proceeds
+- ``dup``     transmit the frame twice (exercises seq-based dedup)
+- ``corrupt`` flip a payload byte after CRC is computed (exercises
+  CRC verification + NAK retransmit)
+- ``kill``    ``os._exit(code)`` (default 1) — a rank dying
+  mid-collective (exercises watchdog escalation on the survivors)
+
+Every injected fault increments ``faults/injected`` and
+``faults/<kind>`` in the metrics registry so a chaos run's report shows
+exactly what was thrown at the system.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ...profiler import metrics as _metrics
+
+__all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
+           "injector", "arm", "disarm", "is_armed", "parse_plan",
+           "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
+
+FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill")
+FAULT_SITES = ("send", "dial", "recv")
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    """What the transport should do at an injection site."""
+
+    kind: str                      # one of FAULT_KINDS
+    delay_ms: float = 100.0        # for kind == "delay"
+    exit_code: int = 1             # for kind == "kill"
+
+
+@dataclass
+class FaultRule:
+    kind: str
+    site: str
+    nth: Optional[int] = None      # fire on the n-th matching event
+    prob: float = 0.0              # or: fire with this probability
+    rank: Optional[int] = None     # only inject on this global rank
+    peer: Optional[int] = None     # only on events involving this peer
+    delay_ms: float = 100.0
+    exit_code: int = 1
+    # runtime state
+    seen: int = 0
+    fired: int = 0
+
+    def matches(self, site: str, rank: int, peer: Optional[int]) -> bool:
+        if site != self.site:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.peer is not None and peer != self.peer:
+            return False
+        return True
+
+
+@dataclass
+class FaultPlan:
+    rules: List[FaultRule] = field(default_factory=list)
+    seed: int = 0
+
+    def describe(self) -> str:
+        out = []
+        for r in self.rules:
+            tok = f"{r.kind}@{r.site}"
+            tok += f"#{r.nth}" if r.nth is not None else f"%{r.prob}"
+            if r.rank is not None:
+                tok += f":rank={r.rank}"
+            out.append(tok)
+        return ",".join(out) or "<empty>"
+
+
+def parse_plan(spec: str) -> FaultPlan:
+    """Parse the PT_FAULT_PLAN DSL (see module docstring)."""
+    plan = FaultPlan()
+    for clause in spec.replace(";", ",").split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if clause.startswith("seed="):
+            plan.seed = int(clause[5:])
+            continue
+        head, *opts = clause.split(":")
+        if "@" not in head:
+            raise ValueError(
+                f"bad PT_FAULT_PLAN clause {clause!r}: expected "
+                f"<kind>@<site>#n or <kind>@<site>%p")
+        kind, _, rest = head.partition("@")
+        if kind not in FAULT_KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} in {clause!r} "
+                             f"(known: {', '.join(FAULT_KINDS)})")
+        rule = FaultRule(kind=kind, site="", )
+        if "#" in rest:
+            site, _, n = rest.partition("#")
+            rule.nth = int(n)
+        elif "%" in rest:
+            site, _, p = rest.partition("%")
+            rule.prob = float(p)
+        else:
+            site, rule.nth = rest, 1
+        if site not in FAULT_SITES:
+            raise ValueError(f"unknown fault site {site!r} in {clause!r} "
+                             f"(known: {', '.join(FAULT_SITES)})")
+        rule.site = site
+        for opt in opts:
+            k, _, v = opt.partition("=")
+            if k == "rank":
+                rule.rank = int(v)
+            elif k == "peer":
+                rule.peer = int(v)
+            elif k == "ms":
+                rule.delay_ms = float(v)
+            elif k == "code":
+                rule.exit_code = int(v)
+            else:
+                raise ValueError(f"unknown option {opt!r} in {clause!r}")
+        plan.rules.append(rule)
+    return plan
+
+
+class FaultInjector:
+    """Process-wide injection point. Disarmed (the default) costs one
+    attribute read per event; armed, each matching rule fires per its
+    ``#n`` / ``%p`` trigger. Thread-safe: transport send paths race."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._plan: Optional[FaultPlan] = None
+        self._rng: Optional[random.Random] = None
+
+    # -- arming ----------------------------------------------------------
+    def arm(self, plan) -> FaultPlan:
+        if isinstance(plan, str):
+            plan = parse_plan(plan)
+        with self._lock:
+            self._plan = plan
+            self._rng = random.Random(plan.seed)
+        return plan
+
+    def disarm(self):
+        with self._lock:
+            self._plan = None
+            self._rng = None
+
+    def is_armed(self) -> bool:
+        return self._plan is not None
+
+    @property
+    def plan(self) -> Optional[FaultPlan]:
+        return self._plan
+
+    # -- the hook the transport calls ------------------------------------
+    def on_event(self, site: str, rank: int,
+                 peer: Optional[int] = None) -> Optional[FaultAction]:
+        """Record one event at `site`; return the action to inject, or
+        None. At most one rule fires per event (first match wins)."""
+        plan = self._plan
+        if plan is None:
+            return None
+        action = None
+        with self._lock:
+            # every matching rule observes every event (so '#n' counts
+            # site events, not rule evaluations); the first rule whose
+            # trigger matches wins the event
+            for rule in plan.rules:
+                if not rule.matches(site, rank, peer):
+                    continue
+                rule.seen += 1
+                if action is not None:
+                    continue
+                fire = False
+                if rule.nth is not None:
+                    fire = rule.seen == rule.nth
+                elif self._rng is not None and rule.prob > 0:
+                    fire = self._rng.random() < rule.prob
+                if not fire:
+                    continue
+                rule.fired += 1
+                _metrics.inc("faults/injected")
+                _metrics.inc(f"faults/{rule.kind}")
+                action = FaultAction(rule.kind, delay_ms=rule.delay_ms,
+                                     exit_code=rule.exit_code)
+        return action
+
+    def counts(self) -> dict:
+        """{kind: times fired} for the armed plan (chaos-test probe)."""
+        plan = self._plan
+        if plan is None:
+            return {}
+        out: dict = {}
+        with self._lock:
+            for r in plan.rules:
+                out[r.kind] = out.get(r.kind, 0) + r.fired
+        return out
+
+
+injector = FaultInjector()
+
+
+def arm(plan) -> FaultPlan:
+    return injector.arm(plan)
+
+
+def disarm():
+    injector.disarm()
+
+
+def is_armed() -> bool:
+    return injector.is_armed()
+
+
+def maybe_arm_from_env() -> bool:
+    """Arm from PT_FAULT_PLAN if set and not already armed. Called by
+    the transport at init so chaos plans reach subprocess workers
+    through the environment alone."""
+    if injector.is_armed():
+        return True
+    spec = os.environ.get("PT_FAULT_PLAN", "").strip()
+    if not spec:
+        return False
+    injector.arm(spec)
+    return True

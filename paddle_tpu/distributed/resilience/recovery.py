@@ -1,0 +1,106 @@
+"""Elastic checkpoint-resume: restore the last complete checkpoint.
+
+Reference analog: the Gemini-style fast-resume loop — training restarts
+(elastic re-formation, preemption, a killed rank) resume from the
+latest *consistent* checkpoint rather than step 0.
+
+Builds directly on ``distributed.checkpoint``: each checkpoint is a
+``step_<N>`` directory written by ``save_state_dict`` (per-rank shard
+files, then the ``0.metadata`` manifest — written LAST and atomically
+via tmp+rename, so the manifest's presence IS the completeness marker:
+a worker killed mid-save leaves a directory without a manifest, which
+discovery skips). Loading goes through ``load_state_dict``'s
+reshard-on-load, so a pod that re-formed onto a different parallel
+config (fewer hosts, remapped ranks) restores bitwise-identical values
+under the new sharding.
+"""
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from ..checkpoint import load_state_dict, save_state_dict
+
+__all__ = ["save_checkpoint", "latest_checkpoint", "list_checkpoints",
+           "resume_from_latest", "CKPT_DIR_RE"]
+
+CKPT_DIR_RE = re.compile(r"^step_(\d+)$")
+_MANIFEST = "0.metadata"
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step:08d}")
+
+
+def list_checkpoints(root: str) -> List[Tuple[int, str]]:
+    """All COMPLETE checkpoints under `root` as (step, path), ascending.
+    A checkpoint is complete iff its manifest exists (the manifest is
+    written last, atomically)."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        m = CKPT_DIR_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(os.path.join(path, _MANIFEST)):
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[Tuple[int, str]]:
+    """(step, path) of the newest complete checkpoint, or None."""
+    found = list_checkpoints(root)
+    return found[-1] if found else None
+
+
+def save_checkpoint(state_dict: Dict, root: str, step: int,
+                    keep: Optional[int] = None) -> str:
+    """Write `state_dict` as the step-`step` checkpoint under `root`.
+
+    Delegates to ``save_state_dict`` (per-rank shards + atomic
+    manifest). With `keep`, prunes the oldest complete checkpoints
+    beyond the newest `keep` — incomplete directories (no manifest:
+    a previous crash mid-save) are always pruned. Returns the
+    checkpoint directory path."""
+    os.makedirs(root, exist_ok=True)
+    path = _step_dir(root, step)
+    save_state_dict(state_dict, path)
+    from .. import env
+    if env.global_rank() == 0:
+        complete = {p for _, p in list_checkpoints(root)}
+        for name in os.listdir(root):
+            cand = os.path.join(root, name)
+            if CKPT_DIR_RE.match(name) and cand != path \
+                    and cand not in complete:
+                shutil.rmtree(cand, ignore_errors=True)
+        if keep is not None and keep > 0:
+            for _, old in list_checkpoints(root)[:-keep]:
+                if old != path:
+                    shutil.rmtree(old, ignore_errors=True)
+    return path
+
+
+def resume_from_latest(state_dict: Dict, root: str) -> Optional[int]:
+    """Restore `state_dict` in place from the newest complete checkpoint
+    under `root`, resharding each tensor to its CURRENT sharding (the
+    surviving pod config). Returns the restored step, or None when no
+    complete checkpoint exists (caller starts from scratch).
+
+    This is the resume half of the elastic recovery loop: after the
+    launch controller re-forms the pod (dead heartbeat -> membership
+    change -> fresh rendezvous), each worker rebuilds its model/optimizer
+    state and calls ``resume_from_latest`` so the next train step
+    continues with bitwise-identical values."""
+    found = latest_checkpoint(root)
+    if found is None:
+        return None
+    step, path = found
+    load_state_dict(state_dict, path)
+    return step

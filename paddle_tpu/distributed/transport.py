@@ -23,6 +23,20 @@ Topology per collective (eager path = small tensors, correctness first):
   - all_to_all: pairwise exchange, deterministic peer order.
   - barrier: generation-counted store barrier.
 
+Fault tolerance (resilience/): every data frame carries a CRC32 and a
+per-peer frame sequence number and is ACKed by the receiver. The sender
+retransmits on NAK (CRC mismatch), ack timeout, or connection loss —
+redialing with exponential backoff — and the receiver dedups retried
+frames by (src, fseq), so retransmits are idempotent. Failures surface
+as the structured errors in resilience/errors.py, never a silent hang:
+recv deadlines raise TransportTimeoutError naming the missing tag, a
+corrupted frame that survives the retransmit budget raises
+FrameCorruptError, an unreachable peer raises PeerUnreachableError.
+The resilience/faults.py chaos injector hooks the send/dial/recv sites
+(armed via PT_FAULT_PLAN) so all of this is exercised by tier-1 tests
+on the CPU mesh. Retry traffic is counted in the metrics registry
+(comm/retries, comm/redials, comm/corrupt_frames, comm/dup_frames).
+
 The hub/star topologies above are rank-asymmetric BY DESIGN: this module
 is the transport that *implements* eager collectives, not SPMD-traced
 user code, and every branch's send is matched by the peer's recv at the
@@ -38,14 +52,30 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+import zlib
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ..profiler import metrics as _metrics
+from .resilience import faults as _faults
+from .resilience.errors import (FrameCorruptError, PeerUnreachableError,
+                                TransportClosedError, TransportError,
+                                TransportTimeoutError)
 from .store import TCPStore, _recv_exact
 
 __all__ = ["TensorTransport", "init_transport", "get_transport",
            "shutdown_transport"]
+
+# retry/backoff knobs (env-overridable; see README "Fault tolerance")
+_MAX_RETRIES = int(os.environ.get("PT_TRANSPORT_MAX_RETRIES", "5"))
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 2.0
+
+_m_retries = _metrics.counter("comm/retries")
+_m_redials = _metrics.counter("comm/redials")
+_m_corrupt = _metrics.counter("comm/corrupt_frames")
+_m_dup = _metrics.counter("comm/dup_frames")
 
 
 def _dtype_to_name(dt) -> str:
@@ -66,6 +96,10 @@ def _to_numpy(arr) -> np.ndarray:
     return np.ascontiguousarray(out)
 
 
+def _backoff(attempt: int) -> float:
+    return min(_BACKOFF_BASE_S * (2 ** attempt), _BACKOFF_CAP_S)
+
+
 def _send_frame(sock, header: dict, payload: bytes):
     h = json.dumps(header).encode()
     sock.sendall(struct.pack("!II", len(h), len(payload)) + h + payload)
@@ -79,25 +113,43 @@ def _recv_frame(sock) -> Tuple[dict, bytes]:
 
 
 class _Mailbox:
-    """Tag-addressed inbox the receiver thread fills and recv() drains."""
+    """Tag-addressed inbox the receiver thread fills and recv() drains.
+
+    ``abort()`` poisons the mailbox with a structured error — every
+    blocked and future ``take()`` raises it. The watchdog escalation
+    path uses this so a stalled collective raises on the waiting rank
+    instead of hanging it until the transport deadline."""
 
     def __init__(self):
         self._cond = threading.Condition()
         self._msgs: Dict[str, List[np.ndarray]] = {}
+        self._abort_exc: Optional[BaseException] = None
 
     def put(self, tag: str, arr: np.ndarray):
         with self._cond:
             self._msgs.setdefault(tag, []).append(arr)
             self._cond.notify_all()
 
+    def abort(self, exc: BaseException):
+        with self._cond:
+            self._abort_exc = exc
+            self._cond.notify_all()
+
+    def pending_tags(self) -> List[str]:
+        with self._cond:
+            return sorted(self._msgs)
+
     def take(self, tag: str, timeout: float) -> np.ndarray:
         deadline = time.time() + timeout
         with self._cond:
             while not self._msgs.get(tag):
+                if self._abort_exc is not None:
+                    raise self._abort_exc
                 remaining = deadline - time.time()
                 if remaining <= 0:
-                    raise TimeoutError(
-                        f"transport recv timed out waiting for {tag!r}")
+                    raise TransportTimeoutError(
+                        tag, pending=sorted(self._msgs),
+                        timeout_s=timeout)
                 self._cond.wait(min(remaining, 1.0))
             arr = self._msgs[tag].pop(0)
             if not self._msgs[tag]:
@@ -107,20 +159,36 @@ class _Mailbox:
 
 class TensorTransport:
     """One per process. Listens on an advertised address, lazily dials
-    peers, frames tensors as JSON header + raw bytes."""
+    peers, frames tensors as JSON header + raw bytes, and retransmits
+    until the peer acknowledges (see module docstring)."""
 
     def __init__(self, rank: int, world_size: int, store: TCPStore,
-                 bind_host: Optional[str] = None, timeout: float = 300.0):
+                 bind_host: Optional[str] = None, timeout: float = 300.0,
+                 max_retries: Optional[int] = None,
+                 ack_timeout: Optional[float] = None):
         self.rank = rank
         self.world_size = world_size
         self.timeout = timeout
+        self.max_retries = _MAX_RETRIES if max_retries is None \
+            else int(max_retries)
+        if ack_timeout is None:
+            env_a = os.environ.get("PT_ACK_TIMEOUT", "").strip()
+            ack_timeout = float(env_a) if env_a else min(timeout, 20.0)
+        self.ack_timeout = ack_timeout
         self._store = store
         self._mailbox = _Mailbox()
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
         self._seq: Dict[str, int] = {}
         self._seq_lock = threading.Lock()
+        # receiver-side dedup: fseqs already delivered, per source rank
+        self._seen_fseq: Dict[int, Set[int]] = {}
+        self._seen_lock = threading.Lock()
+        self._conns: List[socket.socket] = []
+        self._recv_threads: List[threading.Thread] = []
         self._closed = False
+        self._abort_exc: Optional[BaseException] = None
+        _faults.maybe_arm_from_env()
 
         # Bind to the advertised interface, not 0.0.0.0 (ADVICE.md).
         host = bind_host or os.environ.get("POD_IP") \
@@ -147,23 +215,83 @@ class TensorTransport:
                 conn, _ = self._server.accept()
             except OSError:
                 break
+            if self._closed:            # close()'s wake-up connect
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                break
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._recv_loop, args=(conn,),
-                             daemon=True).start()
+            self._conns.append(conn)
+            t = threading.Thread(target=self._recv_loop, args=(conn,),
+                                 daemon=True)
+            self._recv_threads.append(t)
+            t.start()
 
     def _recv_loop(self, conn):
         try:
             while True:
                 header, payload = _recv_frame(conn)
-                arr = np.frombuffer(
-                    payload, dtype=_name_to_dtype(header["dtype"])
-                ).reshape(header["shape"]).copy()
-                self._mailbox.put(header["tag"], arr)
-        except (ConnectionError, OSError, struct.error):
-            pass
+                if header.get("kind", "data") != "data":
+                    continue            # stray control frame
+                self._handle_data_frame(conn, header, payload)
+        except (ConnectionError, OSError, struct.error,
+                json.JSONDecodeError):
+            # peer hung up / redialed / sent a torn frame — the sender
+            # side owns retries, this conn is done
+            try:
+                conn.close()
+            except OSError:
+                _metrics.inc("comm/recv_loop_close_errors")
+
+    def _handle_data_frame(self, conn, header: dict, payload: bytes):
+        src = header.get("src")
+        fseq = header.get("fseq")
+        crc = header.get("crc")
+        act = _faults.injector.on_event("recv", self.rank, src)
+        if act is not None:
+            if act.kind == "delay":
+                time.sleep(act.delay_ms / 1e3)
+            elif act.kind == "kill":
+                os._exit(act.exit_code)
+            elif act.kind == "drop":
+                raise ConnectionError("fault injection: recv drop")
+            elif act.kind == "corrupt" and payload:
+                payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+        if crc is not None and zlib.crc32(payload) != crc:
+            _m_corrupt.inc()
+            _send_frame(conn, {"kind": "nak", "fseq": fseq}, b"")
+            return
+        dup = False
+        if src is not None and fseq is not None:
+            with self._seen_lock:
+                seen = self._seen_fseq.setdefault(int(src), set())
+                if fseq in seen:
+                    dup = True
+                else:
+                    seen.add(fseq)
+        if dup:
+            _m_dup.inc()
+        else:
+            arr = np.frombuffer(
+                payload, dtype=_name_to_dtype(header["dtype"])
+            ).reshape(header["shape"]).copy()
+            self._mailbox.put(header["tag"], arr)
+        # ACK even duplicates: the ack for the first copy may be the
+        # thing that was lost
+        if fseq is not None:
+            _send_frame(conn, {"kind": "ack", "fseq": fseq}, b"")
 
     def _peer_key(self, rank: int) -> str:
         return f"__transport__/{getattr(self, '_job', 'default')}/{rank}"
+
+    def _drop_peer(self, dst: int):
+        sock = self._peers.pop(dst, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                _metrics.inc("comm/peer_close_errors")
 
     def _dial(self, dst: int) -> socket.socket:
         sock = self._peers.get(dst)
@@ -172,24 +300,36 @@ class TensorTransport:
         deadline = time.time() + self.timeout
         last = None
         addr = None
+        attempt = 0
         while time.time() < deadline:
             # re-read each attempt: an elastically-restarted peer
             # re-registers under a new address
             addr = self._store.get(self._peer_key(dst)).decode()
             host, port = addr.rsplit(":", 1)
             try:
+                act = _faults.injector.on_event("dial", self.rank, dst)
+                if act is not None:
+                    if act.kind == "delay":
+                        time.sleep(act.delay_ms / 1e3)
+                    elif act.kind == "kill":
+                        os._exit(act.exit_code)
+                    elif act.kind == "drop":
+                        raise OSError("fault injection: dial drop")
                 sock = socket.create_connection((host, int(port)),
                                                 timeout=self.timeout)
                 break
             except OSError as e:
                 last = e
-                time.sleep(0.1)
+                attempt += 1
+                # exponential backoff: a dead peer being relaunched by
+                # the elastic controller needs seconds, not a 10 Hz
+                # hammer on its old address
+                time.sleep(_backoff(attempt))
         else:
-            raise ConnectionError(f"cannot reach rank {dst} at {addr}: "
-                                  f"{last}")
+            raise PeerUnreachableError(dst, addr, attempt, last)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._peers[dst] = sock
-        self._peer_locks[dst] = threading.Lock()
+        self._peer_locks.setdefault(dst, threading.Lock())
         return sock
 
     def _next_seq(self, key: str) -> int:
@@ -198,16 +338,107 @@ class TensorTransport:
             self._seq[key] = n + 1
             return n
 
+    def _check_usable(self):
+        if self._closed:
+            raise TransportClosedError(
+                f"transport on rank {self.rank} is closed")
+        if self._abort_exc is not None:
+            raise self._abort_exc
+
+    def abort(self, exc: BaseException):
+        """Poison the transport with a structured error: every blocked
+        recv wakes and raises `exc`, and future send/recv raise it too.
+        The watchdog escalation path calls this when a collective stalls
+        past its timeout, so no rank is left hanging."""
+        self._abort_exc = exc
+        self._mailbox.abort(exc)
+
+    # -- reliable framing --------------------------------------------------
+    def _send_with_ack(self, dst: int, header: dict, payload: bytes):
+        """Transmit one data frame and block until the peer ACKs it.
+
+        Retries (up to max_retries) on: connection error (redial with
+        exponential backoff), ack timeout (peer slow or frame lost), or
+        NAK (CRC mismatch at the receiver). The frame's fseq makes
+        retransmits idempotent — the receiver dedups and re-ACKs."""
+        fseq = self._next_seq(f"frame:{dst}")
+        header = dict(header, src=self.rank, fseq=fseq,
+                      crc=zlib.crc32(payload))
+        naks = 0
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            self._check_usable()
+            if attempt > 0:
+                _m_retries.inc()
+            wire = payload
+            dup = False
+            try:
+                act = _faults.injector.on_event("send", self.rank, dst)
+                if act is not None:
+                    if act.kind == "delay":
+                        time.sleep(act.delay_ms / 1e3)
+                    elif act.kind == "kill":
+                        os._exit(act.exit_code)
+                    elif act.kind == "drop":
+                        # a dropped connection: the socket dies under the
+                        # sender, surfacing as a send failure -> redial
+                        self._drop_peer(dst)
+                        raise ConnectionError(
+                            "fault injection: connection dropped")
+                    elif act.kind == "corrupt" and payload:
+                        wire = bytes([payload[0] ^ 0xFF]) + payload[1:]
+                    elif act.kind == "dup":
+                        dup = True
+                sock = self._dial(dst)
+                with self._peer_locks[dst]:
+                    sock.settimeout(self.ack_timeout)
+                    try:
+                        _send_frame(sock, header, wire)
+                        if dup:
+                            _send_frame(sock, header, wire)
+                        resp = self._await_ack(sock, fseq)
+                    finally:
+                        sock.settimeout(None)
+            except PeerUnreachableError:
+                raise
+            except (ConnectionError, OSError, struct.error,
+                    json.JSONDecodeError) as e:
+                last_exc = e
+                self._drop_peer(dst)
+                _m_redials.inc()
+                time.sleep(_backoff(attempt))
+                continue
+            if resp.get("kind") == "ack":
+                return
+            naks += 1          # CRC mismatch at receiver: retransmit
+        if naks:
+            raise FrameCorruptError(dst, fseq, self.max_retries + 1)
+        raise TransportError(
+            f"send to rank {dst} failed after "
+            f"{self.max_retries + 1} attempts: {last_exc!r}")
+
+    def _await_ack(self, sock, fseq: int) -> dict:
+        """Read ack/nak for `fseq`, discarding stale acks of earlier
+        frames (a duplicated transmit produces two acks; the second
+        shows up in front of the NEXT frame's ack)."""
+        while True:
+            resp, _ = _recv_frame(sock)
+            if resp.get("kind") not in ("ack", "nak"):
+                continue
+            if resp.get("fseq") is not None and resp["fseq"] < fseq:
+                continue
+            return resp
+
     # -- p2p ---------------------------------------------------------------
     def send(self, arr, dst: int, channel: str = "p2p"):
+        self._check_usable()
         arr = _to_numpy(arr)
         seq = self._next_seq(f"tx:{channel}:{dst}")
         tag = f"{channel}:{self.rank}->{dst}:{seq}"
-        sock = self._dial(dst)
-        with self._peer_locks[dst]:
-            _send_frame(sock, {"tag": tag,
-                               "dtype": _dtype_to_name(arr.dtype),
-                               "shape": list(arr.shape)}, arr.tobytes())
+        self._send_with_ack(dst, {"tag": tag,
+                                  "dtype": _dtype_to_name(arr.dtype),
+                                  "shape": list(arr.shape)},
+                            arr.tobytes())
 
     def recv(self, src: int, channel: str = "p2p") -> np.ndarray:
         return self._mailbox.take(self.reserve_recv(src, channel),
@@ -349,17 +580,47 @@ class TensorTransport:
                             timeout=self.timeout)
 
     def close(self):
+        """Tear down reliably: wake every blocked recv with a structured
+        error, unblock and join the accept thread, close all accepted
+        connections so their recv threads exit, then close peers."""
+        if self._closed:
+            return
         self._closed = True
+        self._mailbox.abort(TransportClosedError(
+            f"transport on rank {self.rank} closed"))
+        # a blocked accept() does not reliably wake on close alone:
+        # shutdown the listening socket, then poke it with a loopback
+        # connect in case the platform ignored the shutdown
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._server.close()
         except OSError:
+            _metrics.inc("comm/close_errors")
+        try:
+            host, port = self.address.rsplit(":", 1)
+            socket.create_connection((host, int(port)),
+                                     timeout=0.5).close()
+        except OSError:
             pass
+        self._accept_thread.join(timeout=2.0)
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                _metrics.inc("comm/close_errors")
+        for t in self._recv_threads:
+            t.join(timeout=1.0)
         for s in self._peers.values():
             try:
                 s.close()
             except OSError:
-                pass
+                _metrics.inc("comm/close_errors")
         self._peers.clear()
+        self._conns.clear()
+        self._recv_threads.clear()
 
 
 _transport: Optional[TensorTransport] = None

@@ -23,6 +23,15 @@ desync" report works.
 Enable with ``enable_comm_watchdog(timeout_s)`` or env
 ``FLAGS_comm_watchdog_timeout`` (seconds; 0 disables — the default, as in
 the reference where FLAGS_enable_async_trace defaults off).
+
+Escalation (resilience): a task stalled past the timeout no longer just
+dumps — the watchdog marks the group unhealthy in the rendezvous store
+(``__unhealthy__/<gid>`` with the dump payload, visible to every member
+and to the launch controller) and aborts the local transport with a
+structured ``CommTimeoutError``, so the blocked rank RAISES instead of
+hanging while its peers spin. Disable with
+``FLAGS_comm_watchdog_escalate=0`` (dump-only, the pre-escalation
+behavior).
 """
 from __future__ import annotations
 
@@ -33,10 +42,15 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..profiler import metrics as _metrics
+from .resilience.errors import CommTimeoutError
+
 __all__ = [
     "CommTask", "CommTaskManager", "enable_comm_watchdog",
     "disable_comm_watchdog", "comm_task_manager",
 ]
+
+_m_escalations = _metrics.counter("comm/watchdog_escalations")
 
 
 class CommTask:
@@ -87,7 +101,11 @@ class CommTask:
             try:
                 if arr.is_ready():
                     self.done = True
-            except Exception:
+            except Exception:  # ptlint: disable=PT502
+                # by-design best-effort probe on the 1 Hz poll path: a
+                # deleted/donated buffer raises here, which just means
+                # "not observably ready yet" — the task stays pending
+                # and the timeout still fires
                 pass
         return self.done
 
@@ -135,6 +153,10 @@ class CommTaskManager:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.dump_path = os.environ.get("FLAGS_comm_watchdog_dump_path", "")
+        # escalate stalled tasks into structured errors on every member
+        # (dump-only with FLAGS_comm_watchdog_escalate=0)
+        self.escalate = os.environ.get(
+            "FLAGS_comm_watchdog_escalate", "1") != "0"
 
     # -- configuration ----------------------------------------------------
     @property
@@ -232,6 +254,32 @@ class CommTaskManager:
                         now_stalled.append(t)
             for t in now_stalled:
                 self._dump(t)
+                if self.escalate:
+                    self._escalate(t)
+
+    def _escalate(self, task: CommTask):
+        """Stalled past timeout: mark the group unhealthy in the store
+        (every member and the launch controller can see it) and abort
+        the local transport so the blocked rank raises a structured
+        CommTimeoutError instead of hanging."""
+        _m_escalations.inc()
+        err = CommTimeoutError(task.op_name, task.group_id, task.seq,
+                               task.rank, self._timeout_s)
+        try:
+            from .transport import get_transport
+
+            tp = get_transport()
+            if tp is not None:
+                try:
+                    tp._store.set(f"__unhealthy__/{task.group_id}",
+                                  json.dumps(task.to_dict()))
+                except Exception:
+                    # the store may be down WITH the dead peer — the
+                    # local abort below still unblocks this rank
+                    _metrics.inc("comm/escalation_store_errors")
+                tp.abort(err)
+        except Exception:
+            _metrics.inc("comm/escalation_errors")
 
     def _dump(self, task: CommTask):
         report = {

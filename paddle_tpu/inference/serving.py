@@ -54,10 +54,19 @@ _m_requests = _metrics.counter("serving/requests")
 _m_preempt = _metrics.counter("serving/preemptions")
 _m_occupancy = _metrics.gauge("serving/batch_occupancy")
 _m_kv_util = _metrics.gauge("serving/kv_cache_utilization")
+_m_deadline = _metrics.counter("serving/deadline_evictions")
+_m_shed = _metrics.counter("serving/load_shed")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
-           "sample_logits"]
+           "sample_logits", "EngineOverloadedError"]
+
+
+class EngineOverloadedError(RuntimeError):
+    """Admission rejected: the engine is saturated (queue at max_queue).
+    The serving front-end should shed this request (HTTP 429 / retry on
+    another replica) rather than let it age out against its deadline
+    deep in an unbounded queue."""
 
 
 class PagedServingConfig:
@@ -79,7 +88,8 @@ class PagedServingConfig:
     def __init__(self, vocab_size=256, hidden_size=64, num_layers=2,
                  num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
-                 num_kv_heads=None, dtype="float32", cache_quant=None):
+                 num_kv_heads=None, dtype="float32", cache_quant=None,
+                 max_queue=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -98,6 +108,9 @@ class PagedServingConfig:
         if cache_quant not in (None, "int8"):
             raise ValueError("cache_quant must be None or 'int8'")
         self.cache_quant = cache_quant
+        # load shedding: admission is rejected (EngineOverloadedError)
+        # once this many requests are live; None = admit everything
+        self.max_queue = max_queue
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -409,9 +422,10 @@ class PagedCausalLM(Layer):
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "max_new", "pages",
                  "cached", "done", "sampling", "eos_token_id",
-                 "submit_t", "first_tok_t")
+                 "submit_t", "first_tok_t", "deadline_t", "timed_out")
 
-    def __init__(self, rid, prompt, max_new, sampling, eos_token_id):
+    def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
+                 deadline_s=None):
         self.rid = rid
         self.prompt = list(int(t) for t in prompt)
         self.generated = []
@@ -423,6 +437,9 @@ class _Request:
         self.eos_token_id = eos_token_id
         self.submit_t = time.perf_counter()
         self.first_tok_t = None
+        self.deadline_t = None if deadline_s is None \
+            else self.submit_t + float(deadline_s)
+        self.timed_out = False
 
     @property
     def length(self):
@@ -540,19 +557,50 @@ class ServingEngine:
 
     # -- scheduling ------------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens=8, sampling=None,
-                    eos_token_id=None):
+                    eos_token_id=None, deadline_s=None):
+        """Admit one request. `deadline_s` (seconds from submit) bounds
+        its total latency: a request still unfinished past its deadline
+        is evicted at the next step (pages released, `timed_out` set)
+        so a stuck/starved request cannot pin pool pages forever.
+        Raises EngineOverloadedError when cfg.max_queue live requests
+        already exist (load shedding at admission, not deep in the
+        queue)."""
         if len(prompt_tokens) == 0:
             raise ValueError("prompt must contain at least one token "
                              "(an empty row would read another request's "
                              "logits)")
         if len(prompt_tokens) + max_new_tokens > self.cfg.max_seq:
             raise ValueError("prompt + max_new_tokens exceeds max_seq")
+        if self.cfg.max_queue is not None \
+                and len(self.pending()) >= self.cfg.max_queue:
+            _m_shed.inc()
+            raise EngineOverloadedError(
+                f"engine saturated: {len(self.pending())} live requests "
+                f">= max_queue={self.cfg.max_queue}; shed this request "
+                f"(retry later or on another replica)")
         rid = self._next_rid
         self._next_rid += 1
         self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens,
-                                       sampling, eos_token_id)
+                                       sampling, eos_token_id,
+                                       deadline_s=deadline_s)
         _m_requests.inc()
         return rid
+
+    def _evict_expired(self):
+        """Deadline sweep, run before scheduling: requests past their
+        per-request deadline finish NOW as timed out — their pages go
+        back to the pool instead of starving live traffic."""
+        now = time.perf_counter()
+        for r in self.pending():
+            if r.deadline_t is not None and now > r.deadline_t:
+                r.timed_out = True
+                r.done = True
+                self._release(r)
+                _m_deadline.inc()
+
+    def timed_out_requests(self):
+        """rids evicted by the deadline sweep (serving front-end: 504)."""
+        return [r.rid for r in self._requests.values() if r.timed_out]
 
     def _note_first_token(self, req, now):
         if req.first_tok_t is None:
@@ -623,6 +671,7 @@ class ServingEngine:
     def _step(self):
         cfg = self.cfg
 
+        self._evict_expired()
         rows = self._schedule()
         while not rows and self.pending():
             # pool deadlock: in-flight requests hold pages but none can
@@ -794,6 +843,7 @@ class ServingEngine:
     def _decode_run(self, n_steps):
         cfg = self.cfg
         t_start = time.perf_counter()
+        self._evict_expired()
         rows = [r for r in self.pending()
                 if r.length - r.cached == 1][:cfg.max_batch]
         if not rows:

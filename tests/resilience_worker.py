@@ -1,0 +1,99 @@
+"""Chaos-harness worker, spawned 2x by test_resilience.py.
+
+Modes (env RESILIENCE_MODE):
+
+- ``faults``: run four 2-rank eager all_reduces through the TCP
+  transport while PT_FAULT_PLAN injects a connection drop, a corrupted
+  frame, a duplicated frame, and a delayed frame into rank 0's sends.
+  Each rank dumps its collective results + reliability metric counters
+  to OUT_DIR/rank{r}.npz — the parent asserts every collective still
+  produced the correct value and that the retry/corrupt/dup counters
+  recorded the recovery work.
+
+- ``kill``: rank 1 is killed by the injector mid-collective (its 2nd
+  data-frame send); rank 0 runs with the comm watchdog enabled and must
+  surface a structured CommTimeoutError within the watchdog timeout
+  (escalation path), writing a marker json the parent checks.
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _base(rank):
+    return np.arange(8, dtype=np.float32) + 10 * (rank + 1)
+
+
+def _counter(snap, name):
+    return int(snap["counters"].get(name, 0))
+
+
+def run_faults(out_dir, rank):
+    from paddle_tpu.distributed.transport import init_transport
+    from paddle_tpu.profiler import metrics
+
+    tp = init_transport()
+    assert tp is not None
+    results = {}
+    for i, tag in enumerate(["drop", "corrupt", "dup", "delay"]):
+        results[f"ar_{tag}"] = tp.all_reduce(_base(rank) + i, "sum",
+                                             [0, 1], 0)
+    # both ranks quiesce before either tears down its sockets
+    tp.barrier("faults_done", [0, 1])
+    snap = metrics.snapshot()
+    counters = {name: _counter(snap, name) for name in
+                ("comm/retries", "comm/redials", "comm/corrupt_frames",
+                 "comm/dup_frames", "faults/injected")}
+    np.savez(os.path.join(out_dir, f"rank{rank}.npz"),
+             metrics=json.dumps(counters), **results)
+
+
+def run_kill(out_dir, rank):
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.resilience.errors import CommTimeoutError
+    from paddle_tpu.distributed.watchdog import enable_comm_watchdog
+
+    timeout_s = float(os.environ.get("WATCHDOG_TIMEOUT", "4"))
+    dist.init_parallel_env()
+    enable_comm_watchdog(timeout_s)
+    t = paddle.to_tensor(_base(rank))
+    dist.all_reduce(t)          # warm path; rank 1's send #1
+    np.testing.assert_allclose(np.asarray(t.numpy()),
+                               _base(0) + _base(1))
+    t2 = paddle.to_tensor(_base(rank) + 1)
+    t0 = time.time()
+    marker = {"rank": rank, "error": None, "elapsed": None}
+    try:
+        dist.all_reduce(t2)     # rank 1 dies on its send #2
+        marker["error"] = "none"
+    except CommTimeoutError as e:
+        marker["error"] = "CommTimeoutError"
+        marker["elapsed"] = time.time() - t0
+        marker["msg"] = str(e)
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(marker, f)
+
+
+def main():
+    mode = os.environ["RESILIENCE_MODE"]
+    out_dir = os.environ["RESILIENCE_OUT_DIR"]
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if mode == "faults":
+        run_faults(out_dir, rank)
+    elif mode == "kill":
+        run_kill(out_dir, rank)
+    else:
+        raise SystemExit(f"unknown RESILIENCE_MODE {mode!r}")
+
+
+if __name__ == "__main__":
+    main()

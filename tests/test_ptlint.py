@@ -487,6 +487,97 @@ def test_pt403_known_and_pattern_metrics_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PT5xx — error surfacing in distributed/
+# ---------------------------------------------------------------------------
+
+def _lint_distributed(tmp_path, src):
+    """PT5xx is scoped to files under a distributed/ directory."""
+    d = tmp_path / "distributed"
+    d.mkdir(exist_ok=True)
+    p = d / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return engine.run([str(p)])
+
+
+SWALLOWED = """
+    def beat(store):
+        try:
+            store.set("hb", "1")
+        except Exception:
+            pass
+"""
+
+
+def test_pt501_bare_except_flagged(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        def loop(store):
+            try:
+                store.set("hb", "1")
+            except:
+                pass
+    """)
+    assert "PT501" in ids(rep)
+
+
+def test_pt502_swallowed_exception_flagged(tmp_path):
+    rep = _lint_distributed(tmp_path, SWALLOWED)
+    assert "PT502" in ids(rep)
+
+
+def test_pt502_continue_body_flagged(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        def scan(items):
+            for it in items:
+                try:
+                    it.load()
+                except Exception:
+                    continue
+    """)
+    assert "PT502" in ids(rep)
+
+
+def test_pt502_counted_error_is_clean(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        from paddle_tpu.profiler import metrics as _metrics
+
+        def beat(store):
+            try:
+                store.set("hb", "1")
+            except Exception:
+                _metrics.inc("elastic/heartbeat_errors")
+    """)
+    assert "PT502" not in ids(rep)
+
+
+def test_pt502_fallback_value_is_clean(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        def probe(store):
+            try:
+                return float(store.get("hb"))
+            except Exception:
+                return None
+    """)
+    assert "PT502" not in ids(rep)
+
+
+def test_pt502_narrow_except_is_clean(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        def close(sock):
+            try:
+                sock.close()
+            except OSError:
+                pass
+    """)
+    assert "PT502" not in ids(rep)
+
+
+def test_pt5xx_out_of_scope_path_is_clean(tmp_path):
+    # same bad code OUTSIDE a distributed/ directory: not our contract
+    rep = lint(tmp_path, SWALLOWED)
+    assert not [i for i in ids(rep) if i.startswith("PT5")]
+
+
+# ---------------------------------------------------------------------------
 # engine mechanics: suppression, baseline, reporters, select
 # ---------------------------------------------------------------------------
 
@@ -570,7 +661,7 @@ def test_json_reporter_roundtrips(tmp_path):
 def test_all_rule_families_registered():
     rules = engine.all_rules()
     fams = {rid[:3] for rid in rules}
-    assert {"PT1", "PT2", "PT3", "PT4"} <= fams
+    assert {"PT1", "PT2", "PT3", "PT4", "PT5"} <= fams
     for r in rules.values():
         assert r.severity in ("error", "warning")
         assert r.scope in ("file", "project")

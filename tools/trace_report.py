@@ -42,11 +42,24 @@ KNOWN_METRICS = (
     # collectives (distributed/collective.py)
     "comm/collective_count", "comm/collective_bytes", "comm/latency_ms",
     "comm/*_count", "comm/*_bytes",
+    # transport reliability + watchdog escalation
+    # (distributed/transport.py, distributed/watchdog.py)
+    "comm/retries", "comm/redials", "comm/corrupt_frames",
+    "comm/dup_frames", "comm/watchdog_escalations",
+    "comm/escalation_errors", "comm/escalation_store_errors",
+    "comm/close_errors", "comm/peer_close_errors",
+    "comm/recv_loop_close_errors",
+    # elastic manager (distributed/elastic.py)
+    "elastic/heartbeat_errors", "elastic/last_beat_ts",
+    "elastic/membership_changes",
+    # chaos injector (distributed/resilience/faults.py)
+    "faults/injected", "faults/*",
     # serving engine (inference/serving.py)
     "serving/ttft_ms", "serving/tpot_ms", "serving/steps",
     "serving/tokens_generated", "serving/requests",
     "serving/preemptions", "serving/batch_occupancy",
-    "serving/kv_cache_utilization",
+    "serving/kv_cache_utilization", "serving/deadline_evictions",
+    "serving/load_shed",
 )
 
 
