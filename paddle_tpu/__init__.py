@@ -10,6 +10,19 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# Sharding-invariant RNG, set before any key is ever used: with the
+# legacy (non-partitionable) threefry lowering, jit-compiling a random
+# draw with sharded out_shardings produces DIFFERENT bits per mesh
+# factorization — parameter init then silently depends on the parallel
+# config, which is how the dp-only / ZeRO-3 / ring-sep first-step
+# losses of the same seed diverged (the long-standing GSPMD parity
+# failures in tests/test_distributed.py).  The partitionable lowering
+# generates identical bits under every sharding, the property a
+# GSPMD-first framework must guarantee.
+import jax as _jax
+
+_jax.config.update("jax_threefry_partitionable", True)
+
 # core first (reference: `from .base import core` must precede all else)
 from .core.tensor import Tensor, Parameter
 from .core import autograd as _autograd_mod

@@ -1,7 +1,11 @@
-"""ptlint — framework-aware static analysis for paddle_tpu.
+"""ptlint + ptprog — framework-aware static analysis for paddle_tpu.
 
-Four rule families, each targeting a failure class that runtime testing
-on the CPU mesh structurally cannot catch:
+Two surfaces share this package, its reporters (text/json/sarif) and
+the committed-baseline workflow:
+
+**ptlint** (source level, jax-free): five AST rule families, each
+targeting a failure class that runtime testing on the CPU mesh
+structurally cannot catch:
 
 - **PT1xx trace-safety** — Python that silently mis-traces or breaks
   ``@to_static`` capture (jit/api.py can only count the breakage at
@@ -16,24 +20,38 @@ on the CPU mesh structurally cannot catch:
 - **PT4xx registry consistency** — duplicate ``register()`` names,
   entries the dispatcher funnel can't call, and metric names missing
   from ``tools/trace_report.py``'s ``KNOWN_METRICS``.
+- **PT5xx error surfacing** — swallowed exceptions in distributed/.
+
+**ptprog** (IR level, ``paddle_tpu.analysis.program``): the PT6xx
+passes over a *recorded* ``static.Program`` op list — shape/dtype
+dataflow via ``jax.eval_shape`` (the infermeta analog), liveness-based
+peak-memory estimation with a device-budget check, collective/sharding
+consistency against the mesh (including dynamically-built groups the
+AST cannot see), and the pass-equivalence verifier behind
+``PassManager.run(program, verify=True)``.
 
 Usage::
 
     python -m paddle_tpu.analysis paddle_tpu/          # or tools/ptlint.py
-    python -m paddle_tpu.analysis paddle_tpu/ --format json
+    python -m paddle_tpu.analysis paddle_tpu/ --format sarif
     python -m paddle_tpu.analysis paddle_tpu/ --write-baseline
+    python -m paddle_tpu.analysis paddle_tpu/ --update-baseline
+    python -m paddle_tpu.analysis --program llama      # or tools/ptprog.py
+    python -m paddle_tpu.analysis --program llama --budget-gb 16
 
-Suppress a finding in place with ``# ptlint: disable=PT105`` (family
-form ``PT1xx`` and ``all`` also work).  Grandfathered findings live in
-the committed ``.ptlint-baseline.json``; regenerate it with
+Suppress a source finding in place with ``# ptlint: disable=PT105``
+(family form ``PT1xx`` and ``all`` also work).  Grandfathered findings
+live in the committed ``.ptlint-baseline.json``; regenerate it with
 ``--write-baseline`` after an intentional change, and shrink it over
-time — baselined findings never fail CI but still show in reports.
+time with ``--update-baseline``, which prunes entries whose findings
+no longer fire — baselined findings never fail CI but still show in
+reports.
 """
-from .engine import (BASELINE_NAME, Finding, Report, all_rules,
-                     load_baseline, render_json, render_text, run,
-                     write_baseline)
+from .engine import (BASELINE_NAME, PTPROG_RULES, Finding, Report,
+                     all_rules, load_baseline, render_json, render_sarif,
+                     render_text, run, write_baseline)
 from .main import main
 
-__all__ = ["BASELINE_NAME", "Finding", "Report", "all_rules",
-           "load_baseline", "main", "render_json", "render_text", "run",
-           "write_baseline"]
+__all__ = ["BASELINE_NAME", "PTPROG_RULES", "Finding", "Report",
+           "all_rules", "load_baseline", "main", "render_json",
+           "render_sarif", "render_text", "run", "write_baseline"]

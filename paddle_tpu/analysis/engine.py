@@ -35,7 +35,8 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Finding", "Rule", "rule", "all_rules", "ModuleInfo",
            "Project", "run", "load_baseline", "write_baseline",
-           "render_text", "render_json", "BASELINE_NAME"]
+           "render_text", "render_json", "render_sarif",
+           "PTPROG_RULES", "BASELINE_NAME"]
 
 BASELINE_NAME = ".ptlint-baseline.json"
 
@@ -343,15 +344,16 @@ def _git_root(path: str) -> Optional[str]:
 # reporters
 # ---------------------------------------------------------------------------
 
-def render_text(report: Report) -> str:
+def render_text(report: Report, tool_name: str = "ptlint") -> str:
     lines = []
     for f in report.findings:
         lines.append(f"{f.path}:{f.line}:{f.col}: {f.rule_id} "
                      f"[{f.severity}] {f.message}")
     for e in report.parse_errors:
         lines.append(f"parse error: {e}")
+    noun = "program(s)" if tool_name == "ptprog" else "file(s)"
     lines.append(
-        f"ptlint: {report.files} file(s), "
+        f"{tool_name}: {report.files} {noun}, "
         f"{len(report.findings)} finding(s), "
         f"{len(report.baselined)} baselined, "
         f"{report.suppressed} suppressed")
@@ -366,6 +368,85 @@ def render_json(report: Report) -> str:
         "suppressed": report.suppressed,
         "parse_errors": report.parse_errors,
     }, indent=1)
+
+
+# PT6xx: the IR-level ptprog families (paddle_tpu/analysis/program/).
+# Kept here — the one jax-free module both CLIs always load — so
+# `--list-rules` can show the full inventory without importing the
+# analyzer (which needs jax for abstract evaluation).
+PTPROG_RULES = (
+    ("PT601", "error", "op entry failed abstract (eval_shape) evaluation"),
+    ("PT602", "warning", "op mixes floating dtypes across tensor inputs "
+                         "(AMP cast error class)"),
+    ("PT603", "error", "cast op output dtype contradicts its tag"),
+    ("PT604", "warning", "op output is never consumed or fetched "
+                         "(dead op)"),
+    ("PT610", "error", "predicted peak memory exceeds the device budget"),
+    ("PT620", "error", "collective group axis absent from the mesh"),
+    ("PT621", "error", "collective group size/ranks inconsistent with "
+                       "the mesh"),
+    ("PT622", "error", "p2p peer outside the collective group"),
+    ("PT623", "error", "unmatched send/recv pair across pipeline stages"),
+    ("PT630", "error", "pass changed a fetchable shape/dtype"),
+    ("PT631", "error", "pass made a fetch target unproducible"),
+)
+
+
+def render_sarif(report: Report, tool_name: str = "ptlint") -> str:
+    """SARIF 2.1.0 — the format CI services ingest for inline PR
+    annotations.  Active findings become `results`; baselined findings
+    are included but marked `suppressions` (external), so the feed
+    shows grandfathered debt without failing the annotation gate."""
+    _load_rule_modules()
+    rule_meta = {rid: {"id": rid,
+                       "shortDescription": {"text": r.summary},
+                       "defaultConfiguration": {
+                           "level": "error" if r.severity == "error"
+                           else "warning"}}
+                 for rid, r in _RULES.items()}
+    for rid, sev, summary in PTPROG_RULES:
+        rule_meta[rid] = {"id": rid,
+                          "shortDescription": {"text": summary},
+                          "defaultConfiguration": {"level": sev}}
+
+    def result(f: Finding, suppressed: bool) -> dict:
+        r = {
+            "ruleId": f.rule_id,
+            "level": "error" if f.severity == "error" else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col, 0) + 1},
+                }
+            }],
+        }
+        if suppressed:
+            r["suppressions"] = [{"kind": "external",
+                                  "justification": "baselined finding "
+                                  f"({BASELINE_NAME})"}]
+        return r
+
+    used = {f.rule_id for f in report.findings} | \
+        {f.rule_id for f in report.baselined}
+    sarif = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "informationUri":
+                    "https://github.com/PaddlePaddle/Paddle",
+                "rules": [rule_meta[rid]
+                          for rid in sorted(used) if rid in rule_meta],
+            }},
+            "results": [result(f, False) for f in report.findings]
+            + [result(f, True) for f in report.baselined],
+        }],
+    }
+    return json.dumps(sarif, indent=1)
 
 
 # ---------------------------------------------------------------------------

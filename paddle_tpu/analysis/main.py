@@ -1,5 +1,15 @@
-"""ptlint CLI (shared by ``python -m paddle_tpu.analysis`` and
-``tools/ptlint.py``)."""
+"""Shared CLI for the two analysis surfaces:
+
+- **ptlint** (source level): ``python -m paddle_tpu.analysis <paths>``
+  or ``tools/ptlint.py`` — the jax-free AST rule families PT1xx–PT5xx.
+- **ptprog** (IR level): ``python -m paddle_tpu.analysis --program
+  <target>`` or ``tools/ptprog.py`` — the PT6xx passes over a recorded
+  ``static.Program`` (needs jax for abstract evaluation).
+
+Both share reporters (``--format text|json|sarif``) and the committed
+``.ptlint-baseline.json`` grandfather workflow; ``--update-baseline``
+prunes entries whose findings no longer fire.
+"""
 from __future__ import annotations
 
 import argparse
@@ -15,11 +25,13 @@ def main(argv=None) -> int:
         description="paddle_tpu framework-aware static analysis "
                     "(PT1xx trace-safety, PT2xx SPMD collectives, "
                     "PT3xx Pallas grid contracts, PT4xx registry "
-                    "consistency)")
+                    "consistency, PT5xx error surfacing; "
+                    "--program: PT6xx IR-level Program analysis)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to lint "
                          "(default: paddle_tpu/)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", default=None,
                     help="baseline JSON path (default: nearest "
                          f"{engine.BASELINE_NAME} above the first path)")
@@ -28,17 +40,37 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write all current findings as the new baseline "
                          "and exit 0")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="prune baseline entries whose findings no "
+                         "longer fire (keeps the grandfather list "
+                         "honest) and exit 0")
     ap.add_argument("--select", action="append", default=None,
                     metavar="RULE",
                     help="restrict to rule id(s); family form PT3xx ok "
                          "(repeatable)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--program", default=None, metavar="TARGET",
+                    help="IR mode: analyze a recorded static.Program "
+                         "instead of source files. TARGET is a preset "
+                         "(llama, mlp) or module.path:callable returning "
+                         "a Program/Capture")
+    ap.add_argument("--budget-gb", type=float, default=None,
+                    help="device memory budget for the peak-memory "
+                         "check (PT610), in GiB")
+    ap.add_argument("--memory-report", action="store_true",
+                    help="print the full per-op memory/roofline table "
+                         "(IR mode, text format)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rid, r in sorted(engine.all_rules().items()):
             print(f"{rid}  [{r.severity:7s}] ({r.scope}) {r.summary}")
+        for rid, sev, summary in engine.PTPROG_RULES:
+            print(f"{rid}  [{sev:7s}] (program) {summary}")
         return 0
+
+    if args.program is not None:
+        return _run_program_mode(args)
 
     paths = args.paths or ["paddle_tpu"]
     for p in paths:
@@ -67,7 +99,62 @@ def main(argv=None) -> int:
               f"{target}")
         return 0
 
-    out = engine.render_json(report) if args.format == "json" \
-        else engine.render_text(report)
-    print(out)
+    if args.update_baseline:
+        if not baseline:
+            print("ptlint: --update-baseline needs an existing baseline",
+                  file=sys.stderr)
+            return 2
+        n_before = sum(engine.load_baseline(baseline).values())
+        engine.write_baseline(baseline, report.baselined)
+        pruned = n_before - len(report.baselined)
+        print(f"ptlint: baseline {baseline}: kept "
+              f"{len(report.baselined)} live entr"
+              f"{'y' if len(report.baselined) == 1 else 'ies'}, pruned "
+              f"{pruned} stale")
+        return 0
+
+    print(_render(report, args.format))
     return report.exit_code
+
+
+def _render(report, fmt: str, tool: str = "ptlint") -> str:
+    if fmt == "json":
+        return engine.render_json(report)
+    if fmt == "sarif":
+        return engine.render_sarif(report, tool_name=tool)
+    return engine.render_text(report, tool_name=tool)
+
+
+def _run_program_mode(args) -> int:
+    # imported lazily: the IR analyzer needs jax; plain lint runs stay
+    # milliseconds-fast and jax-free
+    from .program import analyze, load_target
+    from .program.memory import render_memory_report
+
+    cap = load_target(args.program)
+
+    baseline = None
+    if not args.no_baseline:
+        baseline = args.baseline or engine.find_baseline(os.getcwd())
+        if baseline and not os.path.isfile(baseline):
+            baseline = None
+
+    budget = (int(args.budget_gb * (1 << 30))
+              if args.budget_gb is not None else None)
+    res = analyze(cap.program, name=cap.name, feed_spec=cap.feed_spec,
+                  mesh=cap.mesh, budget_bytes=budget,
+                  capture_fn=cap.capture_fn, baseline=baseline,
+                  select=args.select)
+
+    out = _render(res.report, args.format, tool="ptprog")
+    if args.format == "text":
+        extra = []
+        if res.memory is not None:
+            extra.append(render_memory_report(
+                res.memory, top=10_000 if args.memory_report else 12))
+        if res.verify:
+            extra.append("pass verification:")
+            extra.extend(f"  {v.summary()}" for v in res.verify)
+        out = "\n".join([out] + extra)
+    print(out)
+    return res.report.exit_code

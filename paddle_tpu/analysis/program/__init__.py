@@ -1,0 +1,65 @@
+"""ptprog — IR-level static analysis over recorded ``static.Program``s.
+
+Where ptlint (the sibling rule families PT1xx–PT5xx) sees Python
+*source*, ptprog sees the *IR*: the op list a ``static.Program``
+actually recorded — post-capture, post-pass-pipeline — plus the jax
+callables behind each entry.  That is the level where a wrong-dtype AMP
+cast, an OOM-at-batch-size, or a mismatched collective group lives, and
+the reference stack checks it there too (infermeta / PIR passes /
+GSPMD propagation validate ProgramDesc before anything touches a
+device).  Four passes share one abstract-dataflow core:
+
+- **PT60x shape/dtype dataflow** (`dataflow.py`) — abstractly evaluates
+  every op entry with ``jax.eval_shape`` (the infermeta analog),
+  surfacing ops that cannot infer (PT601), mixed-float-precision inputs
+  — the AMP-cast bug class (PT602), cast ops whose output contradicts
+  their tag (PT603), and dead ops (PT604).
+- **PT61x liveness / peak memory** (`memory.py`) — per-uid live ranges
+  over the op list give peak bytes for a feed spec, an OOM check
+  against a device budget (PT610), and what ``recompute_pass`` /
+  ``amp_insertion`` would save; per-op FLOPs/bytes roofline via
+  ``paddle_tpu.cost_model``.
+- **PT62x collective consistency** (`collectives.py`) — every recorded
+  collective's group/axis is checked against the mesh (PT620/PT621),
+  p2p peers against the group (PT622), and send/recv pairs are matched
+  across pipeline-stage sub-programs (PT623) — complementing the
+  AST-level PT2xx rules, which cannot see dynamically-built groups.
+- **PT63x pass equivalence** (`verify.py`) — structural + abstract
+  before/after diffing of every registered Program pass; wired into
+  ``PassManager.run(program, verify=True)``, which rejects any
+  transform that changes fetchable shapes/dtypes (PT630/PT631).
+
+Entry points: ``python -m paddle_tpu.analysis --program <target>`` and
+``tools/ptprog.py``.  Findings are ``engine.Finding``s with
+``path="program:<name>"`` and ``line`` = 1-based op index, so the
+ptlint reporters (text/json/sarif) and the committed-baseline workflow
+apply unchanged.
+
+Unlike the AST engine this package imports jax (abstract evaluation
+needs it) — it is therefore imported lazily, never from
+``paddle_tpu.analysis`` itself, keeping ``tools/ptlint.py`` jax-free.
+"""
+from __future__ import annotations
+
+# PT6xx inventory (defined in the jax-free engine so `--list-rules`
+# never has to import this package; the AST registry can't hold these —
+# they run over Programs, not files).
+from ..engine import PTPROG_RULES                            # noqa: E402
+
+from .ir import ProgramIR                                    # noqa: E402
+from .dataflow import abstract_run, check_dataflow           # noqa: E402
+from .memory import MemoryReport, check_memory, estimate_memory  # noqa: E402
+from .collectives import check_collectives, check_pipeline   # noqa: E402
+from .verify import (PassVerificationError, VerifyReport,    # noqa: E402
+                     program_signature, verify_pass)
+from .analyze import AnalysisResult, analyze                 # noqa: E402
+from .capture import (Capture, PRESETS, capture_llama_block,  # noqa: E402
+                      capture_mlp, load_target)
+
+__all__ = ["PTPROG_RULES", "ProgramIR", "abstract_run", "check_dataflow",
+           "MemoryReport", "check_memory", "estimate_memory",
+           "check_collectives", "check_pipeline",
+           "PassVerificationError", "VerifyReport", "program_signature",
+           "verify_pass", "AnalysisResult", "analyze", "Capture",
+           "PRESETS", "capture_llama_block", "capture_mlp",
+           "load_target"]

@@ -1,0 +1,131 @@
+"""The ptprog driver: run all four IR passes over one Program and
+assemble an ``engine.Report`` so the ptlint reporters and baseline
+workflow apply unchanged."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .. import engine
+from .collectives import check_collectives, check_pipeline
+from .dataflow import check_dataflow
+from .ir import ProgramIR
+from .memory import MemoryReport, check_memory
+from .verify import PassVerificationError, VerifyReport, verify_pass
+
+__all__ = ["AnalysisResult", "analyze", "shipped_passes"]
+
+
+def shipped_passes():
+    """The five registered Program passes, as (name, callable) — what
+    pass-equivalence verification exercises by default."""
+    import functools
+
+    from ...static import passes as P
+
+    return [
+        ("dead_op_elimination", P.dead_op_elimination),
+        ("constant_folding", P.constant_folding),
+        ("fuse_chain[matmul,relu]",
+         functools.partial(P.fuse_chain, names=["matmul", "relu"])),
+        ("amp_insertion", P.amp_insertion),
+        ("recompute_pass", P.recompute_pass),
+    ]
+
+
+@dataclass
+class AnalysisResult:
+    report: engine.Report
+    memory: Optional[MemoryReport] = None
+    verify: List[VerifyReport] = field(default_factory=list)
+    env: Dict[int, object] = field(default_factory=dict)
+
+    @property
+    def exit_code(self) -> int:
+        return self.report.exit_code
+
+
+def _apply_baseline_and_select(findings, baseline, select) -> engine.Report:
+    report = engine.Report(files=1)
+
+    def selected(rid):
+        if select is None:
+            return True
+        return any(rid == s or (s.endswith("xx") and rid.startswith(s[:-2]))
+                   for s in select)
+
+    base_counts = engine.load_baseline(baseline) if baseline else {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule_id)):
+        if not selected(f.rule_id):
+            continue
+        k = f.key()
+        if base_counts.get(k, 0) > 0:
+            base_counts[k] -= 1
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    return report
+
+
+def analyze(program=None, name: str = "program", feed_spec=None,
+            mesh=None, budget_bytes: Optional[int] = None,
+            capture_fn=None, stage_programs: Optional[Sequence] = None,
+            baseline: Optional[str] = None,
+            select: Optional[Sequence[str]] = None) -> AnalysisResult:
+    """Run the four IR passes over ``program``.
+
+    - dataflow (PT60x) and memory (PT61x) always run;
+    - collective consistency (PT62x) runs against ``mesh`` (default:
+      the active topology mesh), plus cross-stage send/recv matching
+      when ``stage_programs`` is given;
+    - pass equivalence (PT63x) runs when ``capture_fn`` can produce a
+      fresh Program per shipped pass (passes mutate what they verify).
+    """
+    findings: List[engine.Finding] = []
+    memrep = None
+    verify_reports: List[VerifyReport] = []
+    env: Dict[int, object] = {}
+
+    if program is not None:
+        ir = ProgramIR(program, feed_spec=feed_spec, name=name)
+        env, findings = check_dataflow(ir)
+        mem_f, memrep = check_memory(ir, env, budget_bytes)
+        findings.extend(mem_f)
+        findings.extend(check_collectives(ir, mesh=mesh))
+
+    if stage_programs:
+        findings.extend(check_pipeline(stage_programs, mesh=mesh))
+
+    if capture_fn is not None:
+        for pname, p in shipped_passes():
+            fresh = capture_fn()
+            try:
+                verify_reports.append(
+                    verify_pass(fresh, p, feed_spec=feed_spec,
+                                pass_name=pname))
+            except PassVerificationError as e:
+                for d in e.diffs:
+                    rid = "PT631" if d.startswith("[PT631]") else "PT630"
+                    findings.append(engine.Finding(
+                        rid, "error", f"program:{name}", 0, 0,
+                        f"pass '{pname}': "
+                        + d.split("] ", 1)[-1], line_text=pname))
+
+    try:
+        from ...profiler import metrics as _metrics
+
+        _metrics.inc("analysis/programs_analyzed")
+        if program is not None:
+            _metrics.inc("analysis/ops_analyzed", len(program.ops))
+    except Exception:
+        pass
+
+    report = _apply_baseline_and_select(findings, baseline, select)
+    try:
+        from ...profiler import metrics as _metrics
+
+        _metrics.inc("analysis/findings", len(report.findings))
+    except Exception:
+        pass
+    return AnalysisResult(report=report, memory=memrep,
+                          verify=verify_reports, env=env)

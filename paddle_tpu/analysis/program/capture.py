@@ -1,0 +1,109 @@
+"""Program captures the analyzer can produce on demand.
+
+``--program llama`` (or ``mlp``) needs a Program to chew on; these
+presets record one from the shipped models — a llama decoder block and
+a small MLP — sized to analyze in well under ten seconds on a CPU.  A
+``module:callable`` target loads user code instead: the callable must
+return a ``static.Program`` or a ``Capture``.
+
+Captures are *functions* (not cached Programs) because pass-equivalence
+verification mutates the program it checks — each shipped pass is
+verified against a fresh capture.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+__all__ = ["Capture", "PRESETS", "capture_mlp", "capture_llama_block",
+           "load_target"]
+
+
+@dataclass
+class Capture:
+    name: str
+    program: object                       # static.Program
+    feed_spec: Dict[str, object] = field(default_factory=dict)
+    capture_fn: Optional[Callable] = None   # fresh re-capture for verify
+    mesh: object = None
+
+
+def capture_mlp(batch: int = 8, din: int = 64, dhidden: int = 128,
+                dout: int = 32) -> Capture:
+    """x @ w1 -> relu -> @ w2 -> softmax, recorded into a fresh
+    Program (the canonical pass-pipeline fixture)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+
+    rng = np.random.RandomState(0)
+    w1 = paddle.to_tensor(rng.randn(din, dhidden).astype(np.float32) * .1)
+    w2 = paddle.to_tensor(rng.randn(dhidden, dout).astype(np.float32) * .1)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (batch, din), "float32")
+        h = paddle.matmul(x, w1)
+        h = paddle.nn.functional.relu(h)
+        h = paddle.matmul(h, w2)
+        out = paddle.nn.functional.softmax(h)
+    main.fetch_targets.append(out)
+    return Capture(name="mlp", program=main,
+                   capture_fn=lambda: capture_mlp(batch, din, dhidden,
+                                                  dout).program)
+
+
+def capture_llama_block(batch: int = 2, seq: int = 64, hidden: int = 128,
+                        heads: int = 4, intermediate: int = 256) -> Capture:
+    """One LlamaDecoderLayer forward recorded op-by-op — the "llama
+    preset program capture" the CI gate analyzes.  Flash attention is
+    disabled (the Pallas kernel has its own PT3xx contract checks and
+    no CPU abstract path is needed here) and the layer runs in eval
+    mode so the capture is the plain dense block."""
+    import numpy as np
+
+    import paddle_tpu.static as static
+    from ...models.llama import LlamaConfig, LlamaDecoderLayer
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=hidden, intermediate_size=intermediate,
+        num_hidden_layers=1, num_attention_heads=heads,
+        num_key_value_heads=heads, max_position_embeddings=max(seq, 16),
+        dtype="float32", use_flash_attention=False, recompute=False)
+    layer = LlamaDecoderLayer(cfg)
+    layer.eval()
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (batch, seq, hidden), "float32")
+        out = layer(x)
+    main.fetch_targets.append(out)
+    return Capture(
+        name="llama-block", program=main,
+        capture_fn=lambda: capture_llama_block(batch, seq, hidden, heads,
+                                               intermediate).program)
+
+
+PRESETS: Dict[str, Callable[[], Capture]] = {
+    "mlp": capture_mlp,
+    "llama": capture_llama_block,
+    "llama-block": capture_llama_block,
+}
+
+
+def load_target(target: str) -> Capture:
+    """Resolve a ``--program`` target: a preset name, or
+    ``package.module:callable`` returning a Program or Capture."""
+    if target in PRESETS:
+        return PRESETS[target]()
+    if ":" not in target:
+        raise SystemExit(
+            f"ptprog: unknown program target {target!r} — use one of "
+            f"{sorted(PRESETS)} or module.path:callable")
+    mod_name, _, attr = target.partition(":")
+    import importlib
+
+    mod = importlib.import_module(mod_name)
+    obj = getattr(mod, attr)()
+    if isinstance(obj, Capture):
+        return obj
+    return Capture(name=target, program=obj)

@@ -1,0 +1,174 @@
+"""IR-level collective/sharding consistency checks (PT62x).
+
+The AST rules (PT2xx) can only see collectives whose group is a literal
+at the call site.  Here the *recorded* state is checked: the explicit
+``collective_meta`` log the dispatcher writes while a Program records
+(or, for older captures, the ``Group`` recovered from each entry's
+closure — see ``ir.collective_info``), validated against the process
+mesh that will execute the replay:
+
+- PT620 error — a collective's group binds a mesh axis that does not
+  exist on the mesh (the replay's in-graph branch would reference an
+  unbound axis name; the eager branch silently degrades to identity).
+- PT621 error — group size disagrees with the bound mesh axis size, or
+  group ranks fall outside the mesh's device count.
+- PT622 error — a p2p send/recv names a peer outside its group.
+- PT623 error — ``check_pipeline``: across per-stage sub-programs,
+  a send from stage *i* to peer *j* has no matching recv in stage *j*
+  from peer *i* (and vice versa) — the classic pipeline-schedule
+  deadlock, caught on CPU in milliseconds.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..engine import Finding
+from .ir import ProgramIR
+
+__all__ = ["check_collectives", "check_pipeline", "active_mesh"]
+
+
+def active_mesh():
+    """The mesh the replay will run under: the explicitly initialized
+    topology mesh if any, else None (single-controller eager)."""
+    try:
+        from ...distributed.topology import get_mesh
+
+        return get_mesh()
+    except Exception:
+        return None
+
+
+def _mesh_axes(mesh) -> Optional[Dict[str, int]]:
+    if mesh is None:
+        return None
+    shape = getattr(mesh, "shape", None)
+    if shape is None:
+        return None
+    return dict(shape)
+
+
+def _finding(ir: ProgramIR, rule: str, index: int, msg: str,
+             ctx: str) -> Finding:
+    return Finding(rule, "error", f"program:{ir.name}", index + 1, 0,
+                   msg, line_text=ctx)
+
+
+def check_collectives(ir: ProgramIR, mesh=None,
+                      world_size: Optional[int] = None) -> List[Finding]:
+    """Validate every recorded collective of one program against
+    ``mesh`` (defaults to the active topology mesh)."""
+    mesh = mesh if mesh is not None else active_mesh()
+    axes = _mesh_axes(mesh)
+    ndev = None
+    if mesh is not None:
+        devs = getattr(mesh, "devices", None)
+        ndev = int(devs.size) if devs is not None else None
+    if world_size is None:
+        world_size = ndev
+
+    findings: List[Finding] = []
+    for meta in ir.collectives:
+        op = meta.get("op", "?")
+        idx = int(meta.get("op_index", 0))
+        axis = meta.get("axis")
+        ranks = meta.get("ranks")
+        ctx = f"{op}@{axis or '?'}"
+        # the default world group's synthetic axis never binds a mesh
+        # axis by name — it is the whole mesh
+        is_world = axis in (None, "world") or (
+            axis or "").startswith("group_")
+        if axes is not None and not is_world and axis not in axes:
+            findings.append(_finding(
+                ir, "PT620", idx,
+                f"collective '{op}' is bound to mesh axis '{axis}' "
+                f"which does not exist on the mesh "
+                f"(axes: {sorted(axes)}); the in-graph replay cannot "
+                f"lower this collective", ctx))
+        elif axes is not None and not is_world and ranks is not None \
+                and len(ranks) != axes[axis]:
+            findings.append(_finding(
+                ir, "PT621", idx,
+                f"collective '{op}' group has {len(ranks)} rank(s) but "
+                f"mesh axis '{axis}' has size {axes[axis]} — the group "
+                f"does not tile the axis", ctx))
+        if ranks is not None and world_size:
+            bad = [r for r in ranks if r < 0 or r >= world_size]
+            if bad:
+                findings.append(_finding(
+                    ir, "PT621", idx,
+                    f"collective '{op}' group names rank(s) {bad} "
+                    f"outside the world of {world_size}", ctx))
+        peer = meta.get("peer")
+        if peer is not None and ranks:
+            if peer not in ranks:
+                findings.append(_finding(
+                    ir, "PT622", idx,
+                    f"p2p '{op}' targets peer rank {peer} outside its "
+                    f"group ranks {sorted(ranks)}", ctx))
+    return findings
+
+
+def _p2p_events(ir: ProgramIR) -> List[dict]:
+    return [m for m in ir.collectives
+            if m.get("op") in ("send", "recv", "isend", "irecv")]
+
+
+def check_pipeline(stage_programs: Sequence, mesh=None,
+                   names: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Match send/recv pairs across pipeline-stage sub-programs.
+
+    ``stage_programs[i]`` is the Program recorded for pipeline stage
+    *i* (stage index == rank on the 'pp' axis).  Every send from stage
+    i to peer j must have a matching recv in stage j with peer i, in
+    both directions; surplus events on either side are PT623 findings.
+    Per-stage group/axis checks (PT620–PT622) run too.
+    """
+    irs = [p if isinstance(p, ProgramIR)
+           else ProgramIR(p, name=(names[i] if names else f"stage{i}"))
+           for i, p in enumerate(stage_programs)]
+    findings: List[Finding] = []
+    for ir in irs:
+        findings.extend(check_collectives(ir, mesh=mesh))
+
+    # (src stage, dst stage) -> [counts] of sends / recvs
+    sends: Dict[Tuple[int, int], int] = {}
+    recvs: Dict[Tuple[int, int], int] = {}
+    send_at: Dict[Tuple[int, int], Tuple[ProgramIR, int]] = {}
+    recv_at: Dict[Tuple[int, int], Tuple[ProgramIR, int]] = {}
+    for i, ir in enumerate(irs):
+        for ev in _p2p_events(ir):
+            peer = ev.get("peer")
+            if peer is None:
+                continue
+            idx = int(ev.get("op_index", 0))
+            if ev["op"] in ("send", "isend"):
+                key = (i, int(peer))
+                sends[key] = sends.get(key, 0) + 1
+                send_at.setdefault(key, (ir, idx))
+            else:
+                key = (int(peer), i)
+                recvs[key] = recvs.get(key, 0) + 1
+                recv_at.setdefault(key, (ir, idx))
+
+    for key in sorted(set(sends) | set(recvs)):
+        ns, nr = sends.get(key, 0), recvs.get(key, 0)
+        if ns == nr:
+            continue
+        src, dst = key
+        if ns > nr:
+            ir, idx = send_at[key]
+            findings.append(_finding(
+                ir, "PT623", idx,
+                f"stage {src} sends to stage {dst} {ns} time(s) but "
+                f"stage {dst} posts only {nr} matching recv(s) — the "
+                f"surplus send deadlocks the schedule",
+                f"send:{src}->{dst}"))
+        else:
+            ir, idx = recv_at[key]
+            findings.append(_finding(
+                ir, "PT623", idx,
+                f"stage {dst} expects {nr} recv(s) from stage {src} but "
+                f"stage {src} posts only {ns} send(s) — the surplus "
+                f"recv blocks forever", f"recv:{src}->{dst}"))
+    return findings

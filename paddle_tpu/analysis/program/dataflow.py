@@ -1,0 +1,170 @@
+"""Shape/dtype dataflow over a ProgramIR — the infermeta analog.
+
+``abstract_run`` walks the op list in order, abstractly evaluating
+every entry with ``jax.eval_shape`` on the *recorded callable* (so it
+checks what will actually replay, not a re-derivation), and returns the
+full uid -> ShapeDtypeStruct environment.  All other analysis passes
+(memory, collectives, pass-equivalence) run on top of that environment;
+``check_dataflow`` additionally emits the PT60x findings:
+
+- PT601 error   — abstract evaluation raised (a real infermeta failure:
+  the op cannot trace at the recorded input shapes/dtypes).
+- PT602 warning — an op consumes a MIX of floating dtypes (e.g. bf16
+  and fp32): the silent-promotion signature of a broken/missing AMP
+  cast.  Cast ops are exempt (mixing is their job).
+- PT603 error   — a ``cast_<tag>`` entry's floating output contradicts
+  its tag (an AMP pass rewired casts wrongly).
+- PT604 warning — an op's outputs are never consumed nor fetched: dead
+  weight in the replay (run ``dead_op_elimination``).
+
+Host-side RNG draws inside a recorded op (dropout etc.) are isolated
+under an ``rng_guard`` so analysis never perturbs the global stream.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Finding
+from .ir import OpView, ProgramIR
+
+__all__ = ["abstract_run", "check_dataflow"]
+
+_FLOATS = tuple(np.dtype(d) for d in
+                (np.float16, np.float32, np.float64)) + (
+    np.dtype(jnp.bfloat16),)
+
+# cast_<tag> entries inserted by amp_insertion: tag -> required output
+_CAST_TAGS = {
+    "cast_bfloat16": np.dtype(jnp.bfloat16),
+    "cast_bf16": np.dtype(jnp.bfloat16),
+    "cast_float16": np.dtype(np.float16),
+    "cast_fp16": np.dtype(np.float16),
+    "cast_fp32": np.dtype(np.float32),
+    "cast_fp32out": np.dtype(np.float32),
+}
+
+
+@contextlib.contextmanager
+def _isolated_rng():
+    """Abstract evaluation may execute host-side RNG key derivation in
+    recorded callables; pin it to a throwaway guard key so analysis is
+    side-effect free on the global stream."""
+    try:
+        from ...framework import random as _rand
+    except Exception:
+        yield
+        return
+    try:
+        with _rand.rng_guard(jax.random.PRNGKey(0)):
+            yield
+    except Exception:
+        # rng_guard unavailable mid-version: run unguarded rather than
+        # fail the analysis
+        yield
+
+
+def _op_finding(ir: ProgramIR, op: OpView, rule: str, severity: str,
+                msg: str) -> Finding:
+    return Finding(rule, severity, f"program:{ir.name}", op.index + 1, 0,
+                   msg, line_text=op.name)
+
+
+def abstract_run(ir: ProgramIR,
+                 env: Optional[Dict[int, jax.ShapeDtypeStruct]] = None,
+                 findings: Optional[List[Finding]] = None,
+                 ) -> Tuple[Dict[int, jax.ShapeDtypeStruct],
+                            List[Finding]]:
+    """Abstractly evaluate every op of ``ir`` in record order.
+
+    Returns ``(env, findings)`` where env maps every resolvable uid to
+    its ShapeDtypeStruct.  Ops whose inputs are unresolved (because an
+    upstream op already failed) are skipped without piling on findings —
+    one PT601 per root cause.
+    """
+    env = dict(ir.initial_env) if env is None else env
+    findings = [] if findings is None else findings
+    with _isolated_rng():
+        for op in ir.ops:
+            if any(u not in env for u in op.in_uids):
+                missing_roots = [u for u in op.in_uids if u not in env
+                                 and u not in ir.producer]
+                if missing_roots:
+                    findings.append(_op_finding(
+                        ir, op, "PT601", "error",
+                        f"op '{op.name}' reads uid(s) {missing_roots} "
+                        f"that no feed, external, or earlier op "
+                        f"produces"))
+                continue
+            in_sig = ", ".join(
+                f"{env[u].dtype}{list(env[u].shape)}" for u in op.in_uids)
+            try:
+                updates, in_avals = ir.abstract_eval_op(op, env)
+            except Exception as e:  # noqa: BLE001 — surfaced as finding
+                findings.append(_op_finding(
+                    ir, op, "PT601", "error",
+                    f"op '{op.name}' failed abstract evaluation at "
+                    f"inputs ({in_sig}): {type(e).__name__}: {e}"))
+                continue
+            env.update(updates)
+            _check_float_mix(ir, op, in_avals, findings)
+            _check_cast_tag(ir, op, updates, findings)
+    return env, findings
+
+
+def _check_float_mix(ir: ProgramIR, op: OpView, in_avals, findings):
+    if op.name.startswith("cast_") or len(in_avals) < 2:
+        return
+    float_dts = {np.dtype(a.dtype) for a in in_avals
+                 if np.dtype(a.dtype) in _FLOATS}
+    if len(float_dts) > 1:
+        findings.append(_op_finding(
+            ir, op, "PT602", "warning",
+            f"op '{op.name}' mixes floating dtypes "
+            f"{sorted(d.name for d in float_dts)} across its tensor "
+            f"inputs — a missing/broken AMP cast (the replay will "
+            f"silently promote)"))
+
+
+def _check_cast_tag(ir: ProgramIR, op: OpView, updates, findings):
+    want = _CAST_TAGS.get(op.name)
+    if want is None:
+        return
+    for u, aval in updates.items():
+        got = np.dtype(aval.dtype)
+        if got in _FLOATS and got != want:
+            findings.append(_op_finding(
+                ir, op, "PT603", "error",
+                f"cast op '{op.name}' produces {got.name}, contradicting "
+                f"its tag ({want.name}) — the AMP pass wired this cast "
+                f"wrongly"))
+
+
+def check_dataflow(ir: ProgramIR,
+                   env: Optional[Dict[int, jax.ShapeDtypeStruct]] = None,
+                   ) -> Tuple[Dict[int, jax.ShapeDtypeStruct],
+                              List[Finding]]:
+    """The full PT60x pass: abstract_run + dead-op detection, including
+    a recursive walk into control-flow regions (the PIR Region analog)."""
+    env, findings = abstract_run(ir, env)
+
+    fetch = set(ir.fetch_uids)
+    for op in ir.ops:
+        if op.out_uids and not any(
+                u in ir.consumers or u in fetch for u in op.out_uids):
+            findings.append(_op_finding(
+                ir, op, "PT604", "warning",
+                f"op '{op.name}' outputs are never consumed or fetched "
+                f"— dead weight in the replay "
+                f"(run dead_op_elimination)"))
+        for tag, sub in op.regions:
+            sub_ir = ProgramIR(sub, name=f"{ir.name}/op{op.index}"
+                                         f"[{tag}]")
+            _senv, sfind = check_dataflow(sub_ir)
+            findings.extend(sfind)
+    return env, findings

@@ -1,0 +1,204 @@
+"""Normalized view of a recorded ``static.Program`` op list.
+
+A Program entry is the raw 8-tuple ``(name, fn, entry_flat, tensor_pos,
+in_uids, treedef, out_positions, out_uids)`` (plus ``.regions`` on
+control-flow entries).  ``ProgramIR`` wraps it with the derived tables
+every analysis pass needs — producer/consumer indices, initial abstract
+environment (feeds + externals as ``jax.ShapeDtypeStruct``), fetch
+roots, and best-effort collective metadata recovered from the entry's
+closure when the Program carries no ``collective_meta`` log.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+__all__ = ["OpView", "ProgramIR", "aval_of", "aval_nbytes",
+           "COLLECTIVE_OPS", "P2P_OPS", "collective_info"]
+
+# op names the dispatcher records for paddle_tpu.distributed collectives
+COLLECTIVE_OPS = frozenset({
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "all_to_all_single", "broadcast", "scatter", "reduce"})
+P2P_OPS = frozenset({"send", "recv", "isend", "irecv"})
+
+
+def aval_of(value) -> Optional[jax.ShapeDtypeStruct]:
+    """ShapeDtypeStruct for a Tensor / array / ShapeDtypeStruct."""
+    if value is None:
+        return None
+    if isinstance(value, jax.ShapeDtypeStruct):
+        return value
+    v = getattr(value, "_value", value)       # Tensor -> jax array
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+
+def aval_nbytes(aval) -> int:
+    if aval is None:
+        return 0
+    n = 1
+    for d in aval.shape:
+        n *= int(d)
+    return n * np.dtype(aval.dtype).itemsize
+
+
+class OpView:
+    """One op entry with its index and region children exposed."""
+
+    __slots__ = ("index", "name", "entry", "fn", "in_uids", "out_uids",
+                 "regions")
+
+    def __init__(self, index: int, entry):
+        self.index = index
+        self.entry = entry
+        (self.name, self.fn, _flat, _tpos, self.in_uids, _treedef,
+         _out_pos, self.out_uids) = entry[:8]
+        self.regions = list(getattr(entry, "regions", ()))
+
+    def __repr__(self):
+        return (f"OpView({self.index}: {self.name} "
+                f"{list(self.in_uids)} -> {list(self.out_uids)})")
+
+
+def collective_info(op: OpView) -> Optional[Dict[str, Any]]:
+    """Best-effort group metadata from a collective entry's CLOSURE —
+    the fallback for Programs recorded before ``collective_meta``
+    logging existed.  The recorded jax fn closes over the ``Group`` (and
+    usually the resolved axis name), which is exactly the
+    dynamically-built state the AST-level PT2xx rules cannot see."""
+    if op.name not in COLLECTIVE_OPS | P2P_OPS:
+        return None
+    fn = op.fn
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None) or ()
+    if code is None:
+        return None
+    info: Dict[str, Any] = {"op": op.name, "op_index": op.index,
+                            "gid": None, "ranks": None, "axis": None,
+                            "peer": None}
+    for var, cell in zip(code.co_freevars, cells):
+        try:
+            val = cell.cell_contents
+        except ValueError:              # empty cell
+            continue
+        if var in ("group", "g") and val is not None \
+                and hasattr(val, "ranks") and hasattr(val, "axis_name"):
+            info["gid"] = getattr(val, "id", None)
+            info["ranks"] = tuple(val.ranks)
+            info["axis"] = val.axis_name
+        elif var == "ax" and isinstance(val, str):
+            info.setdefault("axis", None)
+            info["axis"] = info["axis"] or val
+    if info["ranks"] is None and info["axis"] is None:
+        return None
+    return info
+
+
+class ProgramIR:
+    """Derived tables over one Program: ops, producer/consumer maps,
+    initial abstract environment, fetch roots, collective log."""
+
+    def __init__(self, program, feed_spec: Optional[Dict[str, Any]] = None,
+                 name: str = "program"):
+        self.program = program
+        self.name = name
+        self.ops: List[OpView] = [OpView(i, e)
+                                  for i, e in enumerate(program.ops)]
+
+        uid_of = type(program)._uid
+        self.feed_uids: Dict[str, int] = {
+            n: uid_of(t) for n, t in program.feed_targets.items()}
+        self.fetch_uids: List[int] = [uid_of(t)
+                                      for t in program.fetch_targets]
+
+        # initial abstract environment: feeds (spec override wins) then
+        # the remaining externals from the live-read table
+        self.initial_env: Dict[int, jax.ShapeDtypeStruct] = {}
+        for fname, t in program.feed_targets.items():
+            spec = (feed_spec or {}).get(fname)
+            aval = aval_of(spec) if spec is not None else aval_of(t)
+            if aval is not None:
+                self.initial_env[uid_of(t)] = aval
+        feed_uid_set = set(self.feed_uids.values())
+        self.external_uids: List[int] = []
+        for u, t in program._live.items():
+            if u in feed_uid_set:
+                continue
+            aval = aval_of(t)
+            if aval is not None:
+                self.initial_env.setdefault(u, aval)
+                self.external_uids.append(u)
+
+        self.producer: Dict[int, int] = {}
+        self.consumers: Dict[int, List[int]] = {}
+        for op in self.ops:
+            for u in op.out_uids:
+                self.producer.setdefault(u, op.index)
+            for u in op.in_uids:
+                self.consumers.setdefault(u, []).append(op.index)
+
+        # collective log: the explicit meta recorded by
+        # distributed.collective (preferred — includes eager p2p that
+        # never becomes an op entry), else closure recovery per entry
+        meta = list(getattr(program, "collective_meta", ()) or ())
+        if not meta:
+            meta = [m for m in (collective_info(op) for op in self.ops)
+                    if m is not None]
+        self.collectives: List[Dict[str, Any]] = meta
+
+    def abstract_eval_op(self, op: OpView,
+                         env: Dict[int, jax.ShapeDtypeStruct]):
+        """infermeta for one entry: rebuild the flat arg list with
+        ShapeDtypeStructs from ``env`` and run ``jax.eval_shape`` over
+        the recorded callable.  Returns (updates, input_avals); raises
+        whatever the abstract trace raises (the caller turns that into
+        a PT601 finding)."""
+        (name, fn, entry_flat, tpos, in_uids, treedef, out_positions,
+         out_uids) = op.entry[:8]
+        flat2 = list(entry_flat)
+        in_avals = []
+        for i, u in zip(tpos, in_uids):
+            aval = env.get(u)
+            if aval is None:
+                raise KeyError(
+                    f"input uid {u} of op #{op.index} ({name}) has no "
+                    f"known abstract value (producer missing or failed)")
+            flat2[i] = aval
+            in_avals.append(aval)
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        out = jax.eval_shape(fn, *a2, **k2)
+        leaves = jax.tree_util.tree_leaves(out)
+        updates = {}
+        for pos, u in zip(out_positions, out_uids):
+            leaf = leaves[pos]
+            updates[u] = jax.ShapeDtypeStruct(tuple(leaf.shape),
+                                              np.dtype(leaf.dtype))
+        return updates, in_avals
+
+    def jaxpr(self, op: OpView, env: Dict[int, jax.ShapeDtypeStruct]):
+        """The jaxpr behind one entry, traced at the abstract input
+        types from ``env`` — the drill-down view for tooling."""
+        (_name, fn, entry_flat, tpos, in_uids, treedef) = op.entry[:6]
+        flat2 = list(entry_flat)
+        for i, u in zip(tpos, in_uids):
+            flat2[i] = env[u]
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        return jax.make_jaxpr(lambda *a, **k: fn(*a, **k))(*a2, **k2)
+
+    def last_use(self) -> Dict[int, int]:
+        """uid -> index of its last consuming op; fetched uids are
+        pinned to the final index (they must survive to the end)."""
+        n = len(self.ops)
+        out: Dict[int, int] = {}
+        for u, idxs in self.consumers.items():
+            out[u] = max(idxs)
+        for u in self.fetch_uids:
+            out[u] = n - 1 if n else 0
+        return out

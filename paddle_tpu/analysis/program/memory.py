@@ -1,0 +1,271 @@
+"""Liveness-based peak-memory estimation over a ProgramIR.
+
+The live-set model mirrors the Executor replay environment with
+free-after-last-use semantics: a uid is live from the step its producer
+runs (externals/feeds from step 0) through the last op that consumes it
+— or to the end of the program when it is fetched.  Peak bytes is the
+maximum over op indices of the summed live bytes; the unit test pins
+this to a concrete replay that tracks the same accounting over real
+arrays.
+
+Beyond the raw peak, the report quantifies the two standard levers:
+
+- ``recompute_pass`` savings — for k contiguous segments, the live set
+  shrinks to (externals + segment-boundary values + the current
+  segment's internal peak); the report evaluates k in {2, 4} and keeps
+  the best.
+- ``amp_insertion`` savings — intermediate floating values held at
+  half width (4-byte floats -> bf16), externals (parameters stay
+  fp32 master copies in O1) unchanged.
+
+Per-op FLOPs/bytes and arithmetic intensity come from
+``paddle_tpu.cost_model.op_flops`` — the roofline columns of the CLI
+memory report.  PT610 fires when the predicted peak exceeds the device
+budget.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import Finding
+from .ir import ProgramIR, aval_nbytes
+
+__all__ = ["MemoryReport", "estimate_memory", "check_memory",
+           "render_memory_report"]
+
+_F32 = np.dtype(np.float32)
+_F64 = np.dtype(np.float64)
+
+
+@dataclass
+class MemoryReport:
+    name: str
+    peak_bytes: int = 0
+    peak_index: int = -1            # op index where the peak occurs
+    external_bytes: int = 0         # params/constants live for the run
+    feed_bytes: int = 0
+    fetch_bytes: int = 0
+    per_op: List[dict] = field(default_factory=list)
+    live_ranges: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    budget_bytes: Optional[int] = None
+    recompute_savings_bytes: int = 0
+    recompute_best_segments: int = 0
+    amp_savings_bytes: int = 0
+    total_flops: int = 0
+
+    @property
+    def peak_gib(self) -> float:
+        return self.peak_bytes / (1 << 30)
+
+
+def _sizes(ir: ProgramIR, env) -> Dict[int, int]:
+    return {u: aval_nbytes(a) for u, a in env.items()}
+
+
+def _live_ranges(ir: ProgramIR) -> Dict[int, Tuple[int, int]]:
+    """uid -> (birth op index, death op index) in the replay model.
+    Externals and feeds are born at 0; fetched uids die at the end."""
+    n = len(ir.ops)
+    last = ir.last_use()
+    ranges: Dict[int, Tuple[int, int]] = {}
+    for u in ir.initial_env:
+        ranges[u] = (0, last.get(u, 0))
+    for op in ir.ops:
+        for u in op.out_uids:
+            birth = ir.producer.get(u, op.index)
+            ranges[u] = (birth, last.get(u, birth))
+    return ranges
+
+
+def _peak(ranges: Dict[int, Tuple[int, int]], sizes: Dict[int, int],
+          n_ops: int) -> Tuple[int, int]:
+    """(peak bytes, op index) via an event sweep over births/deaths."""
+    if n_ops == 0:
+        total = sum(sizes.get(u, 0) for u in ranges)
+        return total, -1
+    delta = [0] * (n_ops + 1)
+    for u, (b, d) in ranges.items():
+        sz = sizes.get(u, 0)
+        delta[b] += sz
+        if d + 1 <= n_ops:
+            delta[d + 1] -= sz
+    peak = cur = 0
+    peak_i = 0
+    for i in range(n_ops):
+        cur += delta[i]
+        if cur > peak:
+            peak, peak_i = cur, i
+    return peak, peak_i
+
+
+def _segment_peak(ir: ProgramIR, sizes: Dict[int, int],
+                  num_segments: int) -> int:
+    """Predicted peak if the op list ran under ``recompute_pass``
+    (k contiguous segments, internals freed at segment exit): externals
+    + live segment-boundary values + the current segment's own peak."""
+    n = len(ir.ops)
+    if n == 0 or num_segments < 1:
+        return 0
+    bounds = [round(i * n / num_segments)
+              for i in range(num_segments + 1)]
+    ext = sum(sizes.get(u, 0) for u in ir.initial_env)
+    last = ir.last_use()
+    peak = 0
+    for si in range(num_segments):
+        lo, hi = bounds[si], bounds[si + 1]
+        if lo >= hi:
+            continue
+        # boundary values alive while this segment runs: produced before
+        # lo (or external) and still used at/after lo
+        boundary = 0
+        for u, d in last.items():
+            b = ir.producer.get(u, 0 if u in ir.initial_env else None)
+            if b is None or u in ir.initial_env:
+                continue            # externals counted once above
+            if b < lo and d >= lo:
+                boundary += sizes.get(u, 0)
+        # internal running live-set of the segment
+        seg_ranges = {}
+        for op in ir.ops[lo:hi]:
+            for u in op.out_uids:
+                seg_ranges[u] = (ir.producer.get(u, op.index),
+                                 min(last.get(u, op.index), hi - 1))
+        seg_peak, _ = _peak(
+            {u: (b - lo, d - lo) for u, (b, d) in seg_ranges.items()},
+            sizes, hi - lo)
+        peak = max(peak, ext + boundary + seg_peak)
+    return peak
+
+
+def estimate_memory(ir: ProgramIR, env: Dict[int, jax.ShapeDtypeStruct],
+                    budget_bytes: Optional[int] = None) -> MemoryReport:
+    from ... import cost_model as _cm
+
+    sizes = _sizes(ir, env)
+    ranges = _live_ranges(ir)
+    peak, peak_i = _peak(ranges, sizes, len(ir.ops))
+
+    rep = MemoryReport(name=ir.name, peak_bytes=peak, peak_index=peak_i,
+                       budget_bytes=budget_bytes, live_ranges=ranges)
+    feed_uids = set(ir.feed_uids.values())
+    rep.feed_bytes = sum(sizes.get(u, 0) for u in feed_uids)
+    rep.external_bytes = sum(sizes.get(u, 0) for u in ir.initial_env
+                             if u not in feed_uids)
+    rep.fetch_bytes = sum(sizes.get(u, 0) for u in set(ir.fetch_uids))
+
+    # per-op roofline rows
+    running = 0
+    delta = {}
+    for u, (b, d) in ranges.items():
+        delta.setdefault(b, 0)
+        delta[b] += sizes.get(u, 0)
+        delta.setdefault(d + 1, 0)
+        delta[d + 1] -= sizes.get(u, 0)
+    for op in ir.ops:
+        running += delta.get(op.index, 0)
+        in_avals = [env[u] for u in op.in_uids if u in env]
+        out_avals = [env[u] for u in op.out_uids if u in env]
+        flops = _cm.op_flops(op.name, in_avals, out_avals)
+        bytes_moved = (sum(aval_nbytes(a) for a in in_avals)
+                       + sum(aval_nbytes(a) for a in out_avals))
+        rep.per_op.append({
+            "index": op.index, "name": op.name,
+            "out_bytes": sum(aval_nbytes(a) for a in out_avals),
+            "live_bytes": running, "flops": flops,
+            "bytes_moved": bytes_moved,
+            "intensity": (flops / bytes_moved) if bytes_moved else 0.0,
+        })
+        rep.total_flops += flops
+
+    # recompute savings: best of 2 / 4 contiguous segments
+    best_k, best_peak = 0, peak
+    for k in (2, 4):
+        if len(ir.ops) >= k:
+            p = _segment_peak(ir, sizes, k)
+            if p < best_peak:
+                best_k, best_peak = k, p
+    rep.recompute_best_segments = best_k
+    rep.recompute_savings_bytes = max(0, peak - best_peak)
+
+    # amp savings: intermediates' 4-byte floats at half width
+    amp_sizes = dict(sizes)
+    for u, a in env.items():
+        if u in ir.initial_env:
+            continue
+        if np.dtype(a.dtype) in (_F32, _F64):
+            amp_sizes[u] = sizes[u] // 2
+    amp_peak, _ = _peak(ranges, amp_sizes, len(ir.ops))
+    rep.amp_savings_bytes = max(0, peak - amp_peak)
+    return rep
+
+
+def check_memory(ir: ProgramIR, env: Dict[int, jax.ShapeDtypeStruct],
+                 budget_bytes: Optional[int] = None,
+                 ) -> Tuple[List[Finding], MemoryReport]:
+    rep = estimate_memory(ir, env, budget_bytes)
+    findings: List[Finding] = []
+    if budget_bytes is not None and rep.peak_bytes > budget_bytes:
+        at = (ir.ops[rep.peak_index].name
+              if 0 <= rep.peak_index < len(ir.ops) else "?")
+        findings.append(Finding(
+            "PT610", "error", f"program:{ir.name}", rep.peak_index + 1, 0,
+            f"predicted peak memory {rep.peak_bytes / (1 << 20):.1f} MiB "
+            f"exceeds the device budget "
+            f"{budget_bytes / (1 << 20):.1f} MiB (peak at op "
+            f"#{rep.peak_index} '{at}'; recompute_pass would save "
+            f"{rep.recompute_savings_bytes / (1 << 20):.1f} MiB, "
+            f"amp_insertion "
+            f"{rep.amp_savings_bytes / (1 << 20):.1f} MiB)",
+            line_text=at))
+    try:
+        from ...profiler import metrics as _metrics
+
+        _metrics.set_gauge("analysis/peak_bytes", rep.peak_bytes)
+    except Exception:
+        pass
+    return findings, rep
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                      ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def render_memory_report(rep: MemoryReport, top: int = 12) -> str:
+    lines = [f"memory report — {rep.name}",
+             f"  peak live set : {_fmt_bytes(rep.peak_bytes)} "
+             f"(at op #{rep.peak_index})",
+             f"  externals     : {_fmt_bytes(rep.external_bytes)}   "
+             f"feeds: {_fmt_bytes(rep.feed_bytes)}   "
+             f"fetches: {_fmt_bytes(rep.fetch_bytes)}",
+             f"  total flops   : {rep.total_flops:,}"]
+    if rep.budget_bytes is not None:
+        verdict = "OVER" if rep.peak_bytes > rep.budget_bytes else "ok"
+        lines.append(f"  budget        : "
+                     f"{_fmt_bytes(rep.budget_bytes)} [{verdict}]")
+    if rep.recompute_best_segments:
+        lines.append(
+            f"  recompute_pass(num_segments="
+            f"{rep.recompute_best_segments}) would save "
+            f"{_fmt_bytes(rep.recompute_savings_bytes)}")
+    lines.append(f"  amp_insertion would save "
+                 f"{_fmt_bytes(rep.amp_savings_bytes)}")
+    rows = sorted(rep.per_op, key=lambda r: -r["live_bytes"])[:top]
+    if rows:
+        lines.append("  hottest ops (live bytes | flops | "
+                     "arith intensity):")
+        for r in rows:
+            lines.append(
+                f"    #{r['index']:<4d} {r['name']:<28s} "
+                f"{_fmt_bytes(r['live_bytes']):>10s}  "
+                f"{r['flops']:>14,}  {r['intensity']:8.1f}")
+    return "\n".join(lines)

@@ -1,0 +1,138 @@
+"""Pass-equivalence verification (PT63x) — the safety net under
+``PassManager.run(program, verify=True)``.
+
+A Program pass is a structural rewrite of the recorded op list; the
+contract every shipped pass (``dead_op_elimination``,
+``constant_folding``, ``fuse_chain``, ``amp_insertion``,
+``recompute_pass``) must honor is that **fetchable values keep their
+shapes and dtypes**.  ``verify_pass`` snapshots the program's abstract
+signature (fetch uid -> ShapeDtypeStruct via the shared dataflow core,
+plus the producer/consumer graph), runs the pass, re-snapshots, and
+raises ``PassVerificationError`` on any fetch-signature change — before
+a broken rewrite ever reaches ``Executor.run`` on hardware.
+
+The structural diff (ops added/removed per name, edge count) is kept on
+the returned ``VerifyReport`` for tooling; it is informational — passes
+are *supposed* to restructure the graph — only the fetch signature is
+load-bearing.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .dataflow import abstract_run
+from .ir import ProgramIR
+
+__all__ = ["PassVerificationError", "VerifyReport", "program_signature",
+           "verify_pass"]
+
+
+class PassVerificationError(RuntimeError):
+    """A Program pass changed the shape/dtype (or producibility) of a
+    fetchable value.  ``diffs`` lists one human-readable line per
+    violated fetch uid."""
+
+    def __init__(self, pass_name: str, diffs: List[str]):
+        self.pass_name = pass_name
+        self.diffs = list(diffs)
+        super().__init__(
+            f"pass '{pass_name}' is not equivalence-preserving:\n  "
+            + "\n  ".join(diffs))
+
+
+@dataclass
+class Signature:
+    fetch: Dict[int, Optional[Tuple[Tuple[int, ...], str]]]
+    op_names: Counter
+    n_edges: int
+    eval_errors: int
+
+
+@dataclass
+class VerifyReport:
+    pass_name: str
+    ops_before: int = 0
+    ops_after: int = 0
+    added: Counter = field(default_factory=Counter)
+    removed: Counter = field(default_factory=Counter)
+    edges_before: int = 0
+    edges_after: int = 0
+
+    def summary(self) -> str:
+        def fmt(c):
+            return ", ".join(f"{n}×{k}" if n > 1 else k
+                             for k, n in sorted(c.items())) or "-"
+
+        return (f"{self.pass_name}: {self.ops_before} -> "
+                f"{self.ops_after} ops (added {fmt(self.added)}; "
+                f"removed {fmt(self.removed)})")
+
+
+def program_signature(program, feed_spec=None,
+                      name: str = "program") -> Signature:
+    """Abstract signature of a Program: fetch uid -> (shape, dtype)
+    (None when the uid is unproducible at abstract level), plus the
+    structural fingerprint used for the informational diff."""
+    ir = ProgramIR(program, feed_spec=feed_spec, name=name)
+    env, findings = abstract_run(ir)
+    fetch = {}
+    for u in ir.fetch_uids:
+        aval = env.get(u)
+        fetch[u] = ((tuple(aval.shape), str(aval.dtype))
+                    if aval is not None else None)
+    n_edges = sum(len(v) for v in ir.consumers.values())
+    return Signature(fetch=fetch,
+                     op_names=Counter(op.name for op in ir.ops),
+                     n_edges=n_edges,
+                     eval_errors=sum(1 for f in findings
+                                     if f.rule_id == "PT601"))
+
+
+def verify_pass(program, pass_fn: Callable, feed_spec=None,
+                pass_name: Optional[str] = None) -> VerifyReport:
+    """Run ``pass_fn(program)`` under equivalence verification.
+
+    Raises PassVerificationError when any fetch target's abstract
+    shape/dtype changes (PT630) or becomes unproducible (PT631).  With
+    no fetch targets recorded there is nothing load-bearing to compare
+    — the pass runs unverified (mirroring dead_op_elimination's own
+    no-roots behavior) and the report notes it.
+    """
+    pname = pass_name or getattr(pass_fn, "__name__", str(pass_fn))
+    before = program_signature(program, feed_spec)
+    pass_fn(program)
+    after = program_signature(program, feed_spec)
+
+    rep = VerifyReport(
+        pass_name=pname,
+        ops_before=sum(before.op_names.values()),
+        ops_after=sum(after.op_names.values()),
+        added=after.op_names - before.op_names,
+        removed=before.op_names - after.op_names,
+        edges_before=before.n_edges, edges_after=after.n_edges)
+
+    diffs: List[str] = []
+    for u, sig_b in before.fetch.items():
+        if sig_b is None:
+            continue          # was already unproducible; nothing to hold
+        sig_a = after.fetch.get(u)
+        if sig_a is None:
+            diffs.append(
+                f"[PT631] fetch uid {u} {sig_b[1]}{list(sig_b[0])} is no "
+                f"longer producible after the pass")
+        elif sig_a != sig_b:
+            diffs.append(
+                f"[PT630] fetch uid {u} changed "
+                f"{sig_b[1]}{list(sig_b[0])} -> "
+                f"{sig_a[1]}{list(sig_a[0])}")
+    if diffs:
+        try:
+            from ...profiler import metrics as _metrics
+
+            _metrics.inc("analysis/verify_failures")
+        except Exception:
+            pass
+        raise PassVerificationError(pname, diffs)
+    return rep

@@ -296,11 +296,27 @@ class _CommRecord:
             self.task.attach(value)
 
 
-def _track(op_name, group, tensor=None) -> _CommRecord:
+def _track(op_name, group, tensor=None, peer=None) -> _CommRecord:
     """Instrument this collective (always) and register it with the
     desync watchdog when enabled (reference:
     CommTaskManager::CommTaskEnqueue, comm_task_manager.h)."""
     g = group or _get_default_group()
+    # IR-level collective log: while a static Program is recording,
+    # every collective's resolved group/axis/peer is appended to the
+    # program's collective_meta — ptprog's PT62x consistency pass reads
+    # this (closure recovery is its fallback), and it is the ONLY place
+    # eager p2p sends/recvs (which never become op entries) are visible
+    # to analysis.
+    from ..core.dispatch import _ProgramRecorder
+
+    rec = _ProgramRecorder.active
+    if rec is not None:
+        meta = getattr(rec, "collective_meta", None)
+        if meta is None:
+            meta = rec.collective_meta = []
+        meta.append({"op": op_name, "gid": g.id,
+                     "ranks": tuple(g.ranks), "axis": g.axis_name,
+                     "peer": peer, "op_index": len(rec.ops)})
     task = None
     if comm_task_manager.enabled:
         shape = dtype = None
@@ -534,7 +550,7 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    ct = _track("broadcast", group, tensor)
+    ct = _track("broadcast", group, tensor, peer=src)
     g = group or _get_default_group()
     tp = _eager_tp(tensor, g)
     if tp is not None:
@@ -595,7 +611,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     g = group or _get_default_group()
     tp = _eager_tp(tensor, g)
     if tp is not None:
-        ct = _track("reduce", group, tensor)
+        ct = _track("reduce", group, tensor, peer=dst)
         tensor.set_value(tp.reduce(_np(tensor), op, dst, g.ranks, g.id))
         if ct is not None:
             ct.mark_done()
@@ -610,7 +626,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         if tensor_list:
             tensor.set_value(tensor_list[0])
         return Task(tensor)
-    ct = _track("scatter", g, tensor)
+    ct = _track("scatter", g, tensor, peer=src)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         parts = [_np(t) for t in tensor_list] \
@@ -671,7 +687,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     the peer (reference ProcessGroup::Send, process_group.h:162). Eager
     single-process: local buffer (world of 1)."""
     g = group or _get_default_group()
-    ct = _track("send", g, tensor)
+    ct = _track("send", g, tensor, peer=dst)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         tp.send(_np(tensor), dst, channel=f"p2p:{g.id}")
@@ -684,7 +700,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 def recv(tensor, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
-    ct = _track("recv", g, tensor)
+    ct = _track("recv", g, tensor, peer=src)
     tp = _eager_tp(tensor, g)
     if tp is not None:
         tensor.set_value(tp.recv(src, channel=f"p2p:{g.id}"))

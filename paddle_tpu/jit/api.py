@@ -427,6 +427,38 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     return deco
 
 
+def capture_program(fn, input_spec, name_prefix: str = "x"):
+    """Record ``fn``'s op stream into a fresh ``static.Program`` for
+    IR-level analysis (ptprog: ``python -m paddle_tpu.analysis
+    --program``), without compiling or executing a replay.
+
+    ``input_spec`` is a list of InputSpecs (or (shape, dtype) tuples);
+    each becomes a registered feed placeholder, so the analyzer knows
+    the feed signature.  Returns the recorded Program with ``fn``'s
+    tensor outputs appended as fetch targets.  This is the
+    ``@to_static`` capture surface exposed as data: the same define-by-
+    run recording ``program_guard`` does, shaped for pre-flight checks
+    (shape/dtype dataflow, peak-memory, collective consistency) rather
+    than for Executor replay.
+    """
+    from .. import static as _static
+
+    main = _static.Program()
+    with _static.program_guard(main, _static.Program()):
+        ins = []
+        for i, spec in enumerate(input_spec):
+            if isinstance(spec, (tuple, list)):
+                spec = InputSpec(spec[0], spec[1] if len(spec) > 1
+                                 else "float32")
+            ins.append(_static.data(spec.name or f"{name_prefix}{i}",
+                                    spec.shape, spec.dtype))
+        out = fn(*ins)
+    for t in jax.tree.leaves(out, is_leaf=lambda x: isinstance(x, Tensor)):
+        if isinstance(t, Tensor):
+            main.fetch_targets.append(t)
+    return main
+
+
 def not_to_static(fn):
     return fn
 

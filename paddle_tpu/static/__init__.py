@@ -47,6 +47,9 @@ class Program:
         self.feed_targets = {}        # name -> placeholder Tensor
         self.fetch_targets = []
         self.ops = []                 # recorded op entries
+        self.collective_meta = []     # group/axis/peer per collective
+        #                               (written by distributed.collective
+        #                               while recording; read by ptprog)
         self._live = {}               # uid -> Tensor, EXTERNAL inputs only
         #                               (params/constants, read fresh at
         #                               run time); intermediates are keyed

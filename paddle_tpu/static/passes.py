@@ -43,9 +43,36 @@ class PassManager:
         self.passes.append(get_pass(p) if isinstance(p, str) else p)
         return self
 
-    def run(self, program):
+    def run(self, program, verify: bool = False, feed_spec=None):
+        """Run the registered passes in order over ``program``.
+
+        With ``verify=True`` every pass runs under the ptprog
+        pass-equivalence verifier
+        (``paddle_tpu.analysis.program.verify_pass``): the program's
+        abstract fetch signature — shape and dtype of every fetch
+        target, computed by ``jax.eval_shape`` dataflow over the
+        recorded op list — is snapshotted before and after the pass,
+        and any change raises ``PassVerificationError`` *before* the
+        broken rewrite can reach ``Executor.run`` (the PIR
+        pass-manager's IR-verification analog).  Structural diffs
+        (ops added/removed per pass) are collected on
+        ``self.verify_reports`` for inspection.  ``feed_spec``
+        optionally overrides feed shapes/dtypes for the abstract
+        evaluation (``{name: ShapeDtypeStruct-like}``); by default the
+        recorded placeholder specs are used.  Verification compares
+        fetch targets only — a program with no fetch targets verifies
+        vacuously (mirroring dead_op_elimination's no-roots no-op).
+        """
+        if not verify:
+            for p in self.passes:
+                p(program)
+            return program
+        from ..analysis.program import verify_pass
+
+        self.verify_reports = []
         for p in self.passes:
-            p(program)
+            self.verify_reports.append(
+                verify_pass(program, p, feed_spec=feed_spec))
         return program
 
 
@@ -225,10 +252,17 @@ def fuse_chain(program, names, fused_name=None):
         for u in entry[4]:
             consumers.setdefault(u, []).append(idx)
 
+    # control-flow entries are fusion barriers: collapsing a
+    # RegionEntry into a composed fn would hide its sub-programs from
+    # every region-aware pass (and from ptprog's region recursion)
+    def _fusable(entry):
+        return not getattr(entry, "regions", None)
+
     used = set()            # op indices already claimed by a chain
     chains = []
     for start in range(len(ops)):
-        if start in used or ops[start][0] != names[0]:
+        if start in used or ops[start][0] != names[0] \
+                or not _fusable(ops[start]):
             continue
         chain = [start]
         ok = True
@@ -240,7 +274,8 @@ def fuse_chain(program, names, fused_name=None):
                 break
             cons = consumers.get(outs[0], [])
             if len(cons) != 1 or cons[0] in used \
-                    or ops[cons[0]][0] != names[k]:
+                    or ops[cons[0]][0] != names[k] \
+                    or not _fusable(ops[cons[0]]):
                 ok = False
                 break
             chain.append(cons[0])

@@ -690,3 +690,77 @@ def test_cli_standalone_no_jax(tmp_path):
          str(good), "--no-baseline"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_sarif_reporter_emits_valid_results(tmp_path):
+    rep = lint(tmp_path, VARLEN_PREFIX_BUG)
+    doc = json.loads(engine.render_sarif(rep))
+    assert doc["version"] == "2.1.0"
+    run0 = doc["runs"][0]
+    assert run0["tool"]["driver"]["name"] == "ptlint"
+    got = {r["ruleId"] for r in run0["results"]}
+    assert {"PT301", "PT302"} <= got
+    r0 = run0["results"][0]
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] >= 1
+    assert loc["artifactLocation"]["uri"].endswith(".py")
+    # every emitted result's rule is described in the driver
+    described = {ru["id"] for ru in run0["tool"]["driver"]["rules"]}
+    assert got <= described
+
+
+def test_sarif_marks_baselined_as_suppressed(tmp_path):
+    src = """
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def step(x):
+            print(x)
+            return x
+    """
+    base = tmp_path / engine.BASELINE_NAME
+    base.write_text('{"entries": []}')
+    rep = lint(tmp_path, src)
+    engine.write_baseline(str(base), rep.findings)
+    rep2 = lint(tmp_path, src, baseline=str(base))
+    doc = json.loads(engine.render_sarif(rep2))
+    results = doc["runs"][0]["results"]
+    assert results and all("suppressions" in r for r in results)
+
+
+def test_update_baseline_prunes_stale_entries(tmp_path):
+    """The staleness check used to only warn; --update-baseline now
+    rewrites the baseline keeping exactly the entries that still match
+    a live finding."""
+    from paddle_tpu.analysis.main import main
+
+    src = """
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def step(x):
+            print(x)
+            return x
+    """
+    base = tmp_path / engine.BASELINE_NAME
+    base.write_text('{"entries": []}')
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent(src))
+    rep = engine.run([str(mod)])
+    assert ids(rep) == ["PT101"]
+    # baseline = the live finding + a stale one for code long since fixed
+    engine.write_baseline(str(base), rep.findings)
+    data = json.loads(base.read_text())
+    data["entries"].append({"id": "PT101", "path": "gone.py",
+                            "context": "print(y)"})
+    base.write_text(json.dumps(data))
+    assert sum(engine.load_baseline(str(base)).values()) == 2
+
+    rc = main([str(mod), "--baseline", str(base), "--update-baseline"])
+    assert rc == 0
+    kept = engine.load_baseline(str(base))
+    assert sum(kept.values()) == 1
+    assert all(path != "gone.py" for (_rid, path, _ctx) in kept)
+    # and the pruned baseline still grandfathers the live finding
+    rep2 = engine.run([str(mod)], baseline=str(base))
+    assert ids(rep2) == [] and len(rep2.baselined) == 1

@@ -1,0 +1,465 @@
+"""ptprog — IR-level Program analyzer (PT6xx) unit tests.
+
+Covers the four passes against seeded-bug fixtures: the dtype verifier
+must catch a broken AMP cast, the memory estimator must agree with a
+concrete replay's live-set accounting to 10%, the collective checker
+must flag group/mesh mismatches and unmatched pipeline send/recv
+pairs, and the pass-equivalence verifier must reject a deliberately
+broken pass while passing all five shipped passes.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.analysis import engine
+from paddle_tpu.analysis.program import (
+    PassVerificationError, ProgramIR, analyze, capture_mlp,
+    check_collectives, check_dataflow, check_memory, check_pipeline,
+    estimate_memory, verify_pass)
+from paddle_tpu.analysis.program.dataflow import abstract_run
+from paddle_tpu.static.passes import (PassManager, amp_insertion,
+                                      recompute_pass)
+
+
+def _mlp_program():
+    cap = capture_mlp()
+    return cap.program
+
+
+def _rule_ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# dataflow (PT60x)
+# ---------------------------------------------------------------------------
+
+def test_clean_program_has_no_findings():
+    prog = _mlp_program()
+    ir = ProgramIR(prog, name="mlp")
+    env, findings = check_dataflow(ir)
+    assert findings == []
+    # every recorded uid resolved to an abstract value
+    for op in ir.ops:
+        for u in op.out_uids:
+            assert u in env
+
+
+def test_dtype_verifier_catches_seeded_amp_cast_bug():
+    """Acceptance fixture: amp_insertion, then one input cast dropped —
+    the matmul silently consumes bf16 x fp32.  jax promotes without
+    complaint at runtime; the dataflow pass must flag it."""
+    prog = _mlp_program()
+    amp_insertion(prog, dtype="bfloat16")
+    # find a cast_bfloat16 entry and the op consuming its output
+    cast_idx = next(i for i, e in enumerate(prog.ops)
+                    if e[0] == "cast_bfloat16")
+    cast = prog.ops[cast_idx]
+    cast_in, cast_out = cast[4][0], cast[7][0]
+    rewired = False
+    for i, e in enumerate(prog.ops):
+        if cast_out in e[4]:
+            new_in = [cast_in if u == cast_out else u for u in e[4]]
+            prog.ops[i] = e[:4] + (new_in,) + e[5:]
+            rewired = True
+    assert rewired
+    del prog.ops[cast_idx]
+
+    _env, findings = check_dataflow(ProgramIR(prog, name="amp_bug"))
+    assert "PT602" in _rule_ids(findings), findings
+    msg = next(f for f in findings if f.rule_id == "PT602").message
+    assert "bfloat16" in msg and "float32" in msg
+
+
+def test_dataflow_flags_infermeta_failure_once():
+    """Rewiring the second matmul to the wrong weight makes eval_shape
+    raise; exactly one PT601 for the root cause, downstream ops are
+    skipped without cascading findings."""
+    prog = _mlp_program()
+    mm = [i for i, e in enumerate(prog.ops) if e[0] == "matmul"]
+    w1_uid = prog.ops[mm[0]][4][1]
+    e = prog.ops[mm[1]]
+    prog.ops[mm[1]] = e[:4] + ([e[4][0], w1_uid],) + e[5:]
+
+    _env, findings = check_dataflow(ProgramIR(prog, name="badshape"))
+    assert _rule_ids(findings).count("PT601") == 1, findings
+    assert "matmul" in findings[0].message
+
+
+def test_cast_tag_contradiction_detected():
+    prog = _mlp_program()
+    amp_insertion(prog, dtype="bfloat16")
+    idx = next(i for i, e in enumerate(prog.ops)
+               if e[0] == "cast_bfloat16")
+    e = prog.ops[idx]
+    prog.ops[idx] = e[:1] + (lambda a: jnp.asarray(a),) + e[2:]
+
+    _env, findings = check_dataflow(ProgramIR(prog, name="badcast"))
+    assert "PT603" in _rule_ids(findings), findings
+
+
+def test_dead_op_detected():
+    prog = _mlp_program()
+    with static.program_guard(prog, static.Program()):
+        x2 = static.data("x2", (4, 4), "float32")
+        _unused = paddle.exp(x2)            # never consumed nor fetched
+    _env, findings = check_dataflow(ProgramIR(prog, name="dead"))
+    dead = [f for f in findings if f.rule_id == "PT604"]
+    assert len(dead) == 1 and "exp" in dead[0].message
+
+
+def test_dataflow_recurses_into_regions():
+    """Control-flow sub-programs (the PIR Region analog) are analyzed
+    too: a dead op inside a cond branch is found."""
+    from paddle_tpu.jit.dy2static import _record_cond_region
+
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        x = static.data("x", (4,), "float32")
+        pred = paddle.to_tensor(np.asarray(True))
+
+        def true_fn(v):
+            _dead = paddle.exp(v)          # dead inside the region
+            return v * 2.0
+
+        def false_fn(v):
+            return v * 3.0
+
+        out = _record_cond_region(pred, true_fn, false_fn, [x])
+    prog.fetch_targets.append(out[0])
+    _env, findings = check_dataflow(ProgramIR(prog, name="regions"))
+    dead = [f for f in findings if f.rule_id == "PT604"]
+    assert any("exp" in f.message for f in dead), findings
+
+
+# ---------------------------------------------------------------------------
+# memory (PT61x)
+# ---------------------------------------------------------------------------
+
+def _replay_accounting(prog, feed):
+    """Concrete replay with explicit free-after-last-use: the ground
+    truth the estimator is pinned against.  Returns peak bytes over the
+    op sequence of (externals + feeds + live intermediates)."""
+    uid_of = type(prog)._uid
+    last = {}
+    for i, e in enumerate(prog.ops):
+        for u in e[4]:
+            last[u] = i
+    n = len(prog.ops)
+    for t in prog.fetch_targets:
+        last[uid_of(t)] = n - 1
+
+    env = {}
+    for name, t in prog.feed_targets.items():
+        env[uid_of(t)] = jnp.asarray(feed[name])
+    for u, t in prog._live.items():
+        env.setdefault(u, t._value)
+
+    def live_bytes():
+        return sum(np.dtype(v.dtype).itemsize * int(np.prod(v.shape))
+                   if v.shape else np.dtype(v.dtype).itemsize
+                   for v in env.values())
+
+    peak = live_bytes()
+    for i, (name, fn, entry_flat, tpos, in_uids, treedef, out_pos,
+            out_uids) in enumerate(e[:8] for e in prog.ops):
+        flat2 = list(entry_flat)
+        for j, u in zip(tpos, in_uids):
+            flat2[j] = env[u]
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        out = fn(*a2, **k2)
+        leaves = jax.tree_util.tree_leaves(out)
+        for pos, u in zip(out_pos, out_uids):
+            env[u] = leaves[pos]
+        peak = max(peak, live_bytes())
+        for u in [u for u, d in last.items() if d == i]:
+            env.pop(u, None)
+    return peak
+
+
+def test_peak_memory_matches_replay_accounting_within_10pct():
+    prog = _mlp_program()
+    ir = ProgramIR(prog, name="mlp")
+    env, findings = abstract_run(ir)
+    assert not findings
+    rep = estimate_memory(ir, env)
+
+    feed = {"x": np.random.RandomState(0).randn(8, 64).astype(np.float32)}
+    actual = _replay_accounting(prog, feed)
+    assert actual > 0
+    assert abs(rep.peak_bytes - actual) <= 0.10 * actual, \
+        (rep.peak_bytes, actual)
+
+
+def test_memory_budget_violation_is_pt610():
+    prog = _mlp_program()
+    ir = ProgramIR(prog, name="mlp")
+    env, _ = abstract_run(ir)
+    findings, rep = check_memory(ir, env, budget_bytes=1024)
+    assert _rule_ids(findings) == ["PT610"]
+    assert "recompute_pass would save" in findings[0].message
+    ok_findings, _ = check_memory(ir, env, budget_bytes=1 << 30)
+    assert ok_findings == []
+
+
+def test_memory_report_quantifies_amp_and_recompute_savings():
+    # a deeper chain so segmentation has something to free
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (64, 64), "float32")
+        h = x
+        for _ in range(8):
+            h = paddle.exp(h * 0.5)
+    main.fetch_targets.append(h)
+    ir = ProgramIR(main, name="chain")
+    env, _ = abstract_run(ir)
+    rep = estimate_memory(ir, env)
+    assert rep.amp_savings_bytes > 0
+    assert rep.recompute_savings_bytes >= 0
+    assert rep.total_flops > 0
+    # roofline rows exist for every op with monotone indices
+    assert [r["index"] for r in rep.per_op] == list(range(len(main.ops)))
+
+
+def test_cost_model_static_estimate_wires_through():
+    from paddle_tpu.cost_model import CostModel, op_flops
+
+    prog = _mlp_program()
+    rep = CostModel().static_estimate(prog)
+    assert rep.peak_bytes > 0 and rep.total_flops > 0
+    s = jax.ShapeDtypeStruct
+    assert op_flops("matmul", [s((8, 64), np.float32),
+                               s((64, 128), np.float32)],
+                    [s((8, 128), np.float32)]) == 2 * 8 * 128 * 64
+
+
+# ---------------------------------------------------------------------------
+# collectives (PT62x)
+# ---------------------------------------------------------------------------
+
+def _one_dev_mesh(*axes):
+    from jax.sharding import Mesh
+
+    shape = (1,) * len(axes)
+    return Mesh(np.array(jax.devices()[:1]).reshape(shape), axes)
+
+
+def test_collective_group_axis_checked_against_mesh():
+    from paddle_tpu.distributed import collective as coll
+
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        g = coll.new_group([0], axis_name="mp")
+        coll.all_reduce(t, group=g)
+    assert prog.collective_meta, "recorder must log the collective"
+
+    ir = ProgramIR(prog, name="coll")
+    bad = check_collectives(ir, mesh=_one_dev_mesh("dp"))
+    assert "PT620" in _rule_ids(bad), bad
+    ok = check_collectives(ir, mesh=_one_dev_mesh("dp", "mp"))
+    assert ok == [], ok
+
+
+def test_collective_rank_outside_world_is_pt621():
+    from paddle_tpu.distributed import collective as coll
+
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        g = coll.new_group([0, 5], axis_name="dp")
+        coll.all_reduce(t, group=g)
+    bad = check_collectives(ProgramIR(prog, name="coll"),
+                            mesh=_one_dev_mesh("dp"))
+    assert "PT621" in _rule_ids(bad), bad
+
+
+def test_closure_fallback_sees_dynamically_built_group():
+    """Without the recorder log (older captures), the group is still
+    recovered from the recorded fn's closure — the state AST-level
+    PT2xx structurally cannot see."""
+    from paddle_tpu.distributed import collective as coll
+
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        g = coll.new_group([0], axis_name="sep")
+        coll.all_reduce(t, group=g)
+    prog.collective_meta = []          # simulate a pre-log capture
+    ir = ProgramIR(prog, name="closure")
+    assert ir.collectives and ir.collectives[0]["axis"] == "sep"
+    bad = check_collectives(ir, mesh=_one_dev_mesh("dp"))
+    assert "PT620" in _rule_ids(bad), bad
+
+
+def _p2p_stage(send_to=(), recv_from=(), group=None):
+    from paddle_tpu.distributed import collective as coll
+
+    prog = static.Program()
+    with static.program_guard(prog, static.Program()):
+        t = paddle.to_tensor(np.ones((2,), np.float32))
+        for dst in send_to:
+            coll.send(t, dst=dst, group=group)
+        for src in recv_from:
+            coll.recv(t, src=src, group=group)
+    return prog
+
+
+def test_pipeline_send_recv_pairs_match():
+    from paddle_tpu.distributed import collective as coll
+
+    g = coll.new_group([0, 1], axis_name="pp")
+    p0 = _p2p_stage(send_to=[1], group=g)
+    p1 = _p2p_stage(recv_from=[0], group=g)
+    assert check_pipeline([p0, p1]) == []
+
+    # stage 0 sends twice, stage 1 posts one recv: deadlock
+    p0b = _p2p_stage(send_to=[1, 1], group=g)
+    findings = check_pipeline([p0b, p1])
+    assert _rule_ids(findings) == ["PT623"]
+    assert "surplus send" in findings[0].message
+
+    # recv with no matching send blocks forever
+    findings = check_pipeline([_p2p_stage(group=g),
+                               _p2p_stage(recv_from=[0], group=g)])
+    assert _rule_ids(findings) == ["PT623"]
+    assert "blocks forever" in findings[0].message
+
+
+def test_p2p_peer_outside_group_is_pt622():
+    from paddle_tpu.distributed import collective as coll
+
+    g = coll.new_group([0, 1], axis_name="pp")
+    prog = _p2p_stage(send_to=[3], group=g)
+    bad = check_collectives(ProgramIR(prog, name="p2p"))
+    assert "PT622" in _rule_ids(bad), bad
+
+
+# ---------------------------------------------------------------------------
+# pass equivalence (PT63x) — PassManager.run(verify=True)
+# ---------------------------------------------------------------------------
+
+def test_verify_accepts_all_five_shipped_passes():
+    from paddle_tpu.analysis.program.analyze import shipped_passes
+
+    for pname, p in shipped_passes():
+        prog = _mlp_program()
+        rep = verify_pass(prog, p, pass_name=pname)
+        assert rep.pass_name == pname
+
+
+def test_verify_rejects_pass_that_changes_fetch_dtype():
+    def evil_downcast(program):
+        e = program.ops[-1]
+        orig = e[1]
+        new_fn = lambda *a, **k: jnp.asarray(   # noqa: E731
+            orig(*a, **k), jnp.bfloat16)
+        program.ops[-1] = e[:1] + (new_fn,) + e[2:]
+        program._compiled.clear()
+        return program
+
+    prog = _mlp_program()
+    with pytest.raises(PassVerificationError) as ei:
+        verify_pass(prog, evil_downcast)
+    assert "PT630" in str(ei.value)
+
+
+def test_verify_rejects_pass_that_drops_fetch_producer():
+    def evil_truncate(program):
+        program.ops = program.ops[:-1]
+        program._compiled.clear()
+        return program
+
+    prog = _mlp_program()
+    with pytest.raises(PassVerificationError) as ei:
+        verify_pass(prog, evil_truncate)
+    assert "PT631" in str(ei.value)
+
+
+def test_pass_manager_verify_mode_runs_and_rejects():
+    prog = _mlp_program()
+    pm = PassManager(["auto_parallel_amp", "auto_parallel_recompute"])
+    pm.run(prog, verify=True)
+    assert len(pm.verify_reports) == 2
+    assert all(r.ops_after >= 1 for r in pm.verify_reports)
+    # verified program still replays correctly
+    feed = np.random.RandomState(1).randn(8, 64).astype(np.float32)
+    ref_prog = _mlp_program()
+    exe = static.Executor()
+    ref = exe.run(ref_prog, feed={"x": feed},
+                  fetch_list=[ref_prog.fetch_targets[0]])[0]
+    got = exe.run(prog, feed={"x": feed},
+                  fetch_list=[prog.fetch_targets[0]])[0]
+    np.testing.assert_allclose(got, ref, atol=2e-2)
+
+    def broken(program):
+        program.ops = program.ops[:-1]
+        return program
+
+    pm2 = PassManager([broken])
+    with pytest.raises(PassVerificationError):
+        pm2.run(_mlp_program(), verify=True)
+
+
+# ---------------------------------------------------------------------------
+# analyze() driver, capture_program, reporters
+# ---------------------------------------------------------------------------
+
+def test_analyze_driver_end_to_end_clean():
+    cap = capture_mlp()
+    res = analyze(cap.program, name=cap.name, capture_fn=cap.capture_fn)
+    assert res.report.findings == []
+    assert res.memory is not None and res.memory.peak_bytes > 0
+    assert [v.pass_name for v in res.verify] == [
+        "dead_op_elimination", "constant_folding",
+        "fuse_chain[matmul,relu]", "amp_insertion", "recompute_pass"]
+
+
+def test_jit_capture_program_feeds_analyzer():
+    from paddle_tpu.jit import capture_program
+    from paddle_tpu.jit.api import InputSpec
+
+    def f(a):
+        return paddle.nn.functional.relu(paddle.matmul(a, a))
+
+    prog = capture_program(f, [InputSpec((8, 8), "float32", name="a")])
+    assert [e[0] for e in prog.ops] == ["matmul", "relu"]
+    assert prog.fetch_targets
+    res = analyze(prog, name="captured")
+    assert res.report.findings == []
+
+
+def test_sarif_reporter_round_trips_findings():
+    prog = _mlp_program()
+    ir = ProgramIR(prog, name="mlp")
+    env, _ = abstract_run(ir)
+    findings, _rep = check_memory(ir, env, budget_bytes=1)
+    report = engine.Report(files=1, findings=findings)
+    doc = json.loads(engine.render_sarif(report, tool_name="ptprog"))
+    run0 = doc["runs"][0]
+    assert run0["tool"]["driver"]["name"] == "ptprog"
+    assert len(run0["results"]) == 1
+    r = run0["results"][0]
+    assert r["ruleId"] == "PT610" and r["level"] == "error"
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "program:mlp"
+    ids = [ru["id"] for ru in run0["tool"]["driver"]["rules"]]
+    assert "PT610" in ids
+
+
+def test_program_findings_honor_baseline(tmp_path):
+    prog = _mlp_program()
+    ir = ProgramIR(prog, name="mlp")
+    env, _ = abstract_run(ir)
+    findings, _rep = check_memory(ir, env, budget_bytes=1)
+    base = tmp_path / engine.BASELINE_NAME
+    engine.write_baseline(str(base), findings)
+    res = analyze(prog, name="mlp", budget_bytes=1, baseline=str(base))
+    assert res.report.findings == []
+    assert len(res.report.baselined) == 1

@@ -1,0 +1,40 @@
+"""CI gate: the full ptprog suite over the shipped model captures must
+be clean — the IR-level mirror of test_ptlint_clean.py.
+
+All four analysis passes run over each preset capture (the small MLP
+and the llama-block Program); zero non-baselined findings means every
+recorded op abstractly evaluates, no mixed-precision leaks, no dead
+ops, collectives are mesh-consistent, and all five shipped Program
+passes are equivalence-preserving.  The acceptance budget (< 10 s on a
+CPU for the llama-block capture, analysis only) is asserted too.
+"""
+import time
+
+import pytest
+
+from paddle_tpu.analysis.program import PRESETS, analyze
+
+
+@pytest.mark.parametrize("preset", ["mlp", "llama-block"])
+def test_ptprog_clean_over_shipped_captures(preset):
+    cap = PRESETS[preset]()
+    t0 = time.perf_counter()
+    res = analyze(cap.program, name=cap.name, feed_spec=cap.feed_spec,
+                  mesh=cap.mesh, capture_fn=cap.capture_fn)
+    dt = time.perf_counter() - t0
+    msgs = "\n".join(f"{f.rule_id} {f.path}:{f.line} {f.message}"
+                     for f in res.report.findings)
+    assert not res.report.findings, "\n" + msgs
+    # the gate must actually have analyzed something
+    assert len(cap.program.ops) >= 3
+    assert res.memory is not None and res.memory.peak_bytes > 0
+    # all five shipped passes verified equivalence-preserving
+    assert len(res.verify) == 5, [v.pass_name for v in res.verify]
+    if preset == "llama-block":
+        assert dt < 10.0, f"llama-block analysis took {dt:.1f}s"
+
+
+def test_cli_program_mode_exit_code_clean():
+    from paddle_tpu.analysis.main import main
+
+    assert main(["--program", "mlp", "--format", "json"]) == 0

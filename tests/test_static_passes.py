@@ -202,3 +202,90 @@ def test_fuse_chain_single_pass_scales_linearly():
     for _ in range(n_pairs):
         ref = np.exp(ref * 0.001)
     np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# edge cases under PassManager.run(verify=True) — the ptprog
+# pass-equivalence verifier guards each transform
+# ---------------------------------------------------------------------------
+
+def test_fuse_chain_stops_at_region_boundaries():
+    """A control-flow RegionEntry is a fusion barrier: collapsing it
+    into a composed fn would hide its sub-programs from region-aware
+    passes.  The chain around it must survive unfused and the region
+    must keep its .regions."""
+    from paddle_tpu.jit.dy2static import _record_cond_region
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (4, 4), "float32")
+        h = paddle.nn.functional.relu(x)
+        out = _record_cond_region(
+            paddle.to_tensor(np.asarray(True)),
+            lambda v: v + 1.0, lambda v: v - 1.0, [h])[0]
+    main.fetch_targets.append(out)
+    names_before = _op_names(main)
+    assert "cond" in names_before
+
+    pm = PassManager([lambda p: fuse_chain(p, ["relu", "cond"])])
+    pm.run(main, verify=True)
+    assert _op_names(main) == names_before        # nothing fused
+    region_entry = next(e for e in main.ops if e[0] == "cond")
+    assert getattr(region_entry, "regions", None), \
+        "region children must survive the pass pipeline"
+    feed = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    got = _run(main, out, feed)
+    np.testing.assert_allclose(got, np.maximum(feed, 0) + 1.0, atol=1e-6)
+
+
+def test_amp_insertion_custom_white_and_black_lists():
+    """custom_white promotes an op into the bf16 set; custom_black
+    forces fp32 casts before it — both visible in the op list and both
+    equivalence-preserving under verify=True."""
+    feed = np.random.RandomState(7).randn(4, 8).astype(np.float32)
+
+    # relu is in neither default list: whitelisting it inserts bf16
+    # casts in front of it
+    main, x, out = _record_mlp()
+    main.fetch_targets.append(out)
+    ref = _run(main, out, feed)
+    pm = PassManager([lambda p: amp_insertion(
+        p, dtype="bfloat16", custom_white=("relu",))])
+    pm.run(main, verify=True)
+    relu_i = next(i for i, e in enumerate(main.ops) if e[0] == "relu")
+    feeders = {e[0] for e in main.ops
+               if set(e[7]) & set(main.ops[relu_i][4])}
+    assert any(n.startswith("cast_bfloat16") for n in feeders), \
+        _op_names(main)
+    np.testing.assert_allclose(_run(main, out, feed), ref, atol=2e-2)
+
+    # blacklisting relu instead forces an fp32 cast in front of it
+    main2, x2, out2 = _record_mlp()
+    main2.fetch_targets.append(out2)
+    pm2 = PassManager([lambda p: amp_insertion(
+        p, dtype="bfloat16", custom_black=("relu",))])
+    pm2.run(main2, verify=True)
+    relu_i = next(i for i, e in enumerate(main2.ops) if e[0] == "relu")
+    feeders = {e[0] for e in main2.ops
+               if set(e[7]) & set(main2.ops[relu_i][4])}
+    assert any(n.startswith("cast_fp32") for n in feeders), \
+        _op_names(main2)
+    np.testing.assert_allclose(_run(main2, out2, feed), ref, atol=2e-2)
+
+
+def test_recompute_pass_more_segments_than_ops():
+    """num_segments far above the op count degrades gracefully: empty
+    segments are dropped, every surviving segment wraps >= 1 op, and
+    the fetch signature is untouched (verify=True)."""
+    feed = np.random.RandomState(8).randn(4, 8).astype(np.float32)
+    main, x, out = _record_mlp()
+    main.fetch_targets.append(out)
+    ref = _run(main, out, feed)
+    n_ops = len(main.ops)
+
+    pm = PassManager([lambda p: recompute_pass(p, num_segments=10)])
+    pm.run(main, verify=True)
+    names = _op_names(main)
+    assert all(n.startswith("recompute::") for n in names), names
+    assert len(names) <= n_ops
+    np.testing.assert_allclose(_run(main, out, feed), ref, atol=1e-5)

@@ -8,8 +8,12 @@ when paddle_tpu isn't installed and without importing the framework
 
 Usage:
   python tools/ptlint.py paddle_tpu/
-  python tools/ptlint.py paddle_tpu/ --format json
+  python tools/ptlint.py paddle_tpu/ --format json     # or sarif
+  python tools/ptlint.py paddle_tpu/ --update-baseline # prune stale
   python tools/ptlint.py --list-rules
+
+For the IR-level Program analyzer (PT6xx, needs jax) use
+tools/ptprog.py / ``python -m paddle_tpu.analysis --program``.
 """
 import importlib.util
 import os

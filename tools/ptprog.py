@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""ptprog — IR-level Program analyzer CLI.
+
+Runs the four PT6xx analysis passes (shape/dtype dataflow, peak-memory
+estimation, collective consistency, pass equivalence) over a recorded
+``static.Program``.  Unlike ``tools/ptlint.py`` this needs jax: the
+dataflow core abstractly evaluates every recorded op entry with
+``jax.eval_shape``.
+
+Usage:
+  python tools/ptprog.py llama                      # preset capture
+  python tools/ptprog.py mlp --format json
+  python tools/ptprog.py llama --budget-gb 16 --memory-report
+  python tools/ptprog.py my_pkg.my_mod:make_program
+  python tools/ptprog.py --list-rules
+
+Equivalent to ``python -m paddle_tpu.analysis --program <target>``.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    from paddle_tpu.analysis.main import main
+
+    argv = sys.argv[1:]
+    # first positional (if any) is the program target
+    if argv and not argv[0].startswith("-") \
+            and "--program" not in argv:
+        argv = ["--program", argv[0]] + argv[1:]
+    elif "--program" not in argv and "--list-rules" not in argv:
+        argv = ["--program", "llama"] + argv
+    sys.exit(main(argv))

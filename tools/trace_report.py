@@ -60,6 +60,10 @@ KNOWN_METRICS = (
     "serving/preemptions", "serving/batch_occupancy",
     "serving/kv_cache_utilization", "serving/deadline_evictions",
     "serving/load_shed",
+    # IR-level program analyzer (paddle_tpu/analysis/program/)
+    "analysis/programs_analyzed", "analysis/ops_analyzed",
+    "analysis/findings", "analysis/peak_bytes",
+    "analysis/verify_failures",
 )
 
 
