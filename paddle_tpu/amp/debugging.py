@@ -24,6 +24,7 @@ __all__ = [
     "DebugMode",
     "TensorCheckerConfig",
     "check_numerics",
+    "nonfinite_counts",
     "enable_operator_stats_collection",
     "disable_operator_stats_collection",
     "collect_operator_stats",
@@ -63,6 +64,19 @@ def _leaf_stats(a):
         "max": float(f[finite].max()) if finite.any() else None,
         "mean": float(f[finite].mean()) if finite.any() else None,
     }
+
+
+def nonfinite_counts(value) -> tuple:
+    """(num_nan, num_inf) for any array-like (0, 0 for non-float data).
+
+    The shared finiteness probe: ``resilience.guards.StepGuard`` calls
+    this on losses/grad-norms so the training-loop numerical guard and
+    the per-op tensor checker agree on what "non-finite" means (bf16
+    via ml_dtypes included)."""
+    st = _leaf_stats(value)
+    if st is None:
+        return (0, 0)
+    return (st["num_nan"], st["num_inf"])
 
 
 def check_numerics(tensor, op_type="", var_name="",

@@ -70,6 +70,70 @@ def check_bare_except(mod):
                    "killed it; catch Exception (or narrower) instead")
 
 
+def _sleep_calls(loop) -> list:
+    """Constant-argument ``time.sleep`` calls lexically inside `loop`,
+    not nested in an inner function/class (those have their own loop
+    context). A sleep whose argument is an expression (e.g.
+    ``_backoff(attempt)``) is the sanctioned shape and is skipped."""
+    out = []
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "sleep" \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "time" \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _has_handler(loop) -> bool:
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Try):
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+@rule("PT503", "warning",
+      "constant time.sleep retry loop in distributed/ — use the "
+      "resilience.backoff helpers so retries back off exponentially")
+def check_constant_sleep_retry(mod):
+    """A loop that catches errors and re-tries after a CONSTANT
+    ``time.sleep`` hammers a dead peer at a fixed frequency — exactly
+    wrong while the elastic controller needs seconds to relaunch it.
+    ``resilience/backoff.py`` is the one sanctioned policy (the
+    transport redial and store connect paths go through it); a sleep
+    whose argument is computed (``time.sleep(_backoff(attempt))``) is
+    fine. Pure poll loops (no exception handler) are not flagged."""
+    if not _in_scope(mod):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.While, ast.For)):
+            continue
+        if not _has_handler(node):
+            continue
+        for call in _sleep_calls(node):
+            yield (call.lineno, call.col_offset,
+                   "retry loop sleeps a constant "
+                   f"{call.args[0].value!r}s between attempts — route "
+                   "it through resilience.backoff.delay/sleep_backoff "
+                   "(exponential, capped) so a dead peer being "
+                   "relaunched isn't hammered at a fixed frequency")
+
+
 @rule("PT502", "warning",
       "'except Exception: pass' in distributed/ — the error must be "
       "surfaced (raise/log) or counted (profiler metrics)")

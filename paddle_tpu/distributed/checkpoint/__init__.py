@@ -103,6 +103,20 @@ def save_state_dict(state_dict, path, process_group=None,
     # SIGTERMs workers) must never leave a truncated shard/metadata file
     # for the re-formed pod to load
     _atomic_dump(shards, os.path.join(path, shard_file))
+    # chaos site "save": between shard write and manifest publish — a
+    # kill here leaves exactly the torn (manifest-less) directory that
+    # resume discovery must skip
+    from ..resilience import faults as _faults
+
+    _faults.maybe_arm_from_env()
+    act = _faults.injector.on_event("save", rank)
+    if act is not None:
+        if act.kind == "kill":
+            os._exit(act.exit_code)
+        elif act.kind == "delay":
+            import time
+
+            time.sleep(act.delay_ms / 1e3)
     for key, metas in meta.state_dict_metadata.items():
         for m in metas:
             meta.storage_metadata[_index_key(key, m.global_offset)] = \

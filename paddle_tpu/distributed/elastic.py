@@ -88,6 +88,31 @@ class ElasticManager:
         known = self._last_members or list(range(self.min_nodes))
         return [r for r in known if r not in alive]
 
+    def wait_for_members(self, n: int,
+                         timeout: float = 60.0) -> List[int]:
+        """Block until at least `n` members have a fresh heartbeat (the
+        supervisor's re-form gate: survivors wait here for the killed
+        rank to be relaunched and rejoin). Returns the alive members;
+        raises TimeoutError naming who is missing when the group cannot
+        re-form within `timeout`."""
+        deadline = time.time() + timeout
+        members = self.alive_members()
+        while len(members) < n:
+            if time.time() > deadline:
+                missing = [r for r in range(self.max_nodes)
+                           if r not in members][:n - len(members)]
+                raise TimeoutError(
+                    f"elastic group did not re-form: {len(members)}/{n} "
+                    f"members alive after {timeout}s (waiting on ranks "
+                    f"{missing})")
+            time.sleep(min(self.interval, 0.2))
+            members = self.alive_members()
+        return members
+
+    def clear_restart(self):
+        """Acknowledge a membership change after a successful re-form."""
+        self.need_restart = False
+
     # -- heartbeat loop ----------------------------------------------------
     def _beat_once(self):
         """One heartbeat + membership check. Split out from the loop so

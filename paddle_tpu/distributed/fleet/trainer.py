@@ -12,10 +12,11 @@ transfers over 'pp').
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
@@ -175,6 +176,63 @@ class HybridTrainer:
             jnp.asarray(self.lr, jnp.float32),
             jnp.asarray(self.step_count, jnp.float32))
         return loss
+
+    # -- elastic supervisor wiring (distributed/resilience/supervisor) -----
+    def _flat_np(self, tree, prefix: str) -> Dict[str, np.ndarray]:
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return {prefix + jax.tree_util.keystr(kp):
+                np.asarray(jax.device_get(v)) for kp, v in leaves}
+
+    def elastic_state(self) -> Dict[str, np.ndarray]:
+        """Flat host-side state dict (params + Adam moments + step) —
+        the unit the elastic supervisor snapshots to its ring neighbor
+        and the disk tier."""
+        d = {**self._flat_np(self.params, "p:"),
+             **self._flat_np(self.opt_state["m"], "m:"),
+             **self._flat_np(self.opt_state["v"], "v:")}
+        d["step"] = np.asarray(self.step_count, np.int64)
+        return d
+
+    def load_elastic_state(self, state: Dict[str, np.ndarray]):
+        """Restore from ``elastic_state()`` output, device_put-ing every
+        leaf back onto its CURRENT NamedSharding — the reshard-on-load
+        path, so a snapshot taken under one topology restores under
+        another."""
+        def fill(tree, prefix):
+            kps, treedef = jax.tree_util.tree_flatten_with_path(tree)
+            shardings = jax.tree_util.tree_leaves(self.param_shardings)
+            new = []
+            for (kp, leaf), sh in zip(kps, shardings):
+                src = np.asarray(state[prefix + jax.tree_util.keystr(kp)])
+                new.append(jax.device_put(src.astype(leaf.dtype), sh))
+            return jax.tree_util.tree_unflatten(treedef, new)
+
+        self.params = fill(self.params, "p:")
+        self.opt_state = {"m": fill(self.opt_state["m"], "m:"),
+                          "v": fill(self.opt_state["v"], "v:")}
+        self.step_count = int(np.asarray(state["step"]))
+
+    def run_elastic(self, batch_fn: Callable, num_steps: int,
+                    config=None, **overrides):
+        """Drive this trainer under the self-healing supervisor:
+        `batch_fn(step) -> (input_ids, labels)` must be deterministic in
+        `step` so replay after a rollback/recovery converges. Returns
+        the supervisor's (final_state, report)."""
+        from ..resilience.supervisor import (SupervisorConfig,
+                                             run_elastic)
+
+        cfg = config or SupervisorConfig.from_env(**overrides)
+
+        def step_fn(state, step, ctx):
+            ids, labels = batch_fn(step)
+            loss = self.step(ids, labels)
+            return self.elastic_state(), float(np.asarray(
+                jax.device_get(loss)))
+
+        return run_elastic(step_fn, self.elastic_state(), cfg,
+                           num_steps=num_steps,
+                           on_restore=self.load_elastic_state,
+                           start_step=self.step_count)
 
     def lower_text(self, batch_shape):
         """Compiled HLO text (for inspection/debugging of sharding)."""

@@ -52,6 +52,13 @@ def parse_args(argv=None):
     parser.add_argument("--job_id", default="default")
     parser.add_argument("--log_dir", default="log")
     parser.add_argument("--max_restart", type=int, default=3)
+    parser.add_argument("--ckpt_dir", default=None,
+                        help="checkpoint root for the elastic "
+                             "supervisor's disk tier (PT_CKPT_ROOT)")
+    parser.add_argument("--snapshot_every", type=int, default=0,
+                        help="in-memory replicated snapshot interval "
+                             "in steps for supervised workers "
+                             "(PT_SNAPSHOT_EVERY; 0 = leave unset)")
     parser.add_argument("--elastic_timeout", type=float, default=30.0)
     parser.add_argument("--elastic_ttl", type=float, default=10.0,
                         help="heartbeat staleness after which a peer node "
@@ -101,8 +108,16 @@ class Controller:
                 self.store = TCPStore(host, int(port), is_master=False,
                                       timeout=5.0)
             except ConnectionError:
-                self.store = TCPStore(host, int(port), is_master=True)
-                self.is_master = True
+                try:
+                    self.store = TCPStore(host, int(port),
+                                          is_master=True)
+                    self.is_master = True
+                except OSError:
+                    # lost the hosting race (EADDRINUSE): a peer
+                    # controller bound the port between our probe and
+                    # our bind — join it as a client, patiently
+                    self.store = TCPStore(host, int(port),
+                                          is_master=False, timeout=30.0)
 
     def _ns(self):
         return f"{self.args.job_id}/g{self.generation}"
@@ -187,6 +202,17 @@ class Controller:
             "PADDLE_ELASTIC_GENERATION": str(self.generation),
             "FLAGS_selected_tpus": "all",
         })
+        # elastic-supervisor contract (distributed/resilience/supervisor):
+        # restart budget follows the launcher's, and a worker spawned
+        # into a re-formed pod knows it is rejoining (so its supervisor
+        # bumps the rendezvous generation instead of matching a stale one)
+        env["PT_SUPERVISOR_MAX_RESTARTS"] = str(self.args.max_restart)
+        if self.args.ckpt_dir:
+            env["PT_CKPT_ROOT"] = self.args.ckpt_dir
+        if self.args.snapshot_every > 0:
+            env["PT_SNAPSHOT_EVERY"] = str(self.args.snapshot_every)
+        if self.generation > 0:
+            env["PT_SUPERVISOR_REJOIN"] = "1"
         return env
 
     def spawn(self, pod: Pod):
@@ -238,7 +264,7 @@ class Controller:
                     print(f"[launch] elastic: group {unhealthy} marked "
                           f"unhealthy by comm watchdog; re-forming pod",
                           file=sys.stderr)
-                    self.store.delete_key(f"__unhealthy__/{unhealthy}")
+                    self._clear_unhealthy(unhealthy)
                     self._kill(pod)
                     return ("reform",
                             self.store.add(f"{self.args.job_id}/gen_bump",
@@ -309,11 +335,24 @@ class Controller:
         """Group id marked unhealthy by a worker's watchdog escalation
         (only the world group 0 is checked — sub-group desyncs stall
         the world group's next collective anyway), or None."""
+        from ..watchdog import read_unhealthy
+
+        return 0 if read_unhealthy(self.store, 0) is not None else None
+
+    def _clear_unhealthy(self, gid: int):
+        """Consume/clear an ``__unhealthy__`` mark. Also called before
+        every (re-)spawn: a mark set by a dying worker AFTER the re-form
+        decision must not immediately re-trigger escalation against the
+        fresh pod."""
+        from ..watchdog import clear_unhealthy
+
         try:
-            self.store.get_nowait("__unhealthy__/0")
-            return 0
-        except Exception:
-            return None
+            clear_unhealthy(self.store, gid)
+        except Exception as e:
+            # the store owner may be mid-death; the next watch iteration
+            # retries — losing the delete only delays one re-form
+            print(f"[launch] could not clear unhealthy mark: {e!r}",
+                  file=sys.stderr)
 
     def _stale_peer(self, pod: Pod):
         now = time.time()
@@ -343,6 +382,10 @@ class Controller:
         try:
             while True:
                 pod = self.build_pod()
+                if self.elastic:
+                    # a stale mark from the previous incarnation must
+                    # not trip the watchdog consumer on the fresh pod
+                    self._clear_unhealthy(0)
                 self.spawn(pod)
                 result, arg = self.watch(pod)
                 if result == "done":

@@ -1,0 +1,31 @@
+"""Shared exponential-backoff helpers for every retry loop in
+``distributed/``.
+
+A retry loop that sleeps a constant between attempts hammers a dead
+peer at a fixed frequency — exactly wrong while the elastic controller
+needs seconds to relaunch it. Every retry path (transport redial, store
+connect, supervisor restart) goes through these helpers so the policy
+lives in one place; the PT503 lint rule flags constant ``time.sleep``
+retry loops in ``distributed/`` that bypass them.
+
+Deliberately stdlib-only: imported by the no-jax transport/store layer.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["delay", "sleep_backoff"]
+
+
+def delay(attempt: int, base: float = 0.05, cap: float = 2.0) -> float:
+    """Exponential backoff delay for retry `attempt` (0-based):
+    ``min(base * 2**attempt, cap)`` seconds."""
+    return min(base * (2 ** attempt), cap)
+
+
+def sleep_backoff(attempt: int, base: float = 0.05,
+                  cap: float = 2.0) -> float:
+    """Sleep the backoff delay for `attempt`; returns the slept delay."""
+    d = delay(attempt, base=base, cap=cap)
+    time.sleep(d)
+    return d

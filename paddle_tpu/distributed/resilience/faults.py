@@ -2,17 +2,23 @@
 
 The chaos harness: a process-wide ``FaultInjector`` that the transport
 consults at well-defined sites (``send`` per outgoing data-frame
-attempt, ``dial`` per connect attempt, ``recv`` per delivered frame).
-A ``FaultPlan`` names which fault fires where — armed from the
-``PT_FAULT_PLAN`` environment variable or programmatically — so the
-failure modes a TPU pod actually exhibits (dropped DCN connections,
-slow hosts, corrupted frames, killed ranks) are reproducible on the
-2-process CPU mesh in tier-1 tests.
+attempt, ``dial`` per connect attempt, ``recv`` per delivered frame),
+plus two training-loop sites: ``step`` (the elastic supervisor consults
+it at the top of every train step) and ``save`` (the distributed
+checkpoint consults it between writing shard files and publishing the
+manifest — a ``kill@save`` leaves exactly the torn checkpoint a real
+mid-save death leaves). A ``FaultPlan`` names which fault fires where —
+armed from the ``PT_FAULT_PLAN`` environment variable or
+programmatically — so the failure modes a TPU pod actually exhibits
+(dropped DCN connections, slow hosts, corrupted frames, killed ranks)
+are reproducible on the 2-process CPU mesh in tier-1 tests.
 
 Plan DSL (comma/semicolon separated clauses)::
 
     PT_FAULT_PLAN="drop@send#2,corrupt@send#4"
     PT_FAULT_PLAN="kill@send#3:rank=1"
+    PT_FAULT_PLAN="kill@step#5:rank=1"          # die at the 5th step
+    PT_FAULT_PLAN="kill@save#1"                 # die mid-checkpoint
     PT_FAULT_PLAN="delay@send#1:ms=250,dup@send#2"
     PT_FAULT_PLAN="seed=7,drop@send%0.05"
 
@@ -28,11 +34,21 @@ Optional filters: ``:rank=R`` (only this global rank injects) and
 - ``corrupt`` flip a payload byte after CRC is computed (exercises
   CRC verification + NAK retransmit)
 - ``kill``    ``os._exit(code)`` (default 1) — a rank dying
-  mid-collective (exercises watchdog escalation on the survivors)
+  mid-collective (exercises watchdog escalation on the survivors),
+  mid-step (exercises supervisor re-form + snapshot restore), or
+  mid-save (exercises torn-checkpoint discovery)
+
+At the ``step``/``save`` sites only ``kill`` and ``delay`` are
+meaningful; frame-level kinds (drop/dup/corrupt) are ignored there.
 
 Every injected fault increments ``faults/injected`` and
 ``faults/<kind>`` in the metrics registry so a chaos run's report shows
 exactly what was thrown at the system.
+
+Validate a plan offline (CI / before launching a pod)::
+
+    python -m paddle_tpu.distributed.resilience.faults --check "<plan>"
+    python tools/faultplan.py "<plan>"          # jax-free equivalent
 """
 from __future__ import annotations
 
@@ -49,7 +65,7 @@ __all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
            "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
 
 FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill")
-FAULT_SITES = ("send", "dial", "recv")
+FAULT_SITES = ("send", "dial", "recv", "step", "save")
 
 
 @dataclass(frozen=True)
@@ -251,3 +267,38 @@ def maybe_arm_from_env() -> bool:
         return False
     injector.arm(spec)
     return True
+
+
+def main(argv=None) -> int:
+    """Offline PT_FAULT_PLAN validator: ``--check "<plan>"`` parses the
+    plan and prints its normalized form (exit 0) or the parse error
+    (exit 2) — so CI rejects a typo'd chaos plan before it silently
+    no-ops on a real pod."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "paddle_tpu.distributed.resilience.faults",
+        description="Validate a PT_FAULT_PLAN chaos plan offline.")
+    parser.add_argument("plan", nargs="?", default=None,
+                        help="plan string (defaults to $PT_FAULT_PLAN)")
+    parser.add_argument("--check", dest="check", default=None,
+                        metavar="PLAN", help="plan string to validate")
+    args = parser.parse_args(argv)
+    spec = args.check if args.check is not None else args.plan
+    if spec is None:
+        spec = os.environ.get("PT_FAULT_PLAN", "")
+    if not spec.strip():
+        print("no plan given (arg, --check, or $PT_FAULT_PLAN)")
+        return 2
+    try:
+        plan = parse_plan(spec)
+    except ValueError as e:
+        print(f"invalid PT_FAULT_PLAN: {e}")
+        return 2
+    print(f"OK: {len(plan.rules)} rule(s), seed={plan.seed}: "
+          f"{plan.describe()}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,6 +13,12 @@ discovery skips). Loading goes through ``load_state_dict``'s
 reshard-on-load, so a pod that re-formed onto a different parallel
 config (fewer hosts, remapped ranks) restores bitwise-identical values
 under the new sharding.
+
+Retention: ``save_checkpoint(..., keep=K)`` prunes complete checkpoints
+beyond the newest K, and ``sweep_incomplete(root)`` (run at startup and
+by ``resume_from_latest``) deletes torn ``step_<N>`` directories lacking
+a manifest, so crash debris never accumulates. Both are counted
+(``ckpt/pruned`` / ``ckpt/swept_incomplete``).
 """
 from __future__ import annotations
 
@@ -21,10 +27,11 @@ import re
 import shutil
 from typing import Dict, List, Optional, Tuple
 
+from ...profiler import metrics as _metrics
 from ..checkpoint import load_state_dict, save_state_dict
 
 __all__ = ["save_checkpoint", "latest_checkpoint", "list_checkpoints",
-           "resume_from_latest", "CKPT_DIR_RE"]
+           "resume_from_latest", "sweep_incomplete", "CKPT_DIR_RE"]
 
 CKPT_DIR_RE = re.compile(r"^step_(\d+)$")
 _MANIFEST = "0.metadata"
@@ -60,6 +67,31 @@ def latest_checkpoint(root: str) -> Optional[Tuple[int, str]]:
     return found[-1] if found else None
 
 
+def sweep_incomplete(root: str,
+                     skip: Optional[str] = None) -> List[str]:
+    """Delete torn ``step_<N>`` directories (no complete manifest: a
+    writer killed mid-save) under `root`; returns the removed paths.
+
+    Run at startup / before resume — never concurrently with another
+    rank's in-flight ``save_checkpoint`` (a save in progress looks torn
+    until its manifest lands; `skip` excludes one path from the sweep
+    for exactly that reason)."""
+    removed = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return removed
+    complete = {p for _, p in list_checkpoints(root)}
+    for name in names:
+        cand = os.path.join(root, name)
+        if CKPT_DIR_RE.match(name) and os.path.isdir(cand) \
+                and cand not in complete and cand != skip:
+            shutil.rmtree(cand, ignore_errors=True)
+            removed.append(cand)
+            _metrics.inc("ckpt/swept_incomplete")
+    return removed
+
+
 def save_checkpoint(state_dict: Dict, root: str, step: int,
                     keep: Optional[int] = None) -> str:
     """Write `state_dict` as the step-`step` checkpoint under `root`.
@@ -74,30 +106,35 @@ def save_checkpoint(state_dict: Dict, root: str, step: int,
     save_state_dict(state_dict, path)
     from .. import env
     if env.global_rank() == 0:
-        complete = {p for _, p in list_checkpoints(root)}
-        for name in os.listdir(root):
-            cand = os.path.join(root, name)
-            if CKPT_DIR_RE.match(name) and cand != path \
-                    and cand not in complete:
-                shutil.rmtree(cand, ignore_errors=True)
+        sweep_incomplete(root, skip=path)
         if keep is not None and keep > 0:
             for _, old in list_checkpoints(root)[:-keep]:
                 if old != path:
                     shutil.rmtree(old, ignore_errors=True)
+                    _metrics.inc("ckpt/pruned")
     return path
 
 
-def resume_from_latest(state_dict: Dict, root: str) -> Optional[int]:
+def resume_from_latest(state_dict: Dict, root: str,
+                       sweep: bool = True) -> Optional[int]:
     """Restore `state_dict` in place from the newest complete checkpoint
     under `root`, resharding each tensor to its CURRENT sharding (the
     surviving pod config). Returns the restored step, or None when no
     complete checkpoint exists (caller starts from scratch).
+
+    With `sweep` (default), rank 0 first deletes torn ``step_<N>``
+    directories — the startup sweep that keeps crash debris from
+    accumulating across restarts.
 
     This is the resume half of the elastic recovery loop: after the
     launch controller re-forms the pod (dead heartbeat -> membership
     change -> fresh rendezvous), each worker rebuilds its model/optimizer
     state and calls ``resume_from_latest`` so the next train step
     continues with bitwise-identical values."""
+    if sweep:
+        from .. import env
+        if env.global_rank() == 0:
+            sweep_incomplete(root)
     found = latest_checkpoint(root)
     if found is None:
         return None

@@ -1,0 +1,685 @@
+"""Elastic training supervisor: self-healing train loop.
+
+PR 3 shipped the primitives — chaos injection, CRC/ACK transport
+retries, watchdog escalation to ``__unhealthy__/<gid>``, and
+``resume_from_latest`` — but a killed or hung rank still ended the run:
+every survivor raised ``CommTimeoutError`` and a human restarted the
+job. This module closes the loop machine-side (MegaScale attributes
+most lost pod-hours to recovery *latency*, not failure frequency;
+Gemini shows in-memory peer-replicated checkpoints cut restore from
+minutes of disk traffic to seconds):
+
+- ``run_elastic(train_step_fn, state, config)`` drives the loop. A
+  recoverable failure (``CommTimeoutError`` from watchdog escalation,
+  ``PeerUnreachableError``, transport timeouts) triggers recovery: the
+  group re-forms over the rendezvous store (``ElasticManager``
+  heartbeats gate on the survivors/rejoiners), a fresh
+  per-generation ``TensorTransport`` is installed, the stale
+  ``__unhealthy__`` mark is consumed and cleared, and training resumes
+  from the freshest complete recovery point — bounded by
+  ``max_restarts`` with exponential backoff, all visible in
+  ``train/restarts``/``train/reform_ms``/``train/recovery_source/*``.
+
+- **Recovery tiers** (freshest wins): (1) the in-memory
+  ``ReplicatedSnapshot`` ring — every ``snapshot_every`` steps each
+  rank copies its state to its ring neighbor over the CRC-protected
+  transport, so after a single-rank loss the rejoined rank restores
+  from a peer in seconds; (2) the ``step_<N>`` disk tier
+  (``save_checkpoint``/``resume_from_latest``, reshard-on-load);
+  (3) fresh start.
+
+- **Numerical guards** (``guards.StepGuard``): per-step loss/grad
+  finiteness + relative spike detection; anomalous batches are skipped
+  and after K consecutive anomalies the supervisor rolls back to the
+  last snapshot (``train/anomalies|skipped_batches|rollbacks``).
+
+``train_step_fn(state, step, ctx) -> (new_state, loss)`` must be
+deterministic in ``(state, step)`` for replay-after-rollback to
+converge; ``ctx`` carries rank/world and watchdog-tracked collective
+helpers. ``state`` is a flat ``{name: array}`` dict.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...profiler import RecordEvent
+from ...profiler import metrics as _metrics
+from . import backoff as _backoff
+from . import faults as _faults
+from .errors import TransportError
+from .guards import OK, ROLLBACK, SKIP, GuardConfig, StepGuard
+
+__all__ = ["SupervisorConfig", "StepContext", "Supervisor",
+           "run_elastic", "RECOVERABLE_ERRORS"]
+
+# what the supervisor treats as "the group broke, re-form and resume"
+# (everything else — including guard FloatingPointErrors handled
+# in-loop — propagates to the caller)
+RECOVERABLE_ERRORS = (TransportError, TimeoutError, ConnectionError)
+
+_m_restarts = _metrics.counter("train/restarts")
+_m_steps = _metrics.counter("train/steps")
+_m_rollbacks = _metrics.counter("train/rollbacks")
+_m_snapshots = _metrics.counter("train/snapshots")
+_m_snap_bytes = _metrics.counter("train/snapshot_bytes")
+_m_repl_errors = _metrics.counter("train/replication_errors")
+_m_reform_ms = _metrics.histogram("train/reform_ms")
+
+
+@dataclass
+class SupervisorConfig:
+    """Knobs for the self-healing loop (env: ``PT_SUPERVISOR_*``,
+    ``PT_SNAPSHOT_EVERY``, ``PT_CKPT_ROOT|EVERY|KEEP``)."""
+
+    rank: int = 0
+    world_size: int = 1
+    job_id: str = "default"
+    max_restarts: int = 2            # recoveries before giving up
+    backoff_base_s: float = 0.5      # restart backoff: base * 2^attempt
+    backoff_cap_s: float = 30.0
+    snapshot_every: int = 10         # in-memory replicated tier (0 = off)
+    replicate: bool = True           # copy snapshots to the ring neighbor
+    replicate_async: bool = True     # exchange in a background thread
+    snapshots_kept: int = 2          # local + replica retention per rank
+    ckpt_root: Optional[str] = None  # disk tier root (step_<N> dirs)
+    ckpt_every: int = 0              # disk-tier interval (0 = off mid-run)
+    keep: int = 3                    # disk keep-last-K
+    reform_timeout_s: float = 120.0  # rendezvous/heartbeat re-form gate
+    transport_timeout_s: float = 60.0
+    watchdog_timeout_s: Optional[float] = None  # enable comm watchdog
+    heartbeat_ttl_s: float = 5.0
+    rejoin: bool = False             # this process replaces a dead rank
+    group_id: int = 0                # gid for collectives + unhealthy key
+    guard: GuardConfig = field(default_factory=GuardConfig)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "SupervisorConfig":
+        env = os.environ.get
+        cfg = cls(
+            rank=int(env("PADDLE_TRAINER_ID", "0")),
+            world_size=int(env("PADDLE_TRAINERS_NUM", "1")),
+            job_id=env("PADDLE_JOB_ID", "default"),
+            max_restarts=int(env("PT_SUPERVISOR_MAX_RESTARTS", "2")),
+            snapshot_every=int(env("PT_SNAPSHOT_EVERY", "10")),
+            ckpt_root=env("PT_CKPT_ROOT") or None,
+            ckpt_every=int(env("PT_CKPT_EVERY", "0")),
+            keep=int(env("PT_CKPT_KEEP", "3")),
+            reform_timeout_s=float(env("PT_REFORM_TIMEOUT", "120")),
+            rejoin=env("PT_SUPERVISOR_REJOIN", "") not in ("", "0"),
+        )
+        for k, v in overrides.items():
+            setattr(cfg, k, v)
+        return cfg
+
+
+@dataclass
+class StepContext:
+    """What a train step sees: identity plus watchdog-tracked eager
+    collectives over the supervisor's current transport."""
+
+    rank: int
+    world: int
+    step: int
+    transport: object = None
+    group_ranks: List[int] = field(default_factory=lambda: [0])
+    gid: int = 0
+    guard: Optional[StepGuard] = None
+
+    def _task(self, op: str):
+        from ..watchdog import comm_task_manager
+
+        return comm_task_manager.start_task(
+            op, self.gid, self.group_ranks, self.rank)
+
+    def all_reduce(self, arr, op: str = "avg") -> np.ndarray:
+        """Eager all_reduce over the group (identity when world==1),
+        registered with the comm watchdog so a stalled peer escalates
+        instead of hanging this rank."""
+        if self.transport is None or self.world <= 1:
+            return np.asarray(arr)
+        task = self._task(f"ar_{op}")
+        try:
+            return self.transport.all_reduce(
+                arr, op, self.group_ranks, self.gid)
+        finally:
+            if task is not None:
+                task.mark_done()
+
+    def check_grads(self, grads) -> List[int]:
+        """Cross-replica gradient-checksum agreement (SDC probe);
+        returns the disagreeing ranks (see guards.StepGuard)."""
+        if self.guard is None:
+            return []
+        return self.guard.check_grad_agreement(
+            grads, self.transport, self.group_ranks, self.gid, self.rank)
+
+
+# ---------------------------------------------------------------------------
+# state (de)serialization over the transport
+# ---------------------------------------------------------------------------
+
+def _copy_state(state: Dict) -> Dict[str, np.ndarray]:
+    return {k: np.array(np.asarray(v), copy=True) for k, v in state.items()}
+
+
+def _send_state(tp, dst: int, step: int, state: Dict,
+                channel: str) -> int:
+    """Ship a state dict to `dst`: a JSON manifest frame (step + key
+    order) then one CRC-protected frame per array. Returns bytes."""
+    keys = sorted(state)
+    manifest = json.dumps({"step": step, "keys": keys}).encode()
+    tp.send(np.frombuffer(manifest, dtype=np.uint8), dst, channel)
+    nbytes = len(manifest)
+    for k in keys:
+        arr = np.ascontiguousarray(np.asarray(state[k]))
+        tp.send(arr, dst, channel)
+        nbytes += arr.nbytes
+    return nbytes
+
+
+def _recv_state(tp, src: int, channel: str) -> Tuple[int, Dict]:
+    manifest = json.loads(bytes(tp.recv(src, channel)).decode())
+    state = {k: tp.recv(src, channel) for k in manifest["keys"]}
+    return int(manifest["step"]), state
+
+
+class Supervisor:
+    """One per process; owns the store rendezvous, the per-generation
+    transport, the snapshot tiers, and the guarded step loop."""
+
+    def __init__(self, config: SupervisorConfig, store=None):
+        self.config = config
+        self.rank = config.rank
+        self.world = config.world_size
+        self.store = store
+        self.transport = None
+        self.elastic = None
+        self.guard = StepGuard(config.guard)
+        self.generation = 0
+        # snapshot tiers: {next_step: state} / {(src, next_step): state}
+        self._own_snaps: Dict[int, Dict] = {}
+        self._replicas: Dict[Tuple[int, int], Dict] = {}
+        self._repl_thread = None
+        self._initial: Optional[Dict] = None
+        self._installed_global = False
+        self.restarts = 0
+        self.rollbacks = 0
+        self.skipped = 0
+        self._step = 0
+        self.recovery_sources: List[Tuple[int, str]] = []
+        if self.world > 1 and self.store is None:
+            self.store = self._connect_store()
+        if self.store is not None and self.world > 1:
+            from ..elastic import ElasticManager
+
+            self.elastic = ElasticManager(
+                self.store, f"sup/{config.job_id}/hb", self.rank,
+                min_nodes=self.world, max_nodes=self.world,
+                heartbeat_interval=min(1.0, config.heartbeat_ttl_s / 3),
+                ttl=config.heartbeat_ttl_s).start()
+        if config.watchdog_timeout_s:
+            from ..watchdog import enable_comm_watchdog
+
+            enable_comm_watchdog(config.watchdog_timeout_s)
+
+    # -- wiring ------------------------------------------------------------
+    def _connect_store(self):
+        from ..transport import _master_endpoint
+        from ..store import TCPStore
+
+        host, port = _master_endpoint()
+        timeout = self.config.transport_timeout_s * 2
+        if self.rank == 0 and not self.config.rejoin:
+            try:
+                return TCPStore(host, port, is_master=True,
+                                world_size=self.world, timeout=timeout)
+            except OSError:
+                pass
+        return TCPStore(host, port, is_master=False,
+                        world_size=self.world, timeout=timeout)
+
+    def _k(self, suffix: str) -> str:
+        return f"sup/{self.config.job_id}/{suffix}"
+
+    def _teardown_transport(self):
+        from .. import transport as tr
+
+        tp, self.transport = self.transport, None
+        if tp is None:
+            return
+        if self._installed_global and tr.get_transport() is tp:
+            tr.install_transport(None)
+        self._installed_global = False
+        try:
+            tp.close()
+        except Exception:
+            # best-effort teardown of an already-poisoned transport
+            _metrics.inc("comm/close_errors")
+        self._join_replication(timeout=2.0)
+
+    def close(self):
+        self._teardown_transport()
+        if self.elastic is not None:
+            self.elastic.stop()
+
+    # -- group (re-)formation ----------------------------------------------
+    def _registered_count(self, gen: int) -> int:
+        present = 0
+        for r in range(self.world):
+            try:
+                self.store.get_nowait(self._k(f"g{gen}/reg/{r}"))
+                present += 1
+            except KeyError:
+                pass
+        return present
+
+    def _rendezvous(self, bump: bool) -> int:
+        """Settle every rank on one generation: bump (recovery/rejoin),
+        register, and wait until all `world` ranks registered at the
+        final generation. Late bumps move everyone up."""
+        store = self.store
+        gen = store.add(self._k("gen"), 1 if bump else 0)
+        deadline = time.time() + self.config.reform_timeout_s
+        registered_gen = None
+        while True:
+            cur = store.add(self._k("gen"), 0)
+            if cur != registered_gen:
+                gen = cur
+                store.set(self._k(f"g{gen}/reg/{self.rank}"),
+                          str(time.time()))
+                registered_gen = gen
+            present = self._registered_count(gen)
+            if present >= self.world:
+                return gen
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"supervisor rendezvous timed out: {present}/"
+                    f"{self.world} ranks at generation {gen}")
+            time.sleep(0.2)
+
+    def _form_group(self, bump: bool) -> int:
+        """Re-form: heartbeat gate -> rendezvous -> fresh transport
+        (per-generation namespace) -> barrier -> clear stale unhealthy
+        mark. Returns the new generation."""
+        from .. import transport as tr
+        from ..watchdog import clear_unhealthy
+        self._teardown_transport()
+        if self.elastic is not None:
+            self.elastic.wait_for_members(
+                self.world, timeout=self.config.reform_timeout_s)
+        gen = self._rendezvous(bump)
+        self.generation = gen
+        self.transport = tr.TensorTransport(
+            self.rank, self.world, self.store,
+            timeout=self.config.transport_timeout_s,
+            job=f"sup/{self.config.job_id}/g{gen}")
+        tr.install_transport(self.transport)
+        self._installed_global = True
+        self.store.barrier(self._k(f"g{gen}/formed"), self.world,
+                           timeout=self.config.reform_timeout_s)
+        # a recovered pod must not immediately re-trigger escalation
+        # off the previous incarnation's mark
+        if self.rank == 0:
+            clear_unhealthy(self.store, self.config.group_id)
+        if self.elastic is not None:
+            self.elastic.clear_restart()
+        return gen
+
+    # -- recovery-point resolution -----------------------------------------
+    def _disk_step(self) -> int:
+        if not self.config.ckpt_root:
+            return -1
+        from .recovery import latest_checkpoint
+
+        found = latest_checkpoint(self.config.ckpt_root)
+        return found[0] if found else -1
+
+    def _publish_avail(self, gen: int):
+        replicas: Dict[str, List[int]] = {}
+        for (src, step) in self._replicas:
+            replicas.setdefault(str(src), []).append(step)
+        avail = {"rank": self.rank, "own": sorted(self._own_snaps),
+                 "replicas": {k: sorted(v) for k, v in replicas.items()},
+                 "disk": self._disk_step()}
+        self.store.set(self._k(f"g{gen}/avail/{self.rank}"),
+                       json.dumps(avail))
+
+    def _read_avails(self, gen: int) -> List[dict]:
+        out = []
+        for r in range(self.world):
+            out.append(json.loads(
+                self.store.get(self._k(f"g{gen}/avail/{r}")).decode()))
+        return out
+
+    @staticmethod
+    def _resolve(avails: List[dict]) -> Tuple[str, int, Optional[Dict]]:
+        """Pick the freshest complete recovery point from the published
+        availability: ("peer", step, {rank: holder}) when every rank's
+        state at `step` is in memory somewhere (own or a ring replica),
+        else ("disk", step, None), else ("none", -1, None). Pure
+        function of the shared data — every rank computes the same
+        plan."""
+        world = len(avails)
+        steps = set()
+        for a in avails:
+            steps.update(a["own"])
+            for ss in a["replicas"].values():
+                steps.update(ss)
+        peer_step, plan = -1, None
+        for s in sorted(steps, reverse=True):
+            holders = {}
+            for r in range(world):
+                if s in avails[r]["own"]:
+                    holders[r] = r
+                    continue
+                q = next((a["rank"] for a in avails
+                          if s in a["replicas"].get(str(r), [])), None)
+                if q is None:
+                    holders = None
+                    break
+                holders[r] = q
+            if holders is not None:
+                peer_step, plan = s, holders
+                break
+        disk_step = max(a["disk"] for a in avails)
+        if peer_step >= 0 and peer_step >= disk_step:
+            return "peer", peer_step, plan
+        if disk_step >= 0:
+            return "disk", disk_step, None
+        return "none", -1, None
+
+    def _restore_from_disk(self, state: Dict) -> Tuple[int, Dict]:
+        """Bitwise restore of the flat numpy state from the newest
+        complete ``step_<N>`` dir (shard assembly preserves the saved
+        dtypes — no framework-tensor round trip)."""
+        import pickle
+
+        from ..checkpoint import _assemble
+        from .recovery import latest_checkpoint, sweep_incomplete
+
+        if self.rank == 0:
+            sweep_incomplete(self.config.ckpt_root)
+        step, path = latest_checkpoint(self.config.ckpt_root)
+        with open(os.path.join(path, "0.metadata"), "rb") as f:
+            meta = pickle.load(f)
+        cache: Dict = {}
+        out = dict(state)
+        for k in out:
+            if k in meta.state_dict_metadata:
+                out[k] = _assemble(k, meta, path, cache)
+        return int(step), out
+
+    def _recover_state(self, gen: int, state: Dict, step: int,
+                       emit: bool) -> Tuple[int, Dict, str]:
+        """Resolve + apply the freshest recovery point onto this rank.
+        Returns (step, state, source)."""
+        with RecordEvent("train/recover"):
+            self._publish_avail(gen)
+            avails = self._read_avails(gen)
+            source, rstep, plan = self._resolve(avails)
+            if source == "peer":
+                holder = plan[self.rank]
+                if holder == self.rank:
+                    state = _copy_state(self._own_snaps[rstep])
+                # serve replicas to ranks that lost their state; recv
+                # ours if we are one of them (deterministic shared plan)
+                for r in range(self.world):
+                    q = plan[r]
+                    if q == r:
+                        continue
+                    if self.rank == q:
+                        _send_state(self.transport, r, rstep,
+                                    self._replicas[(r, rstep)], "restore")
+                    elif self.rank == r:
+                        rstep, state = _recv_state(
+                            self.transport, q, "restore")
+                step = rstep
+            elif source == "disk":
+                step, state = self._restore_from_disk(state)
+            else:
+                state = _copy_state(self._initial)
+                step = 0
+        if emit:
+            _metrics.inc(f"train/recovery_source/{source}")
+            self.recovery_sources.append((step, source))
+            print(f"[supervisor] rank {self.rank} recovered at step "
+                  f"{step} from {source} tier (generation {gen})",
+                  file=sys.stderr, flush=True)
+        # re-anchor the snapshot tiers on the restored point so a
+        # back-to-back failure can still recover from memory
+        self._own_snaps = {step: _copy_state(state)}
+        self._replicas = {k: v for k, v in self._replicas.items()
+                          if k[1] == step}
+        self.guard.reset()
+        return step, state, source
+
+    # -- snapshot tiers ----------------------------------------------------
+    def _join_replication(self, timeout: Optional[float] = None) -> bool:
+        th, self._repl_thread = self._repl_thread, None
+        if th is None:
+            return True
+        th.join(timeout)
+        if th.is_alive():
+            # still blocked on a dead peer: the exchange thread will
+            # exit when the transport aborts/closes; don't wait for it
+            self._repl_thread = th
+            return False
+        return True
+
+    def _replicate(self, next_step: int, snap: Dict):
+        tp = self.transport
+        try:
+            send_to = (self.rank + 1) % self.world
+            recv_from = (self.rank - 1) % self.world
+            nbytes = _send_state(tp, send_to, next_step, snap, "snap")
+            rstep, rstate = _recv_state(tp, recv_from, "snap")
+            self._replicas[(recv_from, rstep)] = rstate
+            keep = sorted(
+                s for (src, s) in self._replicas if src == recv_from)
+            for s in keep[:-self.config.snapshots_kept]:
+                del self._replicas[(recv_from, s)]
+            _m_snap_bytes.inc(nbytes)
+        except RECOVERABLE_ERRORS as e:
+            # a dead peer surfaces on the training collectives; the
+            # replication ring just records the miss
+            _m_repl_errors.inc()
+            print(f"[supervisor] rank {self.rank} snapshot replication "
+                  f"failed: {e!r}", file=sys.stderr, flush=True)
+
+    def _maybe_snapshot(self, next_step: int, state: Dict):
+        every = self.config.snapshot_every
+        if every <= 0 or next_step % every != 0:
+            return
+        with RecordEvent("train/snapshot"):
+            snap = _copy_state(state)
+            self._own_snaps[next_step] = snap
+            for s in sorted(self._own_snaps)[:-self.config.snapshots_kept]:
+                del self._own_snaps[s]
+            _m_snapshots.inc()
+            if self.world > 1 and self.config.replicate \
+                    and self.transport is not None:
+                if not self._join_replication(
+                        timeout=self.config.transport_timeout_s + 5):
+                    return      # previous exchange wedged on a dead peer
+                if self.config.replicate_async:
+                    import threading
+
+                    self._repl_thread = threading.Thread(
+                        target=self._replicate, args=(next_step, snap),
+                        name="snapshot_replication", daemon=True)
+                    self._repl_thread.start()
+                else:
+                    self._replicate(next_step, snap)
+
+    def _maybe_checkpoint(self, next_step: int, state: Dict):
+        cfg = self.config
+        if not cfg.ckpt_root or cfg.ckpt_every <= 0 \
+                or next_step % cfg.ckpt_every != 0:
+            return
+        from .recovery import save_checkpoint
+
+        save_checkpoint(state, cfg.ckpt_root, next_step, keep=cfg.keep)
+
+    # -- the loop ----------------------------------------------------------
+    def _fault_step_site(self):
+        act = _faults.injector.on_event("step", self.rank)
+        if act is not None:
+            if act.kind == "kill":
+                os._exit(act.exit_code)
+            elif act.kind == "delay":
+                time.sleep(act.delay_ms / 1e3)
+
+    def run(self, train_step_fn: Callable, state: Dict, num_steps: int,
+            on_restore: Optional[Callable] = None,
+            start_step: int = 0) -> Tuple[Dict, dict]:
+        """Drive `train_step_fn` for `num_steps` steps, self-healing
+        through recoverable failures and numerical anomalies. Returns
+        (final_state, report)."""
+        cfg = self.config
+        _faults.maybe_arm_from_env()
+        state = _copy_state(state)
+        self._initial = _copy_state(state)
+        step = start_step
+        losses: Dict[int, float] = {}
+        first = True
+        try:
+            while True:
+                try:
+                    if self.store is not None and self.world > 1:
+                        if self.transport is None:
+                            t0 = time.perf_counter()
+                            with RecordEvent("train/reform"):
+                                gen = self._form_group(
+                                    bump=(not first) or cfg.rejoin)
+                                step, state, _ = self._recover_state(
+                                    gen, state, step,
+                                    emit=(not first) or cfg.rejoin)
+                            _m_reform_ms.observe(
+                                (time.perf_counter() - t0) * 1e3)
+                            if on_restore is not None and \
+                                    ((not first) or cfg.rejoin):
+                                on_restore(state)
+                    elif first and cfg.ckpt_root and self._disk_step() >= 0:
+                        step, state = self._restore_from_disk(state)
+                        self._own_snaps = {step: _copy_state(state)}
+                        if on_restore is not None:
+                            on_restore(state)
+                    first = False
+                    with self.guard:
+                        step, state = self._train_until(
+                            train_step_fn, state, step, num_steps,
+                            losses, on_restore)
+                    # let an in-flight snapshot exchange finish before
+                    # teardown (both ranks reach this point together)
+                    self._join_replication(
+                        timeout=cfg.transport_timeout_s)
+                    report = {
+                        "final_step": step,
+                        "restarts": self.restarts,
+                        "rollbacks": self.rollbacks,
+                        "skipped": self.skipped,
+                        "anomalies": self.guard.anomalies,
+                        "recovery_sources": list(self.recovery_sources),
+                        "losses": [losses.get(s, float("nan"))
+                                   for s in range(start_step, num_steps)],
+                    }
+                    return state, report
+                except RECOVERABLE_ERRORS as e:
+                    self.restarts += 1
+                    _m_restarts.inc()
+                    if self.restarts > cfg.max_restarts:
+                        print(f"[supervisor] rank {self.rank} restart "
+                              f"budget exhausted "
+                              f"({cfg.max_restarts}); giving up: {e!r}",
+                              file=sys.stderr, flush=True)
+                        raise
+                    from ..watchdog import read_unhealthy
+
+                    dump = read_unhealthy(self.store, cfg.group_id) \
+                        if self.store is not None else None
+                    print(f"[supervisor] rank {self.rank} recoverable "
+                          f"failure at step {self._step}: {e!r} "
+                          f"(restart {self.restarts}/{cfg.max_restarts}"
+                          f"{', group marked unhealthy' if dump else ''})",
+                          file=sys.stderr, flush=True)
+                    self._teardown_transport()
+                    time.sleep(_backoff.delay(
+                        self.restarts - 1, base=cfg.backoff_base_s,
+                        cap=cfg.backoff_cap_s))
+        finally:
+            self.close()
+
+    def _train_until(self, train_step_fn, state, step, num_steps,
+                     losses, on_restore):
+        cfg = self.config
+        while step < num_steps:
+            self._step = step          # progress marker for failure logs
+            self._fault_step_site()
+            ctx = StepContext(
+                rank=self.rank, world=self.world, step=step,
+                transport=self.transport,
+                group_ranks=list(range(self.world)), gid=cfg.group_id,
+                guard=self.guard)
+            try:
+                with RecordEvent("train/step"):
+                    new_state, loss = train_step_fn(state, step, ctx)
+                verdict = self.guard.observe(loss)
+            except FloatingPointError:
+                # amp.debugging tensor checker (check_numerics=True)
+                # aborted the step at the op that went non-finite
+                verdict = self.guard.anomaly("nonfinite_op")
+                loss = float("nan")
+            if verdict == OK:
+                state = new_state
+                losses[step] = float(np.asarray(loss))
+                step += 1
+                _m_steps.inc()
+                self._maybe_snapshot(step, state)
+                self._maybe_checkpoint(step, state)
+            elif verdict == SKIP:
+                self.skipped += 1
+                if on_restore is not None:
+                    on_restore(state)     # undo any in-place update
+                step += 1
+            else:                          # ROLLBACK
+                snap_steps = sorted(self._own_snaps)
+                if not snap_steps:
+                    self.skipped += 1      # nothing to roll back onto
+                    if on_restore is not None:
+                        on_restore(state)
+                    step += 1
+                    continue
+                rstep = snap_steps[-1]
+                state = _copy_state(self._own_snaps[rstep])
+                step = rstep
+                self.rollbacks += 1
+                _m_rollbacks.inc()
+                self.guard.reset()
+                if on_restore is not None:
+                    on_restore(state)
+                print(f"[supervisor] rank {self.rank} rolled back to "
+                      f"step {rstep} after {cfg.guard.max_consecutive} "
+                      f"consecutive anomalies "
+                      f"({self.guard.last_reason})",
+                      file=sys.stderr, flush=True)
+        return step, state
+
+
+def run_elastic(train_step_fn: Callable, state: Dict,
+                config: Optional[SupervisorConfig] = None,
+                num_steps: int = 1,
+                on_restore: Optional[Callable] = None,
+                store=None, start_step: int = 0) -> Tuple[Dict, dict]:
+    """Convenience driver: build a Supervisor (store/rank/world from
+    env unless given) and run the self-healing loop."""
+    cfg = config or SupervisorConfig.from_env()
+    sup = Supervisor(cfg, store=store)
+    return sup.run(train_step_fn, state, num_steps,
+                   on_restore=on_restore, start_step=start_step)

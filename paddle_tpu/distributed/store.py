@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Dict, Optional
 
+from .resilience.backoff import delay as _backoff_delay
+
 __all__ = ["TCPStore"]
 
 _OP_SET = 0
@@ -134,6 +136,7 @@ class TCPStore:
         self.host, self.port = host, port
         deadline = time.time() + timeout
         last_err = None
+        attempt = 0
         while time.time() < deadline:
             try:
                 self._sock = socket.create_connection((host, port),
@@ -141,7 +144,13 @@ class TCPStore:
                 break
             except OSError as e:
                 last_err = e
-                time.sleep(0.2)
+                attempt += 1
+                # capped low: the master may be a peer process still
+                # importing; connecting promptly once it binds matters
+                # more than sparing a localhost SYN
+                time.sleep(min(_backoff_delay(attempt, base=0.1,
+                                              cap=0.5),
+                               max(deadline - time.time(), 0.05)))
         else:
             raise ConnectionError(f"cannot reach store {host}:{port}: "
                                   f"{last_err}")

@@ -59,18 +59,17 @@ import numpy as np
 
 from ..profiler import metrics as _metrics
 from .resilience import faults as _faults
+from .resilience.backoff import delay as _backoff_delay
 from .resilience.errors import (FrameCorruptError, PeerUnreachableError,
                                 TransportClosedError, TransportError,
                                 TransportTimeoutError)
 from .store import TCPStore, _recv_exact
 
 __all__ = ["TensorTransport", "init_transport", "get_transport",
-           "shutdown_transport"]
+           "install_transport", "shutdown_transport"]
 
 # retry/backoff knobs (env-overridable; see README "Fault tolerance")
 _MAX_RETRIES = int(os.environ.get("PT_TRANSPORT_MAX_RETRIES", "5"))
-_BACKOFF_BASE_S = 0.05
-_BACKOFF_CAP_S = 2.0
 
 _m_retries = _metrics.counter("comm/retries")
 _m_redials = _metrics.counter("comm/redials")
@@ -97,7 +96,7 @@ def _to_numpy(arr) -> np.ndarray:
 
 
 def _backoff(attempt: int) -> float:
-    return min(_BACKOFF_BASE_S * (2 ** attempt), _BACKOFF_CAP_S)
+    return _backoff_delay(attempt, base=0.05, cap=2.0)
 
 
 def _send_frame(sock, header: dict, payload: bytes):
@@ -165,7 +164,8 @@ class TensorTransport:
     def __init__(self, rank: int, world_size: int, store: TCPStore,
                  bind_host: Optional[str] = None, timeout: float = 300.0,
                  max_retries: Optional[int] = None,
-                 ack_timeout: Optional[float] = None):
+                 ack_timeout: Optional[float] = None,
+                 job: Optional[str] = None):
         self.rank = rank
         self.world_size = world_size
         self.timeout = timeout
@@ -201,8 +201,10 @@ class TensorTransport:
         port = self._server.getsockname()[1]
         self.address = f"{host}:{port}"
         # namespace by job id so a shared/long-lived launcher store never
-        # serves another job's (or a previous incarnation's) addresses
-        self._job = os.environ.get("PADDLE_JOB_ID", "default")
+        # serves another job's (or a previous incarnation's) addresses;
+        # the elastic supervisor passes a per-generation job so a
+        # re-formed pod never dials a dead incarnation's address
+        self._job = job or os.environ.get("PADDLE_JOB_ID", "default")
         store.set(self._peer_key(rank), self.address)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -679,6 +681,17 @@ def init_transport(rank: Optional[int] = None,
 
 def get_transport() -> Optional[TensorTransport]:
     return _transport
+
+
+def install_transport(tp: Optional[TensorTransport]) \
+        -> Optional[TensorTransport]:
+    """Make `tp` the process-global transport. The elastic supervisor
+    uses this when it re-forms the group with a fresh transport, so the
+    comm watchdog's escalation path (which aborts ``get_transport()``)
+    targets the live incarnation, not the one that just died."""
+    global _transport
+    _transport = tp
+    return tp
 
 
 def shutdown_transport():

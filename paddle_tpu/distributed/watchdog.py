@@ -48,9 +48,48 @@ from .resilience.errors import CommTimeoutError
 __all__ = [
     "CommTask", "CommTaskManager", "enable_comm_watchdog",
     "disable_comm_watchdog", "comm_task_manager",
+    "unhealthy_key", "read_unhealthy", "clear_unhealthy",
 ]
 
 _m_escalations = _metrics.counter("comm/watchdog_escalations")
+
+UNHEALTHY_PREFIX = "__unhealthy__"
+
+
+def unhealthy_key(group_id: int) -> str:
+    """Store key under which the watchdog marks a stalled group."""
+    return f"{UNHEALTHY_PREFIX}/{group_id}"
+
+
+def read_unhealthy(store, group_id: int) -> Optional[dict]:
+    """The stalled-task dump a watchdog published for `group_id`, or
+    None. Consumers (launch controller, elastic supervisor) use this as
+    the re-form trigger for hung-but-heartbeating ranks."""
+    try:
+        raw = store.get_nowait(unhealthy_key(group_id))
+    except KeyError:
+        return None
+    except Exception:
+        # the store may be unreachable mid-failure: treat as "no mark"
+        # (counted; the transport error path still drives recovery)
+        _metrics.inc("comm/escalation_store_errors")
+        return None
+    try:
+        return json.loads(raw if isinstance(raw, str) else raw.decode())
+    except (ValueError, AttributeError):
+        return {}
+
+
+def clear_unhealthy(store, group_id: int) -> bool:
+    """Delete a stale ``__unhealthy__/<gid>`` mark. Called after a
+    successful group re-form — a recovered pod must not immediately
+    re-trigger escalation off the previous incarnation's mark. Returns
+    True when a mark was present and cleared."""
+    if read_unhealthy(store, group_id) is None:
+        return False
+    store.delete_key(unhealthy_key(group_id))
+    _metrics.inc("elastic/unhealthy_cleared")
+    return True
 
 
 class CommTask:
@@ -271,7 +310,7 @@ class CommTaskManager:
             tp = get_transport()
             if tp is not None:
                 try:
-                    tp._store.set(f"__unhealthy__/{task.group_id}",
+                    tp._store.set(unhealthy_key(task.group_id),
                                   json.dumps(task.to_dict()))
                 except Exception:
                     # the store may be down WITH the dead peer — the

@@ -14,6 +14,23 @@ Modes (env RESILIENCE_MODE):
   data-frame send); rank 0 runs with the comm watchdog enabled and must
   surface a structured CommTimeoutError within the watchdog timeout
   (escalation path), writing a marker json the parent checks.
+
+- ``elastic``: a 2-rank data-parallel toy training run under the
+  self-healing supervisor (resilience/supervisor.py). PT_FAULT_PLAN
+  kills rank 1 at a step site mid-run; the survivor's watchdog
+  escalates, the parent relaunches rank 1 with PT_SUPERVISOR_REJOIN=1,
+  the group re-forms, the rejoiner restores from the survivor's
+  in-memory ring replica, and both finish all steps. Each rank dumps
+  final weights + per-step losses + metrics; the parent asserts loss
+  parity with an uninterrupted run (toy_reference below) and that the
+  recovery is visible in train/* metrics. A first-encounter-only NaN
+  at TOY_NAN_STEP additionally exercises the skip-anomalous-batch
+  path inside the same run.
+
+- ``torn_save``: writes checkpoint step 1, then dies mid-save of step
+  2 (PT_FAULT_PLAN kill@save — between shard write and manifest
+  publish). The parent asserts resume_from_latest ignores the torn
+  step-2 directory and restores step 1 bitwise-identically.
 """
 import json
 import os
@@ -46,14 +63,18 @@ def run_faults(out_dir, rank):
     for i, tag in enumerate(["drop", "corrupt", "dup", "delay"]):
         results[f"ar_{tag}"] = tp.all_reduce(_base(rank) + i, "sum",
                                              [0, 1], 0)
-    # both ranks quiesce before either tears down its sockets
-    tp.barrier("faults_done", [0, 1])
     snap = metrics.snapshot()
     counters = {name: _counter(snap, name) for name in
                 ("comm/retries", "comm/redials", "comm/corrupt_frames",
                  "comm/dup_frames", "faults/injected")}
     np.savez(os.path.join(out_dir, f"rank{rank}.npz"),
              metrics=json.dumps(counters), **results)
+    # both ranks quiesce before either tears down its sockets; rank 0
+    # hosts the store, so it lingers briefly after the barrier — exiting
+    # immediately can reset rank 1's in-flight barrier poll
+    tp.barrier("faults_done", [0, 1])
+    if rank == 0:
+        time.sleep(1.0)
 
 
 def run_kill(out_dir, rank):
@@ -83,6 +104,127 @@ def run_kill(out_dir, rank):
         json.dump(marker, f)
 
 
+# ---------------------------------------------------------------------------
+# toy deterministic data-parallel trainer (elastic mode + the parent's
+# uninterrupted reference — keep both in this file so they cannot drift)
+# ---------------------------------------------------------------------------
+
+TOY_DIM = 4
+TOY_ROWS = 8          # per rank
+TOY_STEPS = 12
+TOY_LR = 0.1
+_TOY_W_TRUE = (np.arange(TOY_DIM, dtype=np.float64) + 1.0) / TOY_DIM
+
+
+def toy_batch(step, rank):
+    """Deterministic per-(step, rank) regression batch, float64."""
+    r = np.random.RandomState(10_000 + 97 * step + rank)
+    x = r.rand(TOY_ROWS, TOY_DIM)
+    return x, x @ _TOY_W_TRUE
+
+
+def toy_grad_loss(w, step, rank):
+    x, y = toy_batch(step, rank)
+    err = x @ w - y
+    return 2.0 * x.T @ err / len(y), float((err * err).mean())
+
+
+def toy_reference(num_steps=TOY_STEPS, world=2, skip_steps=()):
+    """The uninterrupted trajectory: per-rank grads averaged exactly as
+    the transport's host reduce does (rank-0 part + rank-1 part, then
+    /world). Returns (final_w, losses) — the parity target for the
+    chaos run."""
+    w = np.zeros(TOY_DIM, dtype=np.float64)
+    losses = []
+    for step in range(num_steps):
+        parts = [toy_grad_loss(w, step, r) for r in range(world)]
+        grad = parts[0][0]
+        for g, _ in parts[1:]:
+            grad = np.add(grad, g)
+        grad = grad / world
+        losses.append(float(np.mean([l for _, l in parts])))
+        if step in skip_steps:
+            continue
+        w = w - TOY_LR * grad
+    return w, losses
+
+
+def run_elastic_mode(out_dir, rank):
+    from paddle_tpu.distributed.resilience.guards import GuardConfig
+    from paddle_tpu.distributed.resilience.supervisor import (
+        Supervisor, SupervisorConfig)
+    from paddle_tpu.profiler import metrics
+
+    nan_step = int(os.environ.get("TOY_NAN_STEP", "-1"))
+    nan_fired = []
+
+    def train_fn(state, step, ctx):
+        grad, loss = toy_grad_loss(state["w"], step, rank)
+        grad = ctx.all_reduce(grad, "avg")
+        # the loss both ranks judge must be identical (mean over the
+        # global batch) so their skip verdicts agree
+        loss_arr = ctx.all_reduce(np.asarray([loss]), "avg")
+        loss = float(loss_arr[0])
+        if step == nan_step and not nan_fired:
+            nan_fired.append(step)       # first encounter only (SDC-like)
+            loss = float("nan")
+        return {"w": state["w"] - TOY_LR * grad}, loss
+
+    cfg = SupervisorConfig.from_env(
+        snapshot_every=2, replicate_async=False, max_restarts=1,
+        transport_timeout_s=60.0,
+        watchdog_timeout_s=float(os.environ.get("WATCHDOG_TIMEOUT", "3")),
+        reform_timeout_s=float(os.environ.get("REFORM_TIMEOUT", "90")),
+        heartbeat_ttl_s=4.0,
+        guard=GuardConfig(max_consecutive=3, warmup_steps=100))
+    sup = Supervisor(cfg)
+    unhealthy_after = None
+    state, report = sup.run(
+        train_fn, {"w": np.zeros(TOY_DIM, dtype=np.float64)},
+        num_steps=TOY_STEPS)
+    try:
+        sup.store.get_nowait("__unhealthy__/0")
+        unhealthy_after = True
+    except KeyError:
+        unhealthy_after = False
+    except Exception:
+        unhealthy_after = None           # store gone: can't tell
+    snap = metrics.snapshot()
+    counters = {k: int(v) for k, v in snap["counters"].items()
+                if k.startswith(("train/", "faults/", "elastic/"))}
+    np.savez(os.path.join(out_dir, f"rank{rank}.npz"),
+             w=state["w"], losses=np.asarray(report["losses"]),
+             report=json.dumps({
+                 "final_step": report["final_step"],
+                 "restarts": report["restarts"],
+                 "skipped": report["skipped"],
+                 "anomalies": report["anomalies"],
+                 "recovery_sources": report["recovery_sources"],
+                 "unhealthy_after": unhealthy_after,
+             }),
+             metrics=json.dumps(counters))
+
+
+def run_torn_save(out_dir, rank):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.resilience.recovery import save_checkpoint
+
+    root = os.path.join(out_dir, "ckpts")
+    paddle.seed(7)
+    model = nn.Linear(4, 2)
+    sd = model.state_dict()
+    save_checkpoint(sd, root, step=1)
+    with open(os.path.join(out_dir, "step1_state.json"), "w") as f:
+        f.write(json.dumps({k: np.asarray(v.numpy()).tolist()
+                            for k, v in sd.items()}))
+    # mutate, then save step 2 — PT_FAULT_PLAN=kill@save#1 kills this
+    # process between the shard write and the manifest publish
+    sd2 = {k: np.asarray(v.numpy()) + 1.0 for k, v in sd.items()}
+    save_checkpoint(sd2, root, step=2)
+    raise SystemExit("kill@save did not fire")     # must not get here
+
+
 def main():
     mode = os.environ["RESILIENCE_MODE"]
     out_dir = os.environ["RESILIENCE_OUT_DIR"]
@@ -91,6 +233,10 @@ def main():
         run_faults(out_dir, rank)
     elif mode == "kill":
         run_kill(out_dir, rank)
+    elif mode == "elastic":
+        run_elastic_mode(out_dir, rank)
+    elif mode == "torn_save":
+        run_torn_save(out_dir, rank)
     else:
         raise SystemExit(f"unknown RESILIENCE_MODE {mode!r}")
 

@@ -577,6 +577,78 @@ def test_pt5xx_out_of_scope_path_is_clean(tmp_path):
     assert not [i for i in ids(rep) if i.startswith("PT5")]
 
 
+SLEEP_RETRY = """
+    import time
+
+    def connect(sock, addr):
+        while True:
+            try:
+                sock.connect(addr)
+                return
+            except OSError:
+                time.sleep(0.2)
+"""
+
+
+def test_pt503_constant_sleep_retry_flagged(tmp_path):
+    rep = _lint_distributed(tmp_path, SLEEP_RETRY)
+    assert "PT503" in ids(rep)
+
+
+def test_pt503_backoff_helper_is_clean(tmp_path):
+    rep = _lint_distributed(tmp_path, """
+        import time
+        from paddle_tpu.distributed.resilience.backoff import delay
+
+        def connect(sock, addr):
+            attempt = 0
+            while True:
+                try:
+                    sock.connect(addr)
+                    return
+                except OSError:
+                    attempt += 1
+                    time.sleep(delay(attempt))
+    """)
+    assert "PT503" not in ids(rep)
+
+
+def test_pt503_poll_loop_without_handler_is_clean(tmp_path):
+    # a pure poll loop (no exception handler) is not a retry loop
+    rep = _lint_distributed(tmp_path, """
+        import time
+
+        def wait_ready(store):
+            while not store.ready():
+                time.sleep(0.5)
+    """)
+    assert "PT503" not in ids(rep)
+
+
+def test_pt503_sleep_in_nested_def_is_clean(tmp_path):
+    # the sleep belongs to an inner function's own context, not the loop
+    rep = _lint_distributed(tmp_path, """
+        import time
+
+        def build(workers):
+            for w in workers:
+                try:
+                    w.start()
+                except OSError:
+                    pass
+
+                def later():
+                    time.sleep(1.0)
+                w.on_exit(later)
+    """)
+    assert "PT503" not in ids(rep)
+
+
+def test_pt503_out_of_scope_is_clean(tmp_path):
+    rep = lint(tmp_path, SLEEP_RETRY)
+    assert "PT503" not in ids(rep)
+
+
 # ---------------------------------------------------------------------------
 # engine mechanics: suppression, baseline, reporters, select
 # ---------------------------------------------------------------------------

@@ -1,0 +1,533 @@
+"""Self-healing training: elastic supervisor + numerical guards.
+
+In-process coverage for paddle_tpu/distributed/resilience/supervisor.py
+and guards.py (the 2-process kill/rejoin chaos run lives in
+test_resilience.py):
+
+- StepGuard verdicts: finiteness, relative loss-spike, skip-then-
+  rollback policy, metrics, amp.debugging tensor-checker wiring.
+- Gradient-checksum SDC agreement over a real transport pair.
+- run_elastic single-process: NaN skip, rollback-to-snapshot, disk-tier
+  resume parity, startup torn-checkpoint sweep.
+- A full in-process 2-rank supervised run (two Supervisors on threads)
+  asserting the __unhealthy__ mark lifecycle and loss parity.
+- HybridTrainer elastic_state round-trip + run_elastic wiring.
+- The PT_FAULT_PLAN offline validator (module CLI + jax-free tool).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import transport as tr
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.distributed.resilience.guards import (
+    GuardConfig, OK, ROLLBACK, SKIP, StepGuard, grad_checksum)
+from paddle_tpu.distributed.resilience.recovery import (
+    latest_checkpoint, list_checkpoints, resume_from_latest,
+    save_checkpoint, sweep_incomplete)
+from paddle_tpu.distributed.resilience.supervisor import (
+    Supervisor, SupervisorConfig, run_elastic)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.watchdog import (clear_unhealthy,
+                                             read_unhealthy,
+                                             unhealthy_key)
+from paddle_tpu.profiler import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cval(name):
+    return metrics.counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# StepGuard
+# ---------------------------------------------------------------------------
+
+def test_guard_accepts_normal_losses():
+    g = StepGuard(GuardConfig())
+    for i in range(10):
+        assert g.observe(1.0 / (i + 1)) == OK
+    assert g.anomalies == 0 and g.consecutive == 0
+
+
+def test_guard_nonfinite_loss_and_grad():
+    a0 = _cval("train/anomalies")
+    s0 = _cval("train/skipped_batches")
+    g = StepGuard(GuardConfig(max_consecutive=3))
+    assert g.observe(float("nan")) == SKIP
+    assert g.last_reason == "nonfinite_loss"
+    assert g.observe(1.0, grad_norm=float("inf")) == SKIP
+    assert g.last_reason == "nonfinite_grad"
+    assert _cval("train/anomalies") == a0 + 2
+    assert _cval("train/skipped_batches") == s0 + 2
+
+
+def test_guard_loss_spike_detection():
+    g = StepGuard(GuardConfig(spike_factor=5.0, warmup_steps=3))
+    for _ in range(6):
+        assert g.observe(1.0) == OK
+    assert g.observe(1.2) == OK           # within threshold
+    assert g.observe(50.0) == SKIP        # > 5x EMA
+    assert g.last_reason == "loss_spike"
+    # the spike did not poison the EMA
+    assert g.observe(1.0) == OK
+
+
+def test_guard_rollback_after_k_consecutive():
+    g = StepGuard(GuardConfig(max_consecutive=3))
+    assert g.observe(float("nan")) == SKIP
+    assert g.observe(float("nan")) == SKIP
+    assert g.observe(float("nan")) == ROLLBACK
+    # streak resets after the rollback verdict
+    assert g.observe(float("nan")) == SKIP
+
+
+def test_guard_wires_amp_tensor_checker():
+    """check_numerics=True must install amp.debugging's existing
+    tensor-checker path (not a parallel one) for the guarded region."""
+    from paddle_tpu.amp import debugging as amp_dbg
+
+    g = StepGuard(GuardConfig(check_numerics=True))
+    assert amp_dbg._checker is None
+    with g:
+        assert amp_dbg._checker is not None
+        assert amp_dbg._checker.debug_mode == \
+            amp_dbg.DebugMode.CHECK_NAN_INF_AND_ABORT
+        # a NaN-producing op aborts at the op via the checker
+        with pytest.raises(FloatingPointError):
+            paddle.log(paddle.to_tensor(np.asarray([-1.0], np.float32)))
+    assert amp_dbg._checker is None       # uninstalled on exit
+
+
+def test_guard_uses_shared_nonfinite_probe():
+    """The guard's finiteness check is amp.debugging.nonfinite_counts —
+    array losses (incl. 0-d) go through the same probe as the per-op
+    checker."""
+    g = StepGuard(GuardConfig())
+    assert g.observe(np.asarray([0.5, 0.25])) == OK
+    assert g.observe(np.asarray([0.5, float("inf")])) == SKIP
+
+
+def test_grad_checksum_bitwise():
+    a = {"w": np.arange(8, dtype=np.float32),
+         "b": np.ones(3, np.float64)}
+    b = {"w": np.arange(8, dtype=np.float32),
+         "b": np.ones(3, np.float64)}
+    assert grad_checksum(a) == grad_checksum(b)
+    b["w"] = b["w"].copy()
+    b["w"][5] = np.nextafter(b["w"][5], 99, dtype=np.float32)  # 1 ulp
+    assert grad_checksum(a) != grad_checksum(b)
+
+
+def test_grad_agreement_flags_divergent_rank():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    t0 = tr.TensorTransport(0, 2, store, bind_host="127.0.0.1",
+                            timeout=15.0, ack_timeout=3.0)
+    t1 = tr.TensorTransport(1, 2, store, bind_host="127.0.0.1",
+                            timeout=15.0, ack_timeout=3.0)
+    try:
+        sdc0 = _cval("train/sdc_flags")
+        grads = {"w": np.arange(6, dtype=np.float32)}
+        corrupted = {"w": np.arange(6, dtype=np.float32)}
+        corrupted["w"][3] += 0.5           # SDC on rank 1
+        out = {}
+
+        def side(rank, tp, g):
+            guard = StepGuard(GuardConfig(grad_checksum=True))
+            out[rank] = guard.check_grad_agreement(
+                g, tp, [0, 1], gid=0, rank=rank)
+
+        th = threading.Thread(target=side, args=(1, t1, corrupted),
+                              daemon=True)
+        th.start()
+        side(0, t0, grads)
+        th.join(timeout=10)
+        # with 2 ranks the majority is ambiguous but stable: both sides
+        # agree on WHICH ranks disagree, and the event is counted
+        assert out[0] == out[1]
+        assert len(out[0]) == 1
+        assert _cval("train/sdc_flags") > sdc0
+    finally:
+        t0.close()
+        t1.close()
+        store.close()
+
+
+def test_grad_agreement_clean_when_identical():
+    g = StepGuard(GuardConfig())
+    # world==1 / no transport: trivially clean
+    assert g.check_grad_agreement({"w": np.ones(4)}, None, [0], 0, 0) == []
+
+
+# ---------------------------------------------------------------------------
+# run_elastic: single-process toy training
+# ---------------------------------------------------------------------------
+
+W_TRUE = (np.arange(4, dtype=np.float64) + 1.0) / 4
+
+
+def _toy_batch(step):
+    r = np.random.RandomState(500 + step)
+    x = r.rand(8, 4)
+    return x, x @ W_TRUE
+
+
+def _make_train_fn(nan_steps=(), nan_once=True):
+    fired = set()
+
+    def train_fn(state, step, ctx):
+        x, y = _toy_batch(step)
+        err = x @ state["w"] - y
+        grad = ctx.all_reduce(2.0 * x.T @ err / len(y), "avg")
+        loss = float((err * err).mean())
+        if step in nan_steps and (not nan_once or step not in fired):
+            fired.add(step)
+            loss = float("nan")
+        return {"w": state["w"] - 0.1 * grad}, loss
+
+    return train_fn
+
+
+def _clean_run(num_steps, skip_steps=()):
+    w = np.zeros(4)
+    losses = []
+    for step in range(num_steps):
+        x, y = _toy_batch(step)
+        err = x @ w - y
+        losses.append(float((err * err).mean()))
+        if step in skip_steps:
+            continue
+        w = w - 0.1 * (2.0 * x.T @ err / len(y))
+    return w, losses
+
+
+def test_run_elastic_clean_single_process():
+    s0 = _cval("train/steps")
+    cfg = SupervisorConfig(world_size=1, snapshot_every=4)
+    state, report = run_elastic(_make_train_fn(), {"w": np.zeros(4)},
+                                cfg, num_steps=8)
+    w_ref, losses_ref = _clean_run(8)
+    np.testing.assert_allclose(state["w"], w_ref, rtol=0, atol=0)
+    np.testing.assert_allclose(report["losses"], losses_ref)
+    assert report["final_step"] == 8 and report["restarts"] == 0
+    assert _cval("train/steps") == s0 + 8
+
+
+def test_run_elastic_nan_step_skipped_not_fatal():
+    a0 = _cval("train/anomalies")
+    cfg = SupervisorConfig(
+        world_size=1, snapshot_every=4,
+        guard=GuardConfig(max_consecutive=3, warmup_steps=100))
+    state, report = run_elastic(_make_train_fn(nan_steps={5}),
+                                {"w": np.zeros(4)}, cfg, num_steps=10)
+    # the offending batch is dropped; the run completes
+    assert report["final_step"] == 10
+    assert report["skipped"] == 1 and report["anomalies"] == 1
+    assert np.isnan(report["losses"][5])
+    w_ref, _ = _clean_run(10, skip_steps={5})
+    np.testing.assert_allclose(state["w"], w_ref)
+    assert _cval("train/anomalies") == a0 + 1
+
+
+def test_run_elastic_rollback_after_consecutive_anomalies():
+    r0 = _cval("train/rollbacks")
+    cfg = SupervisorConfig(
+        world_size=1, snapshot_every=2,
+        guard=GuardConfig(max_consecutive=2, warmup_steps=100))
+    # steps 5 and 6 NaN on first encounter: skip at 5, rollback at 6
+    # (to the step-4 snapshot); the replay is clean
+    state, report = run_elastic(_make_train_fn(nan_steps={5, 6}),
+                                {"w": np.zeros(4)}, cfg, num_steps=10)
+    assert report["final_step"] == 10
+    assert report["rollbacks"] == 1
+    assert report["anomalies"] == 2
+    assert _cval("train/rollbacks") == r0 + 1
+    # rollback + clean replay converges to the uninterrupted trajectory
+    w_ref, losses_ref = _clean_run(10)
+    np.testing.assert_allclose(state["w"], w_ref)
+    np.testing.assert_allclose(report["losses"], losses_ref)
+
+
+def test_run_elastic_disk_tier_resume(tmp_path):
+    """Stop after 6 steps (disk checkpoints every 3), then a fresh
+    supervisor resumes from step_<N> and reaches the uninterrupted
+    trajectory bitwise."""
+    root = str(tmp_path / "ckpts")
+    cfg = SupervisorConfig(world_size=1, snapshot_every=0,
+                           ckpt_root=root, ckpt_every=3, keep=2)
+    state6, rep6 = run_elastic(_make_train_fn(), {"w": np.zeros(4)},
+                               cfg, num_steps=6)
+    assert latest_checkpoint(root)[0] == 6
+    # "restart": fresh supervisor, fresh (wrong) initial state
+    cfg2 = SupervisorConfig(world_size=1, snapshot_every=0,
+                            ckpt_root=root, ckpt_every=3, keep=2)
+    state12, rep12 = run_elastic(
+        _make_train_fn(), {"w": np.full(4, 99.0)}, cfg2, num_steps=12)
+    w_ref, _ = _clean_run(12)
+    np.testing.assert_allclose(state12["w"], w_ref, rtol=0, atol=0)
+    # keep=2 retention held
+    assert len(list_checkpoints(root)) <= 2
+
+
+# ---------------------------------------------------------------------------
+# in-process 2-rank supervised run: unhealthy-mark lifecycle + parity
+# ---------------------------------------------------------------------------
+
+def test_two_rank_supervisor_clears_stale_unhealthy_mark():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    # a stale mark from a previous incarnation is present at formation
+    store.set(unhealthy_key(0), json.dumps({"op": "all_reduce"}))
+    c0 = _cval("elastic/unhealthy_cleared")
+    results = {}
+
+    def side(rank):
+        cfg = SupervisorConfig(
+            rank=rank, world_size=2, job_id=f"t2r{os.getpid()}",
+            snapshot_every=2, replicate_async=True,
+            transport_timeout_s=20.0, reform_timeout_s=20.0,
+            guard=GuardConfig(warmup_steps=100))
+        # one store CLIENT per supervisor, as in real multi-process
+        # deployments (a shared client would serialize blocking waits)
+        client = TCPStore("127.0.0.1", store.port, is_master=False)
+        sup = Supervisor(cfg, store=client)
+        state, report = sup.run(_make_train_fn(), {"w": np.zeros(4)},
+                                num_steps=6)
+        # the async ring exchange delivered the peer's replica
+        results[rank] = (state, report, dict(sup._replicas))
+
+    th = threading.Thread(target=side, args=(1,), daemon=True)
+    th.start()
+    side(0)
+    th.join(timeout=30)
+    try:
+        assert 0 in results and 1 in results
+        # both ranks trained in lockstep to the same weights
+        np.testing.assert_allclose(results[0][0]["w"],
+                                   results[1][0]["w"], rtol=0, atol=0)
+        # the async snapshot ring delivered each rank's state to its
+        # neighbor (snapshots at 2/4/6, last snapshots_kept=2 retained)
+        for rank, other in ((0, 1), (1, 0)):
+            replicas = results[rank][2]
+            assert (other, 6) in replicas, sorted(replicas)
+            np.testing.assert_allclose(replicas[(other, 6)]["w"],
+                                       results[other][0]["w"],
+                                       rtol=0, atol=0)
+        # the stale mark was consumed/cleared on successful formation
+        assert read_unhealthy(store, 0) is None
+        assert _cval("elastic/unhealthy_cleared") == c0 + 1
+    finally:
+        store.close()
+
+
+def test_unhealthy_mark_helpers_lifecycle():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        assert read_unhealthy(store, 3) is None
+        assert clear_unhealthy(store, 3) is False       # idempotent
+        store.set(unhealthy_key(3), json.dumps({"op": "barrier",
+                                                "seq": 9}))
+        assert read_unhealthy(store, 3)["seq"] == 9
+        assert clear_unhealthy(store, 3) is True
+        assert read_unhealthy(store, 3) is None
+        assert clear_unhealthy(store, 3) is False
+    finally:
+        store.close()
+
+
+def test_launch_controller_clears_mark_before_spawn():
+    from paddle_tpu.distributed.launch.main import Controller, parse_args
+
+    args = parse_args(["--nnodes", "1:2", "dummy.py"])
+    c = Controller(args)
+    c.store = TCPStore("127.0.0.1", 0, is_master=True)
+    try:
+        c.store.set(unhealthy_key(0), b"{}")
+        assert c._unhealthy_group() == 0
+        c._clear_unhealthy(0)
+        assert c._unhealthy_group() is None
+        c._clear_unhealthy(0)                            # idempotent
+    finally:
+        c.store.close()
+
+
+def test_launch_controller_forwards_supervisor_env(tmp_path):
+    from paddle_tpu.distributed.launch.main import (Controller, Pod,
+                                                    parse_args)
+
+    args = parse_args(["--nnodes", "1", "--max_restart", "4",
+                       "--ckpt_dir", str(tmp_path / "ck"),
+                       "--snapshot_every", "8", "dummy.py"])
+    c = Controller(args)
+    pod = Pod(0, ["127.0.0.1:1234"], 1)
+    c.store = type("S", (), {"port": 0})()
+    env = c._worker_env(pod, 0)
+    assert env["PT_SUPERVISOR_MAX_RESTARTS"] == "4"
+    assert env["PT_CKPT_ROOT"] == str(tmp_path / "ck")
+    assert env["PT_SNAPSHOT_EVERY"] == "8"
+    assert "PT_SUPERVISOR_REJOIN" not in env
+    c.generation = 2                       # re-formed pod => rejoin flag
+    env = c._worker_env(pod, 0)
+    assert env["PT_SUPERVISOR_REJOIN"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# checkpoint retention: startup sweep + keep-last-K
+# ---------------------------------------------------------------------------
+
+def _torn_dir(root, step):
+    d = os.path.join(root, f"step_{step:08d}")
+    os.makedirs(d)
+    with open(os.path.join(d, "0_0.distcp"), "wb") as f:
+        f.write(b"torn")
+    return d
+
+
+def test_sweep_incomplete_removes_torn_dirs(tmp_path):
+    root = str(tmp_path / "ckpts")
+    sd = {"w": np.arange(4, dtype=np.float32)}
+    save_checkpoint(sd, root, step=1)
+    torn5 = _torn_dir(root, 5)
+    torn9 = _torn_dir(root, 9)
+    s0 = _cval("ckpt/swept_incomplete")
+    removed = sweep_incomplete(root)
+    assert sorted(removed) == sorted([torn5, torn9])
+    assert not os.path.exists(torn5) and not os.path.exists(torn9)
+    assert [s for s, _ in list_checkpoints(root)] == [1]
+    assert _cval("ckpt/swept_incomplete") == s0 + 2
+    assert sweep_incomplete(root) == []    # idempotent
+    assert sweep_incomplete(str(tmp_path / "missing")) == []
+
+
+def test_resume_startup_sweep(tmp_path):
+    root = str(tmp_path / "ckpts")
+    sd = {"w": np.arange(4, dtype=np.float32)}
+    save_checkpoint(sd, root, step=2)
+    torn = _torn_dir(root, 7)
+    target = {"w": np.zeros(4, np.float32)}
+    assert resume_from_latest(target, root) == 2
+    assert not os.path.exists(torn)        # swept at startup
+    np.testing.assert_array_equal(
+        np.asarray(target["w"].numpy()), np.arange(4, dtype=np.float32))
+
+
+def test_save_checkpoint_keep_counts_pruned(tmp_path):
+    root = str(tmp_path / "ckpts")
+    p0 = _cval("ckpt/pruned")
+    for step in (1, 2, 3, 4):
+        save_checkpoint({"w": np.full(2, float(step))}, root, step,
+                        keep=2)
+    assert [s for s, _ in list_checkpoints(root)] == [3, 4]
+    assert _cval("ckpt/pruned") == p0 + 2
+
+
+# ---------------------------------------------------------------------------
+# HybridTrainer elastic wiring
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.fleet.trainer import HybridTrainer
+    from paddle_tpu.models import llama
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1, 1, 1),
+                ("dp", "pp", "sharding", "sep", "mp"))
+    cfg = llama.LlamaConfig(vocab_size=64, hidden_size=16,
+                            intermediate_size=32, num_hidden_layers=1,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            max_position_embeddings=32, dtype="float32")
+    return HybridTrainer(cfg, mesh, learning_rate=1e-2)
+
+
+def _trainer_batch(step):
+    r = np.random.RandomState(77 + step)
+    ids = r.randint(0, 64, (2, 8)).astype(np.int32)
+    return ids, np.roll(ids, -1, 1)
+
+
+def test_trainer_elastic_state_roundtrip(tiny_trainer):
+    import jax
+
+    trn = tiny_trainer
+    ids, labels = _trainer_batch(0)
+    trn.step(ids, labels)
+    saved = trn.elastic_state()
+    l1 = float(jax.device_get(trn.step(ids, labels)))
+    trn.step(ids, labels)                  # diverge further
+    trn.load_elastic_state(saved)          # restore (reshard-on-load)
+    assert trn.step_count == int(saved["step"])
+    l1b = float(jax.device_get(trn.step(ids, labels)))
+    assert np.float32(l1).tobytes() == np.float32(l1b).tobytes()
+
+
+def test_trainer_run_elastic(tiny_trainer):
+    trn = tiny_trainer
+    start = trn.step_count
+    cfg = SupervisorConfig(world_size=1, snapshot_every=2,
+                           guard=GuardConfig(warmup_steps=100))
+    state, report = trn.run_elastic(_trainer_batch,
+                                    num_steps=start + 3, config=cfg)
+    assert report["final_step"] == start + 3
+    assert trn.step_count == start + 3
+    assert all(np.isfinite(l) for l in report["losses"])
+
+
+# ---------------------------------------------------------------------------
+# fault plan validation CLI
+# ---------------------------------------------------------------------------
+
+def test_faults_check_cli_in_process(capsys):
+    assert faults.main(["--check", "drop@send#2,kill@step#5:rank=1"]) == 0
+    out = capsys.readouterr().out
+    assert "kill@step#5:rank=1" in out
+    assert faults.main(["--check", "boom@send#1"]) == 2
+    assert faults.main(["--check", "drop@nowhere#1"]) == 2
+    assert faults.main([]) == 2            # nothing to validate
+
+
+def test_faultplan_tool_is_jax_free():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "none"          # would crash on jax init
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "faultplan.py"),
+         "kill@save#1,delay@step#2:ms=50"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "2 rule(s)" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "faultplan.py"),
+         "--check", "kill@banana#1"], capture_output=True, text=True,
+        env=env, timeout=60)
+    assert bad.returncode == 2
+
+
+def test_step_site_kill_and_delay_parse():
+    p = faults.parse_plan("kill@step#5:rank=1,delay@save#1:ms=10")
+    assert p.rules[0].site == "step" and p.rules[0].nth == 5
+    assert p.rules[1].site == "save"
+
+
+def test_new_train_metrics_are_known():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    for name in ("train/restarts", "train/reform_ms", "train/steps",
+                 "train/anomalies", "train/rollbacks",
+                 "train/skipped_batches", "train/snapshots",
+                 "train/sdc_flags", "ckpt/pruned",
+                 "ckpt/swept_incomplete", "elastic/unhealthy_cleared"):
+        assert trace_report._known(name), name
+    assert trace_report._known("train/recovery_source/peer")
+    assert trace_report._known("train/recovery_source/disk")

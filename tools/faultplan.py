@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""faultplan — validate a PT_FAULT_PLAN chaos plan offline.
+
+Equivalent to ``python -m paddle_tpu.distributed.resilience.faults
+--check "<plan>"`` but loads the DSL parser directly from source files
+with stub parent packages, so it runs without importing the framework
+(no jax import — CI validates a plan in milliseconds before a pod ever
+sees it).
+
+Usage:
+  python tools/faultplan.py "drop@send#2,kill@step#5:rank=1"
+  python tools/faultplan.py --check "seed=7,corrupt@send%0.05"
+  PT_FAULT_PLAN="kill@save#1" python tools/faultplan.py
+
+Exit codes: 0 = plan parses (normalized form printed), 2 = invalid.
+"""
+import importlib.util
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name: str, path: str):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_faults():
+    """Import ...resilience.faults with stub parents (skipping every
+    package __init__ and its jax import)."""
+    pkg = os.path.join(_REPO, "paddle_tpu")
+    for stub in ("paddle_tpu", "paddle_tpu.profiler",
+                 "paddle_tpu.distributed",
+                 "paddle_tpu.distributed.resilience"):
+        if stub not in sys.modules:
+            m = types.ModuleType(stub)
+            m.__path__ = [os.path.join(
+                pkg, *stub.split(".")[1:])] if stub != "paddle_tpu" \
+                else [pkg]
+            sys.modules[stub] = m
+    metrics = _load("paddle_tpu.profiler.metrics",
+                    os.path.join(pkg, "profiler", "metrics.py"))
+    sys.modules["paddle_tpu.profiler"].metrics = metrics
+    return _load("paddle_tpu.distributed.resilience.faults",
+                 os.path.join(pkg, "distributed", "resilience",
+                              "faults.py"))
+
+
+def main(argv=None) -> int:
+    return _load_faults().main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,11 +49,20 @@ KNOWN_METRICS = (
     "comm/escalation_errors", "comm/escalation_store_errors",
     "comm/close_errors", "comm/peer_close_errors",
     "comm/recv_loop_close_errors",
-    # elastic manager (distributed/elastic.py)
+    # elastic manager (distributed/elastic.py) + supervisor re-form
     "elastic/heartbeat_errors", "elastic/last_beat_ts",
-    "elastic/membership_changes",
+    "elastic/membership_changes", "elastic/unhealthy_cleared",
     # chaos injector (distributed/resilience/faults.py)
     "faults/injected", "faults/*",
+    # self-healing training loop (distributed/resilience/supervisor.py
+    # + guards.py): restarts/re-forms, recovery tiers, snapshot ring,
+    # numerical-anomaly policy, SDC agreement probe
+    "train/restarts", "train/reform_ms", "train/recovery_source/*",
+    "train/steps", "train/snapshots", "train/snapshot_bytes",
+    "train/replication_errors", "train/anomalies",
+    "train/skipped_batches", "train/rollbacks", "train/sdc_flags",
+    # checkpoint retention (distributed/resilience/recovery.py)
+    "ckpt/pruned", "ckpt/swept_incomplete",
     # serving engine (inference/serving.py)
     "serving/ttft_ms", "serving/tpot_ms", "serving/steps",
     "serving/tokens_generated", "serving/requests",
