@@ -17,7 +17,7 @@ __all__ = ["AnalysisResult", "analyze", "shipped_passes"]
 
 
 def shipped_passes():
-    """The five registered Program passes, as (name, callable) — what
+    """The six registered Program passes, as (name, callable) — what
     pass-equivalence verification exercises by default."""
     import functools
 
@@ -28,6 +28,7 @@ def shipped_passes():
         ("constant_folding", P.constant_folding),
         ("fuse_chain[matmul,relu]",
          functools.partial(P.fuse_chain, names=["matmul", "relu"])),
+        ("auto_fuse", P.auto_fuse),
         ("amp_insertion", P.amp_insertion),
         ("recompute_pass", P.recompute_pass),
     ]

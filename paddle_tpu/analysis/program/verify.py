@@ -3,8 +3,8 @@
 
 A Program pass is a structural rewrite of the recorded op list; the
 contract every shipped pass (``dead_op_elimination``,
-``constant_folding``, ``fuse_chain``, ``amp_insertion``,
-``recompute_pass``) must honor is that **fetchable values keep their
+``constant_folding``, ``fuse_chain``, ``auto_fuse``,
+``amp_insertion``, ``recompute_pass``) must honor is that **fetchable values keep their
 shapes and dtypes**.  ``verify_pass`` snapshots the program's abstract
 signature (fetch uid -> ShapeDtypeStruct via the shared dataflow core,
 plus the producer/consumer graph), runs the pass, re-snapshots, and

@@ -32,6 +32,16 @@ def op_flops(name: str, in_avals: Sequence, out_avals: Sequence) -> int:
     input/output values (jax.ShapeDtypeStruct-likes)."""
     lname = name.lower()
     out_elems = sum(_numel(a) for a in out_avals)
+    if any(k in lname for k in ("recompute::", "fused_")):
+        # composed region: charge the elementwise floor.  This must be
+        # checked FIRST — an auto_fuse region's name carries its member
+        # list (e.g. "fused_auto[matmul+relu]"), and letting it fall
+        # into the matmul branch would price the whole region as one
+        # dense op with a bogus contraction dim.  The replay's true
+        # compute is the sum of its members (the pre-fusion rows show
+        # it); the roofline signal fusion changes is BYTES, which are
+        # computed from the region's external inputs/outputs.
+        return out_elems
     if any(k in lname for k in ("matmul", "linear", "fc_", "bmm",
                                 "addmm", "dense")):
         # out[..., m, n] contracted over k = last dim of the first input
@@ -51,10 +61,6 @@ def op_flops(name: str, in_avals: Sequence, out_avals: Sequence) -> int:
         return 2 * out_elems
     if any(k in lname for k in ("softmax", "norm", "attention")):
         return 5 * out_elems          # exp/sum/div or mean/var/scale
-    if any(k in lname for k in ("recompute::", "fused_")):
-        # composed region: charge the elementwise floor; the replay's
-        # true cost is the sum of its members (pre-fusion rows show it)
-        return out_elems
     # elementwise / data-movement floor
     return out_elems
 

@@ -43,7 +43,8 @@ class HybridTrainer:
                  weight_decay=0.1, beta1=0.9, beta2=0.95, eps=1e-8,
                  grad_clip_norm: Optional[float] = 1.0, seed: int = 0,
                  remat: bool = True,
-                 pipeline_micro_batches: Optional[int] = None):
+                 pipeline_micro_batches: Optional[int] = None,
+                 overlap_sends: bool = False):
         self.config = config
         self.mesh = mesh
         self.lr = learning_rate
@@ -52,6 +53,10 @@ class HybridTrainer:
         self.eps = eps
         self.clip = grad_clip_norm
         self.remat = remat
+        # latency-hidden pipeline sends (spmd_pipeline overlap_sends):
+        # each tick's micro-batch half-splits so the first half's ICI hop
+        # runs behind the second half's compute
+        self.overlap_sends = overlap_sends
         # pp>1 + micro-batches => schedule-driven compiled pipeline
         # (spmd_pipeline ring inside shard_map); otherwise the pp axis is a
         # pure GSPMD layer-stack placement.
@@ -99,13 +104,15 @@ class HybridTrainer:
         remat = self.remat
         mesh = self.mesh
         pipelined = self.pipelined
+        overlap_sends = self.overlap_sends
         spec = llama_mod.microbatch_spec() if pipelined else data_spec()
         batch_sharding = NamedSharding(self.mesh, spec)
 
         def train_step(params, opt_state, input_ids, labels, lr, t):
             if pipelined:
                 loss_of = lambda p: llama_mod.loss_fn_pipelined(  # noqa: E731
-                    p, (input_ids, labels), cfg, mesh, remat=remat)
+                    p, (input_ids, labels), cfg, mesh, remat=remat,
+                    overlap_sends=overlap_sends)
             else:
                 # sep>1: ring-attention context parallel inside the trunk
                 sep_mesh = mesh if mesh.shape.get("sep", 1) > 1 else None

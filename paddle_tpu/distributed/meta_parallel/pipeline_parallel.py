@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
+from ...profiler import metrics as _metrics
 from .pp_layers import PipelineLayer
 from ...utils.jax_compat import axis_size as _axis_size
 
@@ -176,13 +177,22 @@ class _ChunkExecutor:
         genuine zero-bubble split: B runs ONLY the input-grad pullback
         (critical path, graph retained), and each W instruction runs the
         weight-grad pullback itself — real deferred compute in the bubble
-        slot, matching pipeline_zero_bubble.py's B/W decomposition."""
+        slot, matching pipeline_zero_bubble.py's B/W decomposition.
+
+        Cross-stage activation hand-offs are dispatched asynchronously
+        by the single controller; the wall-clock between a chunk output's
+        dispatch and its consumption by the next virtual stage is the
+        window the schedule hides the transfer in — recorded per hand-off
+        as the ``comm/overlap_ms`` histogram."""
+        import time as _time
+
         from ...core import autograd
 
         n_micro = len(micros)
         acts = {}     # (micro, gv) -> (x_in, out_or_loss)
         cots = {}     # (micro, gv) -> upstream cotangent for chunk output
         dws = {}      # (micro, gv) -> param grads awaiting W (split_bw)
+        hand = {}     # (micro, gv) -> dispatch ts of the F hand-off
         total_loss = None
 
         ptr = [0] * self.p
@@ -201,9 +211,16 @@ class _ChunkExecutor:
                         prev = acts.get((mi, gv - 1))
                         if prev is None:
                             continue
+                        t_sent = hand.pop((mi, gv - 1), None)
+                        if t_sent is not None:
+                            _metrics.observe(
+                                "comm/overlap_ms",
+                                (_time.perf_counter() - t_sent) * 1e3)
                         x_in = prev[1].detach()
                         x_in.stop_gradient = False
                     out = self._run_chunk(gv, x_in)
+                    if gv < self.q - 1:
+                        hand[(mi, gv)] = _time.perf_counter()
                     if gv == self.q - 1:
                         y = micros[mi][1]
                         if self._loss_fn is not None and y is not None:
@@ -322,7 +339,7 @@ class PipelineParallelZeroBubble(PipelineParallelWithInterleave):
 
 
 def spmd_pipeline(stage_fn: Callable, stacked_params, x, n_micro: int,
-                  axis_name: str = "pp"):
+                  axis_name: str = "pp", overlap_sends: bool = False):
     """Collective-permute GPipe pipeline, to be called INSIDE shard_map over
     the 'pp' axis.
 
@@ -335,11 +352,23 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, n_micro: int,
     Returns [n_micro, mb, ...] outputs valid on the LAST stage.
     Total steps = n_micro + P - 1; each step: compute on current buffer,
     then ppermute the activation ring one hop toward the next stage.
+
+    ``overlap_sends=True`` is the latency-hidden variant: each tick's
+    micro-batch is split into two halves along the batch dim, and the
+    first half's ppermute is issued BEFORE the second half's compute —
+    giving XLA's scheduler a real window to run the ICI hop behind the
+    MXU instead of serializing compute -> send.  Requires a per-sample
+    stage_fn (true for transformer blocks) and an even micro-batch;
+    otherwise the call falls back to the unsplit schedule.  Numerics
+    are identical either way (the halves are independent rows).
     """
     p = _axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
     n_steps = n_micro + p - 1
     mb_shape = x.shape[1:]
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    split = overlap_sends and len(mb_shape) >= 1 \
+        and mb_shape[0] % 2 == 0 and mb_shape[0] >= 2
 
     def body(carry, t):
         state, outputs = carry
@@ -347,7 +376,20 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, n_micro: int,
         feed = jnp.where(t < n_micro, jnp.minimum(t, n_micro - 1), 0)
         inject = jax.lax.dynamic_index_in_dim(x, feed, 0, keepdims=False)
         cur = jnp.where(stage == 0, inject, state)
-        y = stage_fn(stacked_params, cur)
+        if split:
+            half = mb_shape[0] // 2
+            y0 = stage_fn(stacked_params, cur[:half])
+            # issued before y1's compute: the hop for half 0 is in
+            # flight while half 1 occupies the MXU
+            s0 = jax.lax.ppermute(y0, axis_name, perm)
+            y1 = stage_fn(stacked_params, cur[half:])
+            s1 = jax.lax.ppermute(y1, axis_name, perm)
+            y = jnp.concatenate([y0, y1], axis=0)
+            nxt = jnp.concatenate([s0, s1], axis=0)
+        else:
+            y = stage_fn(stacked_params, cur)
+            # rotate activations one hop forward along the ring
+            nxt = jax.lax.ppermute(y, axis_name, perm)
         # last stage records its finished micro-batch (t - (p-1))
         out_idx = jnp.clip(t - (p - 1), 0, n_micro - 1)
         record = jnp.logical_and(stage == p - 1, t >= p - 1)
@@ -357,9 +399,6 @@ def spmd_pipeline(stage_fn: Callable, stacked_params, x, n_micro: int,
                 o, y, out_idx, 0),
             lambda o: o,
             outputs)
-        # rotate activations one hop forward along the ring
-        nxt = jax.lax.ppermute(
-            y, axis_name, [(i, (i + 1) % p) for i in range(p)])
         return (nxt, outputs), None
 
     outputs0 = jnp.zeros((n_micro,) + mb_shape, x.dtype)

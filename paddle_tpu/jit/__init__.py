@@ -1,6 +1,6 @@
 from . import api
 from . import functional
 from .api import (InputSpec, StaticFunction, TrainStep, TranslatedLayer,
-                  capture_program, set_code_level, set_verbosity,
-                  enable_to_static, ignore_module, load, not_to_static,
-                  save, to_static)
+                  capture_program, lower_stablehlo, set_code_level,
+                  set_verbosity, enable_to_static, ignore_module, load,
+                  not_to_static, save, to_static)

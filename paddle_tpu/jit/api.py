@@ -459,6 +459,24 @@ def capture_program(fn, input_spec, name_prefix: str = "x"):
     return main
 
 
+def lower_stablehlo(fn, input_spec, name_prefix: str = "x",
+                    auto_fuse: bool = False) -> str:
+    """Capture ``fn`` at ``input_spec`` and emit its StableHLO module
+    text — the jit-side entry of the fusion compiler's artifact path
+    (``jax.jit(...).lower(...).as_text()`` over the recorded replay).
+    With ``auto_fuse=True`` the cost-model fusion pass runs (verified)
+    before lowering, so the emitted module reflects the fused op list.
+    """
+    prog = capture_program(fn, input_spec, name_prefix)
+    if auto_fuse:
+        from ..static import passes as _passes
+
+        _passes.PassManager(["auto_fuse"]).run(prog, verify=True)
+    from ..static.stablehlo import program_stablehlo
+
+    return program_stablehlo(prog)
+
+
 def not_to_static(fn):
     return fn
 

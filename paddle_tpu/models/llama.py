@@ -584,7 +584,7 @@ def microbatch_spec():
 
 
 def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
-                      remat: bool = True):
+                      remat: bool = True, overlap_sends: bool = False):
     """Schedule-driven compiled pipeline loss over the 'pp' mesh axis.
 
     Reference analog: PipelineParallel.forward_backward_pipeline (1F1B,
@@ -600,7 +600,10 @@ def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
     outside the ring (they are not layer-striped in the reference either).
 
     batch = (input_ids[n_micro, mb, S], labels[n_micro, mb, S]).
-    Requires num_hidden_layers % pp == 0.
+    Requires num_hidden_layers % pp == 0.  ``overlap_sends=True``
+    half-splits each tick's micro-batch so the first half's ICI hop
+    overlaps the second half's block compute (latency-hidden pipeline
+    sends; numerics identical — rows are independent).
     """
     from ..distributed.meta_parallel.pipeline_parallel import spmd_pipeline
 
@@ -622,7 +625,7 @@ def loss_fn_pipelined(params, batch, config: LlamaConfig, mesh,
         p = _axis_size("pp")
         stage = jax.lax.axis_index("pp")
         ys = spmd_pipeline(stage_fn, stage_blocks, xm, n_micro,
-                           axis_name="pp")
+                           axis_name="pp", overlap_sends=overlap_sends)
         # replicate the last stage's finished micro-batches across 'pp' so
         # the head/loss run under plain GSPMD afterwards
         return jax.lax.psum(

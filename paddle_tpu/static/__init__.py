@@ -20,6 +20,7 @@ from ..core.tensor import Tensor
 from ..jit.api import InputSpec
 
 from . import passes
+from . import stablehlo
 from .passes import PassManager
 
 __all__ = ["InputSpec", "Program", "data", "default_main_program",

@@ -4,9 +4,13 @@ Reference analog: the PIR pass infrastructure
 (/root/reference/paddle/pir/include/pass/, transform sets under
 paddle/fluid/pir/transforms/ and the DRR rewrite engine). Here a pass is a
 function Program -> mutated Program over the recorded op list; PassManager
-mirrors pir::PassManager's run-in-order contract. Kernel-level fusion is
-XLA's job (the replay is jit-compiled whole), so the passes that matter at
-this level are graph hygiene: dead-op elimination and constant folding.
+mirrors pir::PassManager's run-in-order contract. Kernel-level codegen is
+XLA's job (the replay is jit-compiled whole); the passes at this level are
+graph hygiene (dead-op elimination, constant folding) plus the
+CINN-analog fusion tier: ``auto_fuse`` groups memory-bound chains chosen
+by the ptprog roofline cost model into explicit fused regions (emittable
+as StableHLO via static.stablehlo), and the distributed passes (amp,
+recompute) make their transforms visible in the op list.
 """
 from __future__ import annotations
 
@@ -14,6 +18,16 @@ from typing import Callable, Dict, List
 
 __all__ = ["PassManager", "register_pass", "get_pass",
            "dead_op_elimination", "constant_folding"]
+
+# Ops that terminate a fusion chain regardless of roofline intensity:
+# a collective/p2p entry's schedule position is load-bearing (GSPMD
+# ordering, watchdog accounting), and composing one into an opaque
+# fused fn would hide it from ptprog's collective-consistency pass the
+# same way a RegionEntry would be hidden from region recursion.
+FUSION_BARRIER_OPS = frozenset({
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "all_to_all_single", "broadcast", "scatter", "reduce",
+    "send", "recv", "isend", "irecv"})
 
 _PASSES: Dict[str, Callable] = {}
 
@@ -284,7 +298,16 @@ def fuse_chain(program, names, fused_name=None):
             used.update(chain)
     if not chains:
         return program
+    _rewrite_chains(program, chains, lambda _c: fused_name, consumers,
+                    fetch_uids)
+    return program
 
+
+def _rewrite_chains(program, chains, name_of, consumers, fetch_uids):
+    """Collapse each index chain into one fused entry at the position of
+    its last op (shared rewrite tail of fuse_chain and auto_fuse).
+    ``name_of(chain)`` supplies the fused entry's op name."""
+    ops = program.ops
     replacement = {}        # last-op index -> fused entry
     drop = set()
     for chain in chains:
@@ -298,7 +321,7 @@ def fuse_chain(program, names, fused_name=None):
         in_uids, out_uids = _region_io(entries, later, fetch_uids)
         fn = _compose_entries(entries, in_uids, out_uids)
         replacement[chain[-1]] = (
-            fused_name, fn, [None] * len(in_uids),
+            name_of(chain), fn, [None] * len(in_uids),
             list(range(len(in_uids))), in_uids,
             _args_treedef(len(in_uids)),
             list(range(len(out_uids))), out_uids)
@@ -306,6 +329,140 @@ def fuse_chain(program, names, fused_name=None):
     program.ops = [replacement.get(i, e) for i, e in enumerate(ops)
                    if i not in drop]
     program._compiled.clear()
+    return program
+
+
+# ---------------------------------------------------------------------------
+# cost-model-driven fusion (the CINN-analog tier: candidates are CHOSEN by
+# the ptprog roofline estimator, not by hand-named op lists, and every
+# rewrite is provable under PassManager.run(verify=True))
+# ---------------------------------------------------------------------------
+
+def fusion_candidates(program, max_intensity: float = 8.0,
+                      min_chain: int = 2, feed_spec=None):
+    """Rank fusable chains of memory-bound ops by estimated HBM bytes
+    saved.
+
+    Selection is driven by ``CostModel.static_estimate`` — the per-op
+    roofline rows (FLOPs / bytes moved / arithmetic intensity) computed
+    by abstract dataflow over the recorded op list.  An op joins a chain
+    when its intensity is at or below ``max_intensity`` (memory-bound:
+    the op streams more than it computes, so fusing it removes an HBM
+    round-trip) and the chain link is single-output/single-consumer —
+    the same externally-invisible-intermediate contract ``fuse_chain``
+    enforces.  RegionEntry ops (control flow) and collectives/p2p are
+    fusion barriers.
+
+    Returns a list of candidate dicts ``{"indices", "names",
+    "est_bytes_saved"}`` sorted by (-est_bytes_saved, first index) —
+    a deterministic ranking for a given capture.  ``est_bytes_saved``
+    counts each fused-away intermediate twice (the HBM write by its
+    producer plus the read by its consumer that fusion eliminates).
+    """
+    if not program.ops:
+        return []
+    from ..cost_model import CostModel
+
+    try:
+        rep = CostModel().static_estimate(program, feed_spec=feed_spec)
+    except Exception:
+        return []        # abstractly unevaluable capture: nothing to rank
+    rows = {r["index"]: r for r in rep.per_op}
+    ops = program.ops
+    fetch_uids = {type(program)._uid(f) for f in program.fetch_targets}
+    consumers = {}
+    for idx, e in enumerate(ops):
+        for u in e[4]:
+            consumers.setdefault(u, []).append(idx)
+
+    def fusable(i):
+        e = ops[i]
+        if getattr(e, "regions", None) or e[0] in FUSION_BARRIER_OPS:
+            return False
+        r = rows.get(i)
+        return r is not None and r["intensity"] <= max_intensity
+
+    used = set()
+    candidates = []
+    for start in range(len(ops)):
+        if start in used or not fusable(start):
+            continue
+        chain = [start]
+        while True:
+            cur = ops[chain[-1]]
+            outs = cur[7]
+            # a non-tail member must have exactly one output, not
+            # fetched, with exactly one consumer — otherwise the
+            # intermediate would be externally visible
+            if len(outs) != 1 or outs[0] in fetch_uids:
+                break
+            cons = consumers.get(outs[0], [])
+            if len(cons) != 1:
+                break
+            nxt = cons[0]
+            if nxt in used or nxt in chain or not fusable(nxt):
+                break
+            chain.append(nxt)
+        if len(chain) < min_chain:
+            continue
+        used.update(chain)
+        saved = sum(2 * rows[i]["out_bytes"] for i in chain[:-1])
+        candidates.append({
+            "indices": chain,
+            "names": [ops[i][0] for i in chain],
+            "est_bytes_saved": saved,
+        })
+    candidates.sort(key=lambda c: (-c["est_bytes_saved"],
+                                   c["indices"][0]))
+    return candidates
+
+
+@register_pass("auto_fuse")
+def auto_fuse(program, max_intensity: float = 8.0, min_chain: int = 2,
+              feed_spec=None, max_regions=None):
+    """Cost-model-driven chain fusion: collapse the ``fusion_candidates``
+    chains (roofline-ranked memory-bound regions) into single fused
+    entries — the automatic replacement for hand-naming chains via
+    ``fuse_chain(program, names)``.
+
+    Emits ``compiler/fused_regions`` / ``compiler/est_bytes_saved`` /
+    ``compiler/auto_fuse_ms`` metrics per invocation.  Fetch-signature
+    preservation holds by construction (fused intermediates have no
+    external consumers and tail outputs keep their uids) and is enforced
+    end-to-end by ``PassManager.run(verify=True)``.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    cands = fusion_candidates(program, max_intensity=max_intensity,
+                              min_chain=min_chain, feed_spec=feed_spec)
+    if max_regions is not None:
+        cands = cands[:max_regions]
+    if cands:
+        ops = program.ops
+        fetch_uids = {type(program)._uid(f)
+                      for f in program.fetch_targets}
+        consumers = {}
+        for idx, e in enumerate(ops):
+            for u in e[4]:
+                consumers.setdefault(u, []).append(idx)
+
+        def name_of(chain):
+            return "fused_auto[" + "+".join(ops[i][0]
+                                            for i in chain) + "]"
+
+        _rewrite_chains(program, [c["indices"] for c in cands], name_of,
+                        consumers, fetch_uids)
+    try:
+        from ..profiler import metrics as _metrics
+
+        _metrics.inc("compiler/fused_regions", len(cands))
+        _metrics.inc("compiler/est_bytes_saved",
+                     sum(c["est_bytes_saved"] for c in cands))
+        _metrics.observe("compiler/auto_fuse_ms",
+                         (time.perf_counter() - t0) * 1e3)
+    except Exception:
+        pass
     return program
 
 
@@ -443,4 +600,5 @@ def recompute_pass(program, num_segments=2):
     return program
 
 
-__all__ += ["fuse_chain", "amp_insertion", "recompute_pass"]
+__all__ += ["fuse_chain", "amp_insertion", "recompute_pass",
+            "auto_fuse", "fusion_candidates", "FUSION_BARRIER_OPS"]

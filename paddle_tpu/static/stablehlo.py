@@ -1,0 +1,121 @@
+"""StableHLO emission for recorded ``static.Program``s.
+
+The reference's CINN layer compiles fused subgraphs to NVRTC PTX; the
+TPU-native analog (PAPER.md layer 6) emits StableHLO — the same replay
+callables ``Executor.run`` jit-compiles are lowered with
+``jax.jit(...).lower(...).as_text()`` so a fused region (or the whole
+program) becomes an inspectable compiler artifact instead of an opaque
+composed closure.  ``tools/fusereport.py`` uses this to dump the
+post-``auto_fuse`` regions next to their roofline diff.
+
+Abstract input types come from the ptprog dataflow core (the recorded
+feed placeholders plus live-read externals), so nothing executes: this
+is trace-and-lower only, usable on a machine with no accelerator.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["program_stablehlo", "entry_stablehlo",
+           "fused_regions_stablehlo"]
+
+
+def _ir_env(program, feed_spec=None, name: str = "program"):
+    from ..analysis.program.dataflow import abstract_run
+    from ..analysis.program.ir import ProgramIR
+
+    ir = ProgramIR(program, feed_spec=feed_spec, name=name)
+    env, _findings = abstract_run(ir)
+    return ir, env
+
+
+def _count_emission():
+    try:
+        from ..profiler import metrics as _metrics
+
+        _metrics.inc("compiler/stablehlo_emissions")
+    except Exception:
+        pass
+
+
+def program_stablehlo(program, feed_spec=None,
+                      name: str = "program") -> str:
+    """Lower the whole recorded op list to StableHLO text.
+
+    The lowered callable is the Executor replay shape —
+    ``(feed_arrays, ext_arrays) -> fetch values`` — traced at the
+    program's abstract feed/external types, so the emitted module shows
+    exactly what XLA would compile (fused entries appear as their
+    composed bodies, inlined)."""
+    import jax
+
+    ir, env = _ir_env(program, feed_spec=feed_spec, name=name)
+    feed_uids = [ir.feed_uids[n] for n in sorted(ir.feed_uids)]
+    ext_uids = list(ir.external_uids)
+    fetch_uids = list(ir.fetch_uids)
+
+    def replay(feed_arrays, ext_arrays):
+        run_env = dict(zip(feed_uids, feed_arrays))
+        run_env.update(zip(ext_uids, ext_arrays))
+        for (op_name, fn, entry_flat, tpos, in_uids, treedef,
+             out_positions, out_uids) in (e[:8] for e in program.ops):
+            flat2 = list(entry_flat)
+            for i, u in zip(tpos, in_uids):
+                flat2[i] = run_env[u]
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+            out = fn(*a2, **k2)
+            leaves = jax.tree_util.tree_leaves(out)
+            for pos, u in zip(out_positions, out_uids):
+                run_env[u] = leaves[pos]
+        return [run_env[u] for u in fetch_uids]
+
+    feed_avals = [ir.initial_env[u] for u in feed_uids]
+    ext_avals = [ir.initial_env[u] for u in ext_uids]
+    text = jax.jit(replay).lower(feed_avals, ext_avals).as_text()
+    _count_emission()
+    return text
+
+
+def entry_stablehlo(program, index: int, feed_spec=None,
+                    name: str = "program") -> str:
+    """Lower ONE op entry (typically an ``auto_fuse`` region) to
+    StableHLO text, traced at the abstract input types the dataflow
+    pass derives for that entry's position in the program."""
+    import jax
+
+    ir, env = _ir_env(program, feed_spec=feed_spec, name=name)
+    (op_name, fn, entry_flat, tpos, in_uids, treedef, _out_pos,
+     _out_uids) = program.ops[index][:8]
+    in_avals = []
+    for u in in_uids:
+        aval = env.get(u)
+        if aval is None:
+            raise ValueError(
+                f"op #{index} ({op_name}): input uid {u} has no abstract "
+                f"value — the program does not dataflow-verify")
+        in_avals.append(aval)
+
+    def call(*arrays):
+        flat2 = list(entry_flat)
+        for i, a in zip(tpos, arrays):
+            flat2[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, flat2)
+        return fn(*a2, **k2)
+
+    text = jax.jit(call).lower(*in_avals).as_text()
+    _count_emission()
+    return text
+
+
+def fused_regions_stablehlo(program, feed_spec=None,
+                            name: str = "program",
+                            prefix: str = "fused_") -> Dict[int, str]:
+    """StableHLO text for every fused entry (op name starting with
+    ``prefix``), keyed by op index — the inspectable-artifact surface
+    of the fusion pipeline."""
+    out: Dict[int, str] = {}
+    for i, e in enumerate(program.ops):
+        if e[0].startswith(prefix):
+            out[i] = entry_stablehlo(program, i, feed_spec=feed_spec,
+                                     name=name)
+    return out

@@ -1,0 +1,215 @@
+"""Cost-model-driven fusion (`auto_fuse`) under the pass-equivalence
+verifier, plus the StableHLO artifact path.
+
+The contract: candidates are CHOSEN by `CostModel.static_estimate`
+roofline intensity (no hand-named op lists), every rewrite preserves
+the abstract fetch signature (`PassManager.run(verify=True)`), replay
+numerics are untouched, control-flow regions and collectives are
+fusion barriers, and the candidate ranking is deterministic per
+capture.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu.analysis.program import program_signature
+from paddle_tpu.static.passes import (PassManager, auto_fuse,
+                                      fusion_candidates)
+
+
+def _record_mlp(feed_shape=(4, 8)):
+    paddle.seed(0)
+    main = static.Program()
+    rng = np.random.RandomState(0)
+    w1 = paddle.to_tensor(rng.randn(8, 16).astype(np.float32) * 0.3)
+    w2 = paddle.to_tensor(rng.randn(16, 4).astype(np.float32) * 0.3)
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", feed_shape, "float32")
+        h = paddle.matmul(x, w1)
+        h = paddle.nn.functional.relu(h)
+        h = paddle.matmul(h, w2)
+        out = paddle.nn.functional.softmax(h)
+    main.fetch_targets.append(out)
+    return main, x, out
+
+
+def _run(prog, fetch, feed_val):
+    exe = static.Executor()
+    return exe.run(prog, feed={"x": feed_val}, fetch_list=[fetch])[0]
+
+
+def test_auto_fuse_selects_by_cost_model_and_preserves_numerics():
+    feed = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+    main, x, out = _record_mlp()
+    ref = _run(main, out, feed)
+
+    main2, x2, out2 = _record_mlp()
+    cands = fusion_candidates(main2)
+    # every candidate is a memory-bound chain with a roofline estimate
+    assert cands and all(c["est_bytes_saved"] > 0 for c in cands)
+    pm = PassManager([auto_fuse])
+    pm.run(main2, verify=True)          # fetch-signature equality gate
+    names = [e[0] for e in main2.ops]
+    assert any(n.startswith("fused_auto[") for n in names), names
+    np.testing.assert_allclose(_run(main2, out2, feed), ref, atol=1e-5)
+
+
+def test_auto_fuse_ranking_is_deterministic():
+    cands_a = fusion_candidates(_record_mlp()[0])
+    cands_b = fusion_candidates(_record_mlp()[0])
+    assert [(c["names"], c["est_bytes_saved"]) for c in cands_a] \
+        == [(c["names"], c["est_bytes_saved"]) for c in cands_b]
+    # ranked by estimated bytes saved, ties broken by position
+    saved = [c["est_bytes_saved"] for c in cands_a]
+    assert saved == sorted(saved, reverse=True)
+
+    # the fused op list is identical across fresh captures too
+    p1, p2 = _record_mlp()[0], _record_mlp()[0]
+    auto_fuse(p1)
+    auto_fuse(p2)
+    assert [e[0] for e in p1.ops] == [e[0] for e in p2.ops]
+
+
+def test_auto_fuse_intensity_threshold_excludes_compute_bound():
+    """The intensity ceiling is the selection mechanism: lowering it
+    below the ops' roofline intensity empties the candidate set (no
+    name lists anywhere), and chains shrink monotonically with the
+    ceiling."""
+    # at 0.2 only relu (I~0.12) qualifies — a 1-op chain is no chain
+    main, x, out = _record_mlp()
+    assert fusion_candidates(main, max_intensity=0.2) == []
+    pm = PassManager([lambda p: auto_fuse(p, max_intensity=0.2)])
+    pm.run(main, verify=True)
+    names = [e[0] for e in main.ops]
+    assert names.count("matmul") == 2 and \
+        not any(n.startswith("fused_auto") for n in names), names
+
+    # at the default ceiling the same capture produces candidates
+    assert fusion_candidates(_record_mlp()[0])
+
+
+def test_auto_fuse_region_entry_is_barrier():
+    """A control-flow RegionEntry must never be composed into a fused
+    fn — its sub-programs would vanish from region-aware passes."""
+    from paddle_tpu.jit.dy2static import _record_cond_region
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (4, 4), "float32")
+        h = paddle.nn.functional.relu(x)
+        out = _record_cond_region(
+            paddle.to_tensor(np.asarray(True)),
+            lambda v: v + 1.0, lambda v: v - 1.0, [h])[0]
+        out = paddle.nn.functional.relu(out)
+    main.fetch_targets.append(out)
+    pm = PassManager([auto_fuse])
+    pm.run(main, verify=True)
+    cond = next(e for e in main.ops if e[0] == "cond")
+    assert getattr(cond, "regions", None), \
+        "region children must survive auto_fuse"
+    assert not any(e[0].startswith("fused_auto") and "cond" in e[0]
+                   for e in main.ops)
+    feed = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+    got = _run(main, out, feed)
+    np.testing.assert_allclose(
+        got, np.maximum(np.maximum(feed, 0) + 1.0, 0), atol=1e-6)
+
+
+def test_auto_fuse_collective_is_barrier():
+    """An entry recorded under a collective op name is never fused even
+    when it is memory-bound — its schedule position is load-bearing."""
+    from paddle_tpu.core.dispatch import apply as _apply
+
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", (4, 4), "float32")
+        h = paddle.nn.functional.relu(x)
+        # stand-in for a recorded collective: elementwise body, but the
+        # NAME is what makes it a barrier
+        h = _apply(lambda a: a * 1.0, h, op_name="all_reduce")
+        out = paddle.nn.functional.relu(h)
+    main.fetch_targets.append(out)
+    pm = PassManager([auto_fuse])
+    pm.run(main, verify=True)
+    names = [e[0] for e in main.ops]
+    assert "all_reduce" in names, names
+    assert not any("all_reduce" in n for n in names
+                   if n.startswith("fused_auto")), names
+
+
+def test_auto_fuse_llama_block_fuses_with_signature_equality():
+    """The llama-block preset: >= 2 regions / >= 4 ops fused, abstract
+    fetch signature identical pre/post, estimated bytes-moved reduced."""
+    from paddle_tpu.analysis.program import capture_llama_block
+    from paddle_tpu.cost_model import CostModel
+
+    cap = capture_llama_block()
+    n_before = len(cap.program.ops)
+    sig_before = program_signature(cap.program).fetch
+    pre = CostModel().static_estimate(cap.program)
+    pre_bytes = sum(r["bytes_moved"] for r in pre.per_op)
+
+    pm = PassManager([auto_fuse])
+    pm.run(cap.program, verify=True)
+
+    fused = [e for e in cap.program.ops
+             if e[0].startswith("fused_auto[")]
+    assert len(fused) >= 2, [e[0] for e in cap.program.ops]
+    assert n_before - len(cap.program.ops) >= 3
+    sig_after = program_signature(cap.program).fetch
+    assert sig_after == sig_before
+    post = CostModel().static_estimate(cap.program)
+    post_bytes = sum(r["bytes_moved"] for r in post.per_op)
+    assert post_bytes < pre_bytes
+
+
+def test_auto_fuse_emits_compiler_metrics():
+    from paddle_tpu.profiler import metrics
+
+    regions = metrics.counter("compiler/fused_regions").value
+    saved = metrics.counter("compiler/est_bytes_saved").value
+    main, _x, _out = _record_mlp()
+    auto_fuse(main)
+    assert metrics.counter("compiler/fused_regions").value > regions
+    assert metrics.counter("compiler/est_bytes_saved").value > saved
+
+
+def test_stablehlo_emission_for_fused_regions():
+    """Fused regions lower to inspectable StableHLO text via the
+    jit/static bridge (jax.jit(...).lower(...).as_text())."""
+    from paddle_tpu.static.stablehlo import (fused_regions_stablehlo,
+                                             program_stablehlo)
+
+    main, x, out = _record_mlp()
+    auto_fuse(main)
+    regions = fused_regions_stablehlo(main)
+    assert regions, [e[0] for e in main.ops]
+    for text in regions.values():
+        assert "stablehlo" in text and "func.func" in text
+    module = program_stablehlo(main)
+    assert "stablehlo" in module
+
+    # jit-side entry: capture + (verified) fuse + lower in one call
+    from paddle_tpu.jit import lower_stablehlo
+
+    text = lower_stablehlo(
+        lambda a: paddle.nn.functional.relu(a) * 2.0 + 1.0,
+        [((4, 8), "float32")], auto_fuse=True)
+    assert "stablehlo" in text
+
+
+def test_auto_fuse_composes_with_other_passes_under_verify():
+    feed = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+    main, x, out = _record_mlp()
+    ref = _run(main, out, feed)
+
+    main2, x2, out2 = _record_mlp()
+    pm = PassManager(["auto_fuse", "auto_parallel_recompute"])
+    pm.run(main2, verify=True)
+    names = [e[0] for e in main2.ops]
+    assert all(n.startswith("recompute::") for n in names), names
+    np.testing.assert_allclose(_run(main2, out2, feed), ref, atol=1e-5)

@@ -1,0 +1,178 @@
+"""bench.py budget machinery + tools/benchgate.py regression gate.
+
+The r05 failure mode (rc 124, zero parsed metrics) must be impossible:
+a workload that blows its budget becomes a ``timed_out`` partial row
+and the final JSON of record still lands with every finished row
+promoted into it; benchgate then refuses to bless a round whose
+flagship row is missing, and fails on >5% drops vs the last good
+BENCH_r*.json.
+"""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bench
+import benchgate
+
+
+# ---------------------------------------------------------------------------
+# per-workload timeouts + partial-row promotion (bench.py)
+# ---------------------------------------------------------------------------
+
+def test_run_with_timeout_passes_and_interrupts():
+    assert bench.run_with_timeout(lambda: 41 + 1, 5.0) == 42
+    t0 = time.perf_counter()
+    with pytest.raises(bench.WorkloadTimeout):
+        bench.run_with_timeout(lambda: time.sleep(10), 0.2)
+    assert time.perf_counter() - t0 < 5.0
+    # the alarm is disarmed afterwards: a slow follow-up call survives
+    assert bench.run_with_timeout(lambda: 7, 0) == 7
+
+
+def test_assemble_final_promotes_partial_rows_on_timeout():
+    rows = {
+        "llama_train": {"timed_out": True, "timeout_s": 900.0,
+                        "elapsed_s": 900.2},
+        "serving": {"decode_batch8": {"decode_tokens_per_sec": 1000.0,
+                                      "ttft_s_p50": 0.5}},
+        "eager_dispatch": {"matmul_add_fwd_us": 130.0},
+    }
+    result = bench.assemble_final(rows, mode="full")
+    # the flagship metric is honestly absent, not fabricated...
+    assert result["value"] is None and result["vs_baseline"] is None
+    # ...but every finished row made it into the JSON of record
+    assert result["extra"]["serving"]["decode_batch8"][
+        "decode_tokens_per_sec"] == 1000.0
+    assert result["extra"]["eager_dispatch"][
+        "matmul_add_fwd_us"] == 130.0
+    assert result["extra"]["incomplete_rows"] == ["llama_train"]
+    json.dumps(result)                      # must stay serializable
+
+
+def test_assemble_final_complete_run_keeps_flagship_semantics():
+    rows = {"llama_train": {
+        "tokens_per_sec_per_chip": 18000.0, "mfu": 0.675,
+        "n_params": 9e8, "batch": 4, "seq": 4096, "steps": 10,
+        "loss": 1.0}}
+    result = bench.assemble_final(rows)
+    assert result["value"] == 18000.0
+    assert result["vs_baseline"] == round(0.675 / 0.45, 4)
+    assert "incomplete_rows" not in result["extra"]
+
+
+def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
+                                              capsys):
+    """End to end through bench.main(): a workload that blows the
+    per-workload budget becomes a timed_out row, the remaining
+    workloads still run, and the final JSON of record is printed with
+    the partial rows promoted — rc-124-with-zero-metrics is gone."""
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "BENCH_partial.jsonl"))
+
+    def hangs(on_tpu):
+        time.sleep(30)
+        return {"never": True}
+
+    def quick(on_tpu):
+        return {"ok": True, "n": 1}
+
+    monkeypatch.setattr(bench, "WORKLOADS", (
+        ("llama_train", hangs, True),
+        ("serving", quick, True),
+    ))
+    bench.main(["--timeout-s", "0.3"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(out)
+    assert result["value"] is None
+    assert result["extra"]["llama_train"]["timed_out"] is True
+    assert result["extra"]["serving"] == {"ok": True, "n": 1}
+    assert result["extra"]["incomplete_rows"] == ["llama_train"]
+    # the partial stream carries the same rows, fsync'd as they landed
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "BENCH_partial.jsonl").read_text().splitlines()]
+    assert [r["bench"] for r in lines] == ["llama_train", "serving",
+                                           "final"]
+
+
+def test_fast_mode_selects_gate_rows_only():
+    gate = [n for n, _fn, g in bench.WORKLOADS if g]
+    assert gate == ["llama_train", "eager_dispatch", "serving"]
+    assert len(bench.WORKLOADS) == 8
+
+
+# ---------------------------------------------------------------------------
+# regression gate (tools/benchgate.py)
+# ---------------------------------------------------------------------------
+
+def _result(tps=16000.0, ttft=0.5, tpot=7.0):
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": tps, "unit": "tokens/s", "vs_baseline": 1.0,
+        "extra": {"serving": {"decode_batch8": {
+            "ttft_s_p50": ttft, "ttft_s_p95": ttft * 2,
+            "tpot_ms_min": tpot}}},
+    }
+
+
+def _gate(tmp_path, cand, base):
+    c = tmp_path / "cand.json"
+    b = tmp_path / "base.json"
+    c.write_text(json.dumps(cand))
+    b.write_text(json.dumps(base))
+    return benchgate.main(["-c", str(c), "--baseline", str(b)])
+
+
+def test_benchgate_passes_within_threshold(tmp_path):
+    assert _gate(tmp_path, _result(tps=15600.0), _result()) == 0
+
+
+def test_benchgate_fails_injected_tokens_regression(tmp_path):
+    assert _gate(tmp_path, _result(tps=14000.0), _result()) == 1
+
+
+def test_benchgate_fails_injected_latency_regressions(tmp_path):
+    assert _gate(tmp_path, _result(ttft=0.6), _result()) == 1
+    assert _gate(tmp_path, _result(tpot=8.0), _result()) == 1
+
+
+def test_benchgate_fails_when_flagship_row_missing(tmp_path):
+    cand = _result()
+    cand["value"] = None                    # timed-out flagship row
+    assert _gate(tmp_path, cand, _result()) == 1
+
+
+def test_benchgate_parses_driver_wrapper_and_skips_empty_rounds(tmp_path):
+    """Baseline auto-discovery: the newest BENCH_r*.json with parsed
+    metrics wins; an r05-style rc-124 empty round is skipped."""
+    good = {"n": 4, "rc": 0,
+            "tail": "noise\n" + json.dumps(_result()) + "\n"}
+    empty = {"n": 5, "rc": 124, "tail": "WARNING: killed\n"}
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(good))
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps(empty))
+    path, result = benchgate.find_baseline(str(tmp_path))
+    assert path.endswith("BENCH_r04.json")
+    assert result["value"] == 16000.0
+
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(_result(tps=15900.0)))
+    assert benchgate.main(["-c", str(cand),
+                           "--baseline-dir", str(tmp_path)]) == 0
+
+
+def test_benchgate_reads_partial_jsonl_stream(tmp_path):
+    stream = tmp_path / "BENCH_partial.jsonl"
+    rows = [
+        {"bench": "llama_train", "t": 1.0, "result": {"mfu": 0.6}},
+        {"bench": "final", "t": 2.0, "result": _result()},
+    ]
+    stream.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    got = benchgate.load_result(str(stream))
+    assert got["value"] == 16000.0

@@ -345,7 +345,7 @@ def test_p2p_peer_outside_group_is_pt622():
 # pass equivalence (PT63x) — PassManager.run(verify=True)
 # ---------------------------------------------------------------------------
 
-def test_verify_accepts_all_five_shipped_passes():
+def test_verify_accepts_all_shipped_passes():
     from paddle_tpu.analysis.program.analyze import shipped_passes
 
     for pname, p in shipped_passes():
@@ -418,7 +418,8 @@ def test_analyze_driver_end_to_end_clean():
     assert res.memory is not None and res.memory.peak_bytes > 0
     assert [v.pass_name for v in res.verify] == [
         "dead_op_elimination", "constant_folding",
-        "fuse_chain[matmul,relu]", "amp_insertion", "recompute_pass"]
+        "fuse_chain[matmul,relu]", "auto_fuse", "amp_insertion",
+        "recompute_pass"]
 
 
 def test_jit_capture_program_feeds_analyzer():

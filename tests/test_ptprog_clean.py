@@ -4,9 +4,11 @@ be clean — the IR-level mirror of test_ptlint_clean.py.
 All four analysis passes run over each preset capture (the small MLP
 and the llama-block Program); zero non-baselined findings means every
 recorded op abstractly evaluates, no mixed-precision leaks, no dead
-ops, collectives are mesh-consistent, and all five shipped Program
-passes are equivalence-preserving.  The acceptance budget (< 10 s on a
-CPU for the llama-block capture, analysis only) is asserted too.
+ops, collectives are mesh-consistent, and all six shipped Program
+passes (including the cost-model-driven ``auto_fuse``) are
+equivalence-preserving.  The acceptance budget (< 10 s on a CPU for
+the llama-block capture, analysis only) is asserted too, and the
+fusion report must run over both presets inside the same budget.
 """
 import time
 
@@ -28,8 +30,9 @@ def test_ptprog_clean_over_shipped_captures(preset):
     # the gate must actually have analyzed something
     assert len(cap.program.ops) >= 3
     assert res.memory is not None and res.memory.peak_bytes > 0
-    # all five shipped passes verified equivalence-preserving
-    assert len(res.verify) == 5, [v.pass_name for v in res.verify]
+    # all six shipped passes verified equivalence-preserving
+    assert len(res.verify) == 6, [v.pass_name for v in res.verify]
+    assert "auto_fuse" in [v.pass_name for v in res.verify]
     if preset == "llama-block":
         assert dt < 10.0, f"llama-block analysis took {dt:.1f}s"
 
@@ -38,3 +41,24 @@ def test_cli_program_mode_exit_code_clean():
     from paddle_tpu.analysis.main import main
 
     assert main(["--program", "mlp", "--format", "json"]) == 0
+
+
+@pytest.mark.parametrize("preset", ["mlp", "llama-block"])
+def test_fusion_report_runs_fast_and_reduces_bytes(preset):
+    """CI gate for the fusion tier: the report (estimate -> verified
+    auto_fuse -> re-estimate) completes within the analysis budget on
+    both preset captures and shows estimated bytes-moved reduced."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from fusereport import build_report
+
+    t0 = time.perf_counter()
+    rep = build_report(preset)
+    dt = time.perf_counter() - t0
+    assert dt < 10.0, f"{preset} fusion report took {dt:.1f}s"
+    assert rep["verified"] and rep["regions"]
+    assert rep["post"]["total_bytes_moved"] \
+        < rep["pre"]["total_bytes_moved"]

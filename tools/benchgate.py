@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""benchgate — CI regression gate over bench.py results.
+
+Compares a candidate bench result (the final JSON line bench.py prints,
+or a BENCH_r*.json driver wrapper, or a BENCH_partial.jsonl stream)
+against the last GOOD baseline round and exits nonzero when the perf
+signal regressed:
+
+- llama train ``tokens/s-per-chip`` dropping more than ``--threshold``
+  (default 5%),
+- serving ``ttft_s_p50`` / ``ttft_s_p95`` / ``tpot_ms_min`` rising more
+  than the threshold on any decode batch present in both runs,
+- the candidate missing the flagship metric entirely (a timed-out
+  flagship row must fail the gate, not silently pass it — the r05
+  failure mode).
+
+"Last good" baseline: ``--baseline FILE``, or auto-discovery — the
+newest ``BENCH_r*.json`` in ``--baseline-dir`` (default: repo root)
+whose payload parses and carries a flagship value (r05's rc-124 empty
+round is skipped automatically).
+
+Usage:
+  python tools/benchgate.py --candidate /tmp/BENCH_new.json
+  python tools/benchgate.py --candidate BENCH_partial.jsonl --threshold 0.03
+  python bench.py --fast > /tmp/row.json && python tools/benchgate.py -c /tmp/row.json
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def extract_result(obj):
+    """Pull the bench final-result dict out of any of the shapes we
+    store: the result itself, the driver wrapper {"tail": "...json..."},
+    or None when unparseable."""
+    if not isinstance(obj, dict):
+        return None
+    if obj.get("metric") == "llama_train_tokens_per_sec_per_chip":
+        return obj
+    # BENCH_partial.jsonl row: {"bench": "final", "result": {...}}
+    if obj.get("bench") == "final" and isinstance(obj.get("result"), dict):
+        return extract_result(obj["result"])
+    # driver wrapper: the final JSON line is embedded in "tail"
+    tail = obj.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    got = extract_result(json.loads(line))
+                except ValueError:
+                    continue
+                if got is not None:
+                    return got
+    return None
+
+
+def load_result(path):
+    """Load a result from a JSON file or a .jsonl stream (last parseable
+    final row wins)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        got = extract_result(json.loads(text))
+        if got is not None:
+            return got
+    except ValueError:
+        pass
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            got = extract_result(json.loads(line))
+        except ValueError:
+            continue
+        if got is not None:
+            result = got
+    return result
+
+
+def find_baseline(baseline_dir):
+    """Newest BENCH_r*.json with a parsed flagship value."""
+    rounds = sorted(glob.glob(os.path.join(baseline_dir,
+                                           "BENCH_r*.json")),
+                    reverse=True)
+    for path in rounds:
+        try:
+            result = load_result(path)
+        except OSError:
+            continue
+        if result is not None and result.get("value") is not None:
+            return path, result
+    return None, None
+
+
+def _serving_metrics(result):
+    """{(batch_key, metric): value} for the gated serving latencies."""
+    out = {}
+    serving = (result.get("extra") or {}).get("serving") or {}
+    for key, row in serving.items():
+        if not isinstance(row, dict):
+            continue
+        # step_ms is the tpot proxy older rounds (<= r04) recorded
+        for metric in ("ttft_s_p50", "ttft_s_p95", "tpot_ms_min",
+                       "step_ms"):
+            v = row.get(metric)
+            if isinstance(v, (int, float)):
+                out[(key, metric)] = float(v)
+    return out
+
+
+def compare(candidate, baseline, threshold=0.05):
+    """Returns (failures, report_lines). A failure is a formatted
+    string; an empty list means the gate passes."""
+    failures = []
+    lines = []
+
+    cand_tps = candidate.get("value")
+    base_tps = baseline.get("value")
+    if cand_tps is None:
+        failures.append("candidate has no llama_train tokens/s value "
+                        "(flagship row missing or timed out)")
+    elif base_tps:
+        drop = (base_tps - cand_tps) / base_tps
+        verdict = "FAIL" if drop > threshold else "ok"
+        lines.append(f"tokens/s-per-chip: {base_tps:.1f} -> "
+                     f"{cand_tps:.1f}  ({-drop * 100:+.1f}%) [{verdict}]")
+        if drop > threshold:
+            failures.append(
+                f"tokens/s-per-chip dropped {drop * 100:.1f}% "
+                f"(> {threshold * 100:.0f}%)")
+
+    cand_sv = _serving_metrics(candidate)
+    base_sv = _serving_metrics(baseline)
+    for key in sorted(set(cand_sv) & set(base_sv)):
+        b, c = base_sv[key], cand_sv[key]
+        if b <= 0:
+            continue
+        rise = (c - b) / b                 # latency: higher is worse
+        verdict = "FAIL" if rise > threshold else "ok"
+        lines.append(f"{key[0]}.{key[1]}: {b:g} -> {c:g}  "
+                     f"({rise * 100:+.1f}%) [{verdict}]")
+        if rise > threshold:
+            failures.append(
+                f"{key[0]}.{key[1]} rose {rise * 100:.1f}% "
+                f"(> {threshold * 100:.0f}%)")
+    return failures, lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-c", "--candidate", required=True,
+                    help="candidate result: bench.py output JSON, "
+                         "BENCH_partial.jsonl, or BENCH_r*.json wrapper")
+    ap.add_argument("--baseline",
+                    help="explicit baseline file (default: newest good "
+                         "BENCH_r*.json in --baseline-dir)")
+    ap.add_argument("--baseline-dir", default=_REPO)
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression budget (default 0.05)")
+    args = ap.parse_args(argv)
+
+    candidate = load_result(args.candidate)
+    if candidate is None:
+        print(f"benchgate: FAIL — candidate {args.candidate} has no "
+              f"parseable bench result")
+        return 2
+    if args.baseline:
+        base_path, baseline = args.baseline, load_result(args.baseline)
+    else:
+        base_path, baseline = find_baseline(args.baseline_dir)
+    if baseline is None:
+        print("benchgate: FAIL — no usable baseline round found "
+              f"(looked in {args.baseline or args.baseline_dir})")
+        return 2
+
+    failures, lines = compare(candidate, baseline, args.threshold)
+    print(f"benchgate: candidate={args.candidate} baseline={base_path} "
+          f"threshold={args.threshold * 100:.0f}%")
+    for ln in lines:
+        print("  " + ln)
+    if failures:
+        for f in failures:
+            print("  REGRESSION: " + f)
+        print("benchgate: FAIL")
+        return 1
+    print("benchgate: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, _REPO)
+    sys.exit(main())

@@ -42,6 +42,12 @@ KNOWN_METRICS = (
     # collectives (distributed/collective.py)
     "comm/collective_count", "comm/collective_bytes", "comm/latency_ms",
     "comm/*_count", "comm/*_bytes",
+    # collective-compute overlap (meta_parallel: stage-3 param prefetch,
+    # latency-hidden pipeline sends / 1F1B hand-off windows)
+    "comm/overlap_ms",
+    # fusion compiler (static/passes.py auto_fuse + static/stablehlo.py)
+    "compiler/fused_regions", "compiler/est_bytes_saved",
+    "compiler/auto_fuse_ms", "compiler/stablehlo_emissions",
     # transport reliability + watchdog escalation
     # (distributed/transport.py, distributed/watchdog.py)
     "comm/retries", "comm/redials", "comm/corrupt_frames",
