@@ -551,6 +551,144 @@ def bench_serving(on_tpu):
     return rows
 
 
+def bench_fleet_serving(on_tpu):
+    """Fleet serving gate row (ISSUE 7): (a) a shared-prefix workload —
+    N requests behind one common system prompt — served WITH and WITHOUT
+    the prefix cache (requests/s, mean TTFT, hit rate: the benchgate
+    fleet signals), and (b) the int8 double-buffered weight-streaming
+    decode step vs the bf16 non-prefetched baseline (honest min/max
+    spread — decode here is weight-streaming-bound, PR 2)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              ServingEngine)
+    from paddle_tpu.inference.weight_stream import measure_stream_win
+
+    if on_tpu:
+        n_req, prefix_len, unique_len, max_new = 16, 512, 32, 32
+        stream_batch, stream_win = 16, 48
+
+        def mk_cfg(**over):
+            base = dict(max_batch=8, num_blocks=8 * 20 + 64,
+                        max_blocks_per_seq=20)
+            base.update(over)
+            return PagedServingConfig.llama_1b(**base)
+    else:
+        n_req, prefix_len, unique_len, max_new = 16, 96, 8, 4
+        stream_batch, stream_win = 4, 4
+
+        def mk_cfg(**over):
+            base = dict(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=4, num_kv_heads=2, ffn_size=64,
+                        block_size=8, num_blocks=96, max_batch=4,
+                        max_blocks_per_seq=16, token_budget=32)
+            base.update(over)
+            return PagedServingConfig(**base)
+    paddle.seed(0)
+    cfg = mk_cfg()
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    prefix = list(rng.randint(1, cfg.vocab_size, prefix_len))
+    prompts = [prefix + list(rng.randint(1, cfg.vocab_size, unique_len))
+               for _ in range(n_req)]
+
+    def serve_wave(prefix_cache, seed):
+        model._serving_shared = None
+        eng = ServingEngine.from_model(model, mk_cfg(
+            prefix_cache=prefix_cache), seed=seed)
+        # warm the executables off the clock; on the cache engine this
+        # also seeds the shared system prompt — the fleet steady state
+        # (so all n_req timed requests are prefix hits)
+        eng.add_request(prompts[0], max_new_tokens=1)
+        eng.run_to_completion()
+        eng._requests.clear()
+        from paddle_tpu.profiler import metrics as _m
+
+        reused0 = _m.counter("serving/prefix_pages_reused").value
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p, max_new_tokens=max_new)
+                for p in prompts]
+        ttft = {}
+        while eng.pending():
+            produced = eng.step()
+            now = time.perf_counter()
+            for rid, _ in produced:
+                ttft.setdefault(rid, now - t0)
+        dt = time.perf_counter() - t0
+        assert all(len(eng._requests[r].generated) == max_new
+                   for r in rids)
+        hit_rate = eng._prefix_cache.hit_rate() \
+            if eng._prefix_cache is not None else 0.0
+        reused = _m.counter("serving/prefix_pages_reused").value - reused0
+        return (n_req / dt, float(np.mean(list(ttft.values()))),
+                hit_rate, reused)
+
+    rps_nc, ttft_nc, _, _ = serve_wave(False, seed=1)
+    rps_pc, ttft_pc, hit_rate, pages_reused = serve_wave(True, seed=1)
+
+    # -- int8 double-buffered weight streaming micro-bench ---------------
+    def decode_setup(weight_stream):
+        model._serving_shared = None
+        eng = ServingEngine.from_model(model, mk_cfg(
+            max_batch=stream_batch), seed=2,
+            weight_stream=weight_stream)
+        rngd = np.random.RandomState(3)
+        for _ in range(stream_batch):
+            eng.add_request(
+                list(rngd.randint(1, cfg.vocab_size, unique_len)),
+                max_new_tokens=8 * stream_win)
+        while any(r.length - r.cached > 1 for r in eng.pending()):
+            eng.step()
+        eng.decode_run(stream_win)          # warm the window executable
+        return eng
+
+    def time_windows(eng, n=3):
+        ms = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            out = eng.decode_run(stream_win)
+            if len(out) < stream_win * stream_batch:
+                break
+            ms.append((time.perf_counter() - t0) / stream_win * 1e3)
+        return sorted(ms)
+
+    eng_base = decode_setup(None)
+    eng_stream = decode_setup("int8")
+    base_ms = time_windows(eng_base)
+    stream_ms = time_windows(eng_stream)
+    win_ms, _, _ = measure_stream_win(
+        lambda: eng_stream.decode_run(1) or eng_stream._kc,
+        lambda: eng_base.decode_run(1) or eng_base._kc)
+
+    return {
+        "fleet": {
+            "n_requests": n_req, "prefix_len": prefix_len,
+            "unique_len": unique_len, "max_new": max_new,
+            "requests_per_sec": round(rps_pc, 2),
+            "requests_per_sec_nocache": round(rps_nc, 2),
+            "speedup_vs_nocache": round(rps_pc / rps_nc, 3),
+            "ttft_mean_s": round(ttft_pc, 4),
+            "ttft_mean_s_nocache": round(ttft_nc, 4),
+            "prefix_hit_rate": round(hit_rate, 4),
+            "prefix_pages_reused": pages_reused,
+        },
+        "weight_stream": {
+            "decode_batch": stream_batch, "window": stream_win,
+            "step_ms_bf16_min": round(base_ms[0], 3) if base_ms else None,
+            "step_ms_bf16_max": round(base_ms[-1], 3) if base_ms else None,
+            "step_ms_int8_stream_min":
+                round(stream_ms[0], 3) if stream_ms else None,
+            "step_ms_int8_stream_max":
+                round(stream_ms[-1], 3) if stream_ms else None,
+            "stream_speedup": round(base_ms[0] / stream_ms[0], 3)
+                if base_ms and stream_ms else None,
+            "prefetch_win_ms": round(win_ms, 3),
+        },
+    }
+
+
 def host_dispatch_bench(measure_us):
     """Host-path dispatch cost (tunnel-free), shared by bench.py and
     tools/op_bench.py: the same grad-recorded matmul+add dispatches
@@ -774,6 +912,7 @@ WORKLOADS = (
     ("eager_dispatch", bench_eager_dispatch, True),
     ("llama13b_block", bench_llama13b_block, False),
     ("serving", bench_serving, True),
+    ("fleet", bench_fleet_serving, True),
     ("second_order", bench_second_order, False),
 )
 
@@ -923,6 +1062,23 @@ def update_readme_table(result):
             "Llama ~1B serving, int8 KV cache (half the cache bytes)",
             "decode tokens/s @ bs 16",
             f"{i16.get('decode_tokens_per_sec', '?'):.0f}"))
+    fl = x.get("fleet", {}).get("fleet", {})
+    if fl.get("requests_per_sec") is not None:
+        rows.append((
+            f"Llama ~1B fleet serving ({fl.get('n_requests')} reqs, "
+            f"shared {fl.get('prefix_len')}-tok system prompt)",
+            "req/s with prefix cache (vs without)",
+            f"{fl['requests_per_sec']:.2f} "
+            f"({fl.get('speedup_vs_nocache', '?')}x)"))
+    wsr = x.get("fleet", {}).get("weight_stream", {})
+    if wsr.get("step_ms_int8_stream_min") is not None:
+        rows.append((
+            f"Llama ~1B decode step, int8 double-buffered weight "
+            f"streaming (bs {wsr.get('decode_batch')})",
+            "ms/step min..max (bf16 baseline)",
+            f"{wsr['step_ms_int8_stream_min']}.."
+            f"{wsr.get('step_ms_int8_stream_max')} "
+            f"({wsr.get('step_ms_bf16_min')}..)"))
     rn = x.get("resnet50_dp", {})
     if "images_per_sec" in rn:
         rows.append(("ResNet-50 (amp bf16, bs 256)", "images/s",

@@ -345,3 +345,33 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """reference: paddle.inference.create_predictor."""
     return Predictor(config)
+
+
+# ---------------------------------------------------------------------------
+# fleet serving tier (lazy: the serving stack pulls in the model layers,
+# which Config/Predictor users should not pay for at import)
+# ---------------------------------------------------------------------------
+
+_FLEET_EXPORTS = {
+    "ServingEngine": "serving", "PagedCausalLM": "serving",
+    "PagedServingConfig": "serving", "SamplingParams": "serving",
+    "EngineOverloadedError": "serving", "save_paged_model": "serving",
+    "PrefixCache": "prefix_cache",
+    "PrefillWorker": "disagg", "DecodeWorker": "disagg",
+    "migrate_request": "disagg", "receive_request": "disagg",
+    "Replica": "router", "ReplicaRouter": "router",
+    "WeightStreamer": "weight_stream",
+}
+
+
+def __getattr__(name):
+    mod = _FLEET_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module 'paddle_tpu.inference' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module("." + mod, __name__), name)
+
+
+__all__ += sorted(_FLEET_EXPORTS)

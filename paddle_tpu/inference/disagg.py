@@ -1,0 +1,202 @@
+"""Disaggregated prefill/decode serving over the CRC/ACK TensorTransport.
+
+Fleet-scale engines split the two serving phases onto different workers:
+a PREFILL worker runs the compute-bound chunked prefill (the varlen
+flash ``fresh_prefill`` specialization) and a DECODE worker runs the
+weight-streaming-bound token loop — so a long prompt arriving never
+spikes the TPOT of sequences already decoding (the P/D-disaggregation
+deployments of production stacks: Splitwise / DistServe / vLLM-PD).
+
+The hand-off ships, per request, over ``distributed.TensorTransport``
+(CRC32-framed, ACK/NAK retransmit, idempotent dedup — a dropped or
+corrupted frame is retried transparently and counted in ``comm/*``):
+
+  1. a JSON metadata frame (prompt, progress, sampling, origin salt
+     identity),
+  2. the request's raw KV pages gathered from the prefill engine's pool
+     (``[L, n_pages, HKV, block_size, D]``, plus the per-page scale
+     pools when the cache is int8-quantized).
+
+The decode engine scatters the pages into ITS pool at freshly allocated
+page ids and resumes at the decode tip.  Because the KV bytes transfer
+verbatim, the sampling salts keep the origin ``(seed, rid)`` identity,
+and both engines share one compiled step (same model/config), the
+decode-side token stream is BITWISE-identical to the single-engine
+path — chaos-tested under PT_FAULT_PLAN drop/corrupt/delay/dup plans
+in tests/test_fleet_serving.py.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..profiler import metrics as _metrics
+from .serving import SamplingParams, ServingEngine, _Request
+
+__all__ = ["migrate_request", "receive_request", "PrefillWorker",
+           "DecodeWorker", "DISAGG_CHANNEL"]
+
+DISAGG_CHANNEL = "disagg"
+
+_m_migrations = _metrics.counter("serving/migrations")
+
+
+def migrate_request(engine: ServingEngine, rid: int, transport,
+                    dst: int, channel: str = DISAGG_CHANNEL) -> None:
+    """Ship request ``rid`` (fully prefilled, at its decode tip) from
+    ``engine`` to the decode worker at transport rank ``dst``.  The
+    source request finishes locally (pages released); ownership moves to
+    the receiver."""
+    r = engine._requests[rid]
+    if r.done:
+        raise ValueError(f"request {rid} already finished")
+    if r.length - r.cached != 1:
+        raise ValueError(
+            f"request {rid} is not at its decode tip "
+            f"(cached={r.cached}, length={r.length}): finish prefill "
+            f"before migrating")
+    pages = np.asarray(r.pages, np.int32)
+    sp = r.sampling
+    meta = {
+        "prompt": list(r.prompt),
+        "generated": list(r.generated),
+        "max_new": int(r.max_new),
+        "cached": int(r.cached),
+        "eos_token_id": r.eos_token_id,
+        "sampling": [sp.temperature, sp.top_k, sp.top_p],
+        "salt_rid": int(r.salt_rid),
+        "salt_seed": int(engine.seed if r.salt_seed is None
+                         else r.salt_seed),
+        "quant": engine._ks is not None,
+        "n_pages": int(pages.size),
+    }
+    transport.send(np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                   dst, channel)
+    # raw page gather: [L, n_pages, HKV, block_size, D] in the cache
+    # dtype — the KV bytes the decode engine resumes from, verbatim
+    transport.send(np.asarray(engine._kc[:, pages]), dst, channel)
+    transport.send(np.asarray(engine._vc[:, pages]), dst, channel)
+    if meta["quant"]:
+        transport.send(np.asarray(engine._ks[:, pages]), dst, channel)
+        transport.send(np.asarray(engine._vs[:, pages]), dst, channel)
+    _m_migrations.inc()
+    r.done = True
+    engine._release(r)
+
+
+def receive_request(engine: ServingEngine, transport, src: int,
+                    channel: str = DISAGG_CHANNEL) -> int:
+    """Install one migrated request into ``engine``: allocate pages,
+    scatter the shipped KV into this engine's pool, and admit the
+    request at its decode tip under its ORIGIN salt identity.  Returns
+    the local rid."""
+    meta = json.loads(bytes(transport.recv(src, channel)).decode())
+    kc = transport.recv(src, channel)
+    vc = transport.recv(src, channel)
+    scales = None
+    if meta["quant"]:
+        if engine._ks is None:
+            raise ValueError("int8-KV request migrated to a non-quant "
+                             "decode engine (configs must match)")
+        scales = (transport.recv(src, channel),
+                  transport.recv(src, channel))
+    n_pages = int(meta["n_pages"])
+    pages = [engine._take_free_page() for _ in range(n_pages)]
+    idx = jnp.asarray(pages, jnp.int32)
+    engine._kc = engine._kc.at[:, idx].set(
+        jnp.asarray(kc, engine._cache_dt))
+    engine._vc = engine._vc.at[:, idx].set(
+        jnp.asarray(vc, engine._cache_dt))
+    if scales is not None:
+        engine._ks = engine._ks.at[:, idx].set(jnp.asarray(scales[0]))
+        engine._vs = engine._vs.at[:, idx].set(jnp.asarray(scales[1]))
+
+    rid = engine._next_rid
+    engine._next_rid += 1
+    t, k, p = meta["sampling"]
+    req = _Request(rid, meta["prompt"], meta["max_new"],
+                   SamplingParams(t, k, p), meta["eos_token_id"])
+    req.generated = [int(x) for x in meta["generated"]]
+    req.cached = int(meta["cached"])
+    req.pages = pages
+    req.salt_rid = int(meta["salt_rid"])
+    req.salt_seed = int(meta["salt_seed"])
+    # TTFT was observed on the prefill worker (the first token samples
+    # there); suppress a second observation on this engine
+    req.first_tok_t = req.submit_t
+    engine._requests[rid] = req
+    _m_migrations.inc()
+    return rid
+
+
+class PrefillWorker:
+    """Prefill side of the disaggregated pair: admits requests, drives
+    chunked prefill to the decode tip (first token sampled here — TTFT
+    is a prefill-side number), then migrates each request's KV pages +
+    state to the decode worker."""
+
+    def __init__(self, engine: ServingEngine, transport, decode_rank: int,
+                 channel: str = DISAGG_CHANNEL):
+        self.engine = engine
+        self.transport = transport
+        self.decode_rank = decode_rank
+        self.channel = channel
+        self._live: List[int] = []
+
+    def submit(self, prompt_tokens, **kw) -> int:
+        rid = self.engine.add_request(prompt_tokens, **kw)
+        self._live.append(rid)
+        return rid
+
+    def pump(self, max_steps: int = 1000) -> List[int]:
+        """Run prefill steps until every live request migrated (or
+        finished locally — a max_new==1 request never reaches the decode
+        worker).  Returns the migrated rids."""
+        moved: List[int] = []
+        for _ in range(max_steps):
+            if not self._live:
+                break
+            self.engine.step()
+            for rid in list(self._live):
+                r = self.engine._requests[rid]
+                if r.done:
+                    self._live.remove(rid)
+                elif r.generated and r.length - r.cached == 1:
+                    migrate_request(self.engine, rid, self.transport,
+                                    self.decode_rank, self.channel)
+                    self._live.remove(rid)
+                    moved.append(rid)
+        return moved
+
+
+class DecodeWorker:
+    """Decode side: accepts migrated requests and runs the multi-step
+    decode windows (one host sync per window), prefill-free — no
+    prefill chunk ever lands in its step batches, so TPOT stays flat."""
+
+    def __init__(self, engine: ServingEngine, transport,
+                 prefill_rank: int, channel: str = DISAGG_CHANNEL):
+        self.engine = engine
+        self.transport = transport
+        self.prefill_rank = prefill_rank
+        self.channel = channel
+
+    def accept(self, n: int = 1) -> List[int]:
+        return [receive_request(self.engine, self.transport,
+                                self.prefill_rank, self.channel)
+                for _ in range(n)]
+
+    def run(self, window: int = 16, max_steps: int = 1000) -> dict:
+        """Decode every accepted request to completion; returns
+        {local_rid: generated tokens}."""
+        for _ in range(max_steps):
+            if not self.engine.pending():
+                break
+            if not self.engine.decode_run(window):
+                self.engine.step()      # page-tight fallback (can preempt)
+        return {rid: list(r.generated)
+                for rid, r in self.engine._requests.items()}

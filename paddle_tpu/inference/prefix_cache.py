@@ -1,0 +1,183 @@
+"""Refcounted shared-prefix KV block cache over the serving page pool.
+
+Reference analog: vLLM's automatic prefix caching / the RadixAttention
+trie — requests that share a prompt prefix (the fleet-serving common
+case: one system prompt in front of millions of user turns) map their
+first N KV pages to the SAME physical pool blocks instead of each
+re-prefilling the shared tokens.
+
+Design: a trie keyed by chained token-block digests.  Each node covers
+exactly one FULL cache block (``block_size`` tokens) and records the
+physical page holding that block's KV, a refcount of live requests
+sharing it, and an LRU tick.  The chain digest of block *i* commits to
+every token in blocks ``0..i`` (blake2b over parent digest + the
+block's tokens), so a node can only match a request whose ENTIRE prefix
+up to that block is identical — exactly the dependence KV entries have
+(K/V at position t are a function of tokens ``0..t``).
+
+Copy-on-write at the divergence point falls out of the block
+granularity: only full, prompt-covered blocks are ever shared, so the
+first block where two prompts diverge (or any partially-filled block)
+is always a private page the request writes freshly — shared pages are
+read-only by construction and no in-place page copy is ever needed.
+
+The tip token of a prompt is never served from cache (``match`` caps at
+``len(prompt) - 1`` tokens): its logits must be computed to sample the
+first generated token, matching the engine's scheduling contract.
+
+Ownership: pages enter the cache via ``insert`` (ownership transfers
+from the request's private allocation to the cache); live requests
+co-own via refcounts and the engine reclaims zero-ref pages through
+``evict`` when the free pool runs dry — cache residency is a *use* of
+free HBM, never a reservation against live traffic.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("page", "refs", "lru", "parent", "children")
+
+    def __init__(self, page: int, parent: Optional[bytes], lru: int):
+        self.page = page
+        self.refs = 1          # created on behalf of the inserting request
+        self.lru = lru
+        self.parent = parent
+        self.children = 0
+
+
+class PrefixCache:
+    """Trie of cached full-block KV pages keyed by token-block digests."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._nodes: Dict[bytes, _Node] = {}
+        self._page_owner: Dict[int, bytes] = {}   # page -> node key
+        self._tick = 0
+        self.lookups = 0
+        self.hits = 0
+
+    # -- keys --------------------------------------------------------------
+    def _chain(self, tokens, n_blocks: int) -> List[bytes]:
+        """Chained digests for the first ``n_blocks`` full blocks: digest
+        of block i commits to all tokens of blocks 0..i."""
+        bs = self.block_size
+        key = b"\x00prefix-root"
+        out = []
+        for i in range(n_blocks):
+            h = hashlib.blake2b(key, digest_size=16)
+            h.update(np.asarray(tokens[i * bs:(i + 1) * bs],
+                                np.int64).tobytes())
+            key = h.digest()
+            out.append(key)
+        return out
+
+    # -- read path ---------------------------------------------------------
+    def match(self, prompt) -> Tuple[List[int], List[bytes], int]:
+        """Longest cached block chain covering a STRICT prefix of
+        ``prompt`` (the tip token is always recomputed so its logits can
+        be sampled).  Acquires one ref on every matched node.  Returns
+        ``(pages, node_keys, n_tokens)``; the caller must eventually
+        ``release(node_keys)``."""
+        self.lookups += 1
+        n_max = max(len(prompt) - 1, 0) // self.block_size
+        pages: List[int] = []
+        held: List[bytes] = []
+        for k in self._chain(prompt, n_max):
+            node = self._nodes.get(k)
+            if node is None:
+                break
+            node.refs += 1
+            self._tick += 1
+            node.lru = self._tick
+            held.append(k)
+            pages.append(node.page)
+        if held:
+            self.hits += 1
+        return pages, held, len(held) * self.block_size
+
+    def release(self, keys) -> None:
+        """Drop one ref per key (request finished / evicted / preempted).
+        Zero-ref nodes stay resident — warm cache — until ``evict``."""
+        for k in keys:
+            node = self._nodes.get(k)
+            if node is not None and node.refs > 0:
+                node.refs -= 1
+
+    # -- write path --------------------------------------------------------
+    def insert(self, prompt, pages) -> List[bytes]:
+        """Register the FULL prompt blocks backed by ``pages`` (the
+        request's block list, block i at ``pages[i]``).  Pages of blocks
+        not yet cached transfer ownership to the cache; the caller holds
+        one ref on each returned (new) key and must ``release`` them.
+        Blocks already cached (two identical prompts racing through
+        prefill) are skipped — the second copy stays a private page."""
+        n = min(len(prompt) // self.block_size, len(pages))
+        keys = self._chain(prompt, n)
+        new: List[bytes] = []
+        parent: Optional[bytes] = None
+        for i, k in enumerate(keys):
+            if k in self._nodes:
+                parent = k
+                continue
+            page = int(pages[i])
+            if page in self._page_owner:
+                # a page cannot serve two blocks; stop registering here
+                break
+            if parent is not None and parent not in self._nodes:
+                break                      # gap in the chain: unreachable
+            self._tick += 1
+            self._nodes[k] = _Node(page, parent, self._tick)
+            self._page_owner[page] = k
+            if parent is not None:
+                self._nodes[parent].children += 1
+            new.append(k)
+            parent = k
+        return new
+
+    # -- pool pressure -----------------------------------------------------
+    def owned_pages(self) -> Dict[int, bytes]:
+        """Pages currently owned by the cache (membership view — the
+        engine must NOT return these to its free pool on release)."""
+        return self._page_owner
+
+    def evictable_count(self) -> int:
+        """Pages reclaimable by eviction right now.  Every zero-ref node
+        counts: match acquires whole prefix paths, so a node's refcount
+        is always >= any descendant's and zero-ref subtrees drain
+        leaf-first."""
+        return sum(1 for n in self._nodes.values() if n.refs == 0)
+
+    def evict(self, n: int) -> List[int]:
+        """Free up to ``n`` pages from zero-ref LEAF nodes, LRU-first
+        (leaf-first keeps every resident node reachable from the root).
+        Returns the freed page ids for the engine's free pool."""
+        freed: List[int] = []
+        while len(freed) < n:
+            best = None
+            for k, node in self._nodes.items():
+                if node.refs or node.children:
+                    continue
+                if best is None or node.lru < self._nodes[best].lru:
+                    best = k
+            if best is None:
+                break
+            node = self._nodes.pop(best)
+            self._page_owner.pop(node.page, None)
+            if node.parent is not None and node.parent in self._nodes:
+                self._nodes[node.parent].children -= 1
+            freed.append(node.page)
+        return freed
+
+    # -- introspection -----------------------------------------------------
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def __len__(self) -> int:
+        return len(self._nodes)

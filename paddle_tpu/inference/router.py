@@ -1,0 +1,214 @@
+"""Health-aware admission + routing across ServingEngine replicas.
+
+The fleet front door: N single-host engines (possibly disaggregated
+pairs) serve behind one router that (1) scores each replica by its LIVE
+engine gauges — batch occupancy, KV-pool utilization — and admits every
+request on the least-loaded healthy replica, (2) turns
+``EngineOverloadedError`` from a hard failure into a REROUTE to the
+next replica (``serving/reroutes``), (3) demotes replicas whose health
+probe fails (watchdog ``__unhealthy__`` mark, aborted/closed transport,
+or any caller-supplied predicate) so traffic drains away from a sick
+host without dropping in-flight work elsewhere, and (4) installs each
+engine's ``requeue_hook`` so a deadline-evicted request is retried on
+another replica (``serving/requeues``) instead of dying with a 504.
+
+This is the same decision loop a production LB runs off a metrics
+scrape, shrunk to process-local method calls: the scores read the
+exact values the ``serving/*`` gauges export.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..profiler import metrics as _metrics
+from .serving import EngineOverloadedError, ServingEngine
+
+__all__ = ["Replica", "ReplicaRouter", "transport_healthy",
+           "watchdog_healthy"]
+
+_m_reroutes = _metrics.counter("serving/reroutes")
+_m_requeues = _metrics.counter("serving/requeues")
+
+
+def transport_healthy(tp) -> bool:
+    """A TensorTransport is healthy while it is open and un-poisoned
+    (watchdog escalation aborts it with a structured error)."""
+    return tp is not None and not tp._closed and tp._abort_exc is None
+
+
+def watchdog_healthy(store, group_id: int) -> bool:
+    """True while the comm watchdog has NOT marked ``group_id``
+    unhealthy in the store (distributed/watchdog.py escalation)."""
+    from ..distributed.watchdog import read_unhealthy
+
+    try:
+        return read_unhealthy(store, group_id) is None
+    except Exception:
+        return False          # unreadable store: assume the worst
+
+
+class Replica:
+    """One routable engine + its health probe.
+
+    ``health_fn`` is any zero-arg predicate — compose it from
+    ``transport_healthy`` / ``watchdog_healthy`` for real deployments;
+    a probe that raises counts as unhealthy.  ``mark_unhealthy`` is the
+    manual demotion lever (ops taking a replica out of rotation)."""
+
+    def __init__(self, engine: ServingEngine, name: Optional[str] = None,
+                 health_fn: Optional[Callable[[], bool]] = None):
+        self.engine = engine
+        self.name = name or f"replica{id(engine) & 0xffff:04x}"
+        self.health_fn = health_fn
+        self._demoted = False
+
+    def healthy(self) -> bool:
+        if self._demoted:
+            return False
+        if self.health_fn is not None:
+            try:
+                return bool(self.health_fn())
+            except Exception:
+                return False
+        return True
+
+    def mark_unhealthy(self):
+        self._demoted = True
+
+    def mark_healthy(self):
+        self._demoted = False
+
+    def load_score(self) -> float:
+        """Live load from the same values the serving gauges export:
+        batch occupancy + KV-pool utilization (0..2; lower = idler)."""
+        eng, cfg = self.engine, self.engine.cfg
+        occ = len(eng.pending()) / max(cfg.max_batch, 1)
+        live = cfg.num_blocks - 1 - len(eng._free_pages)
+        return occ + live / max(cfg.num_blocks - 1, 1)
+
+
+class ReplicaRouter:
+    """Admission + routing over a replica set.
+
+    ``submit`` returns a router-level handle (stable across requeues —
+    the handle follows the request to whichever replica finally serves
+    it); ``run_to_completion``/``results`` collect generations by
+    handle."""
+
+    def __init__(self, replicas, requeue_deadline_s: Optional[float] = None):
+        self.replicas: List[Replica] = [
+            r if isinstance(r, Replica) else Replica(r) for r in replicas]
+        if not self.replicas:
+            raise ValueError("router needs at least one replica")
+        # a requeued request gets this fresh deadline (None: no deadline
+        # on the retry — it already burned its first one)
+        self.requeue_deadline_s = requeue_deadline_s
+        self._handles: Dict[int, Tuple[int, int]] = {}   # h -> (idx, rid)
+        self._by_engine: Dict[Tuple[int, int], int] = {}
+        self._next_handle = 0
+        for idx, rep in enumerate(self.replicas):
+            rep.engine.requeue_hook = self._make_requeue_hook(idx)
+
+    # -- admission ---------------------------------------------------------
+    def _ordered(self, exclude: Optional[int] = None) -> List[int]:
+        healthy = [i for i, r in enumerate(self.replicas)
+                   if i != exclude and r.healthy()]
+        return sorted(healthy,
+                      key=lambda i: self.replicas[i].load_score())
+
+    def submit(self, prompt_tokens, max_new_tokens=8, sampling=None,
+               eos_token_id=None, deadline_s=None) -> int:
+        """Admit on the least-loaded healthy replica; an overloaded
+        replica is skipped (counted as a reroute) instead of failing the
+        request.  Raises EngineOverloadedError only when EVERY healthy
+        replica sheds (the fleet is genuinely saturated — or fully
+        demoted)."""
+        order = self._ordered()
+        for pos, idx in enumerate(order):
+            try:
+                rid = self.replicas[idx].engine.add_request(
+                    prompt_tokens, max_new_tokens=max_new_tokens,
+                    sampling=sampling, eos_token_id=eos_token_id,
+                    deadline_s=deadline_s)
+            except EngineOverloadedError:
+                _m_reroutes.inc()
+                continue
+            if pos > 0:
+                # admitted, but not on first choice — already counted
+                # one reroute per replica skipped above
+                pass
+            h = self._next_handle
+            self._next_handle += 1
+            self._handles[h] = (idx, rid)
+            self._by_engine[(idx, rid)] = h
+            return h
+        raise EngineOverloadedError(
+            f"all {len(self.replicas)} replicas saturated or unhealthy "
+            f"({sum(r.healthy() for r in self.replicas)} healthy)")
+
+    # -- deadline requeue --------------------------------------------------
+    def _make_requeue_hook(self, src_idx: int):
+        def hook(info):
+            _m_requeues.inc()
+            handle = self._by_engine.pop((src_idx, info["rid"]), None)
+            for idx in self._ordered(exclude=src_idx):
+                try:
+                    rid = self.replicas[idx].engine.add_request(
+                        info["prompt"],
+                        max_new_tokens=info["max_new"],
+                        sampling=info["sampling"],
+                        eos_token_id=info["eos_token_id"],
+                        deadline_s=self.requeue_deadline_s)
+                except EngineOverloadedError:
+                    _m_reroutes.inc()
+                    continue
+                if handle is not None:
+                    self._handles[handle] = (idx, rid)
+                    self._by_engine[(idx, rid)] = handle
+                return
+            # nowhere to retry: the handle keeps pointing at the
+            # timed-out request so results() reports it honestly
+            if handle is not None:
+                self._by_engine[(src_idx, info["rid"])] = handle
+        return hook
+
+    # -- driving -----------------------------------------------------------
+    def step_all(self) -> Dict[int, List[int]]:
+        """One scheduling step on every replica with pending work;
+        returns {handle: [tokens produced this step]}."""
+        produced: Dict[int, List[int]] = {}
+        for idx, rep in enumerate(self.replicas):
+            if not rep.engine.pending():
+                continue
+            for rid, tok in rep.engine.step():
+                h = self._by_engine.get((idx, rid))
+                if h is not None:
+                    produced.setdefault(h, []).append(tok)
+        return produced
+
+    def run_to_completion(self, max_steps: int = 1000) -> Dict[int, List[int]]:
+        for _ in range(max_steps):
+            if not any(rep.engine.pending() for rep in self.replicas):
+                break
+            self.step_all()
+        return self.results()
+
+    def results(self) -> Dict[int, List[int]]:
+        out = {}
+        for h, (idx, rid) in self._handles.items():
+            out[h] = list(
+                self.replicas[idx].engine._requests[rid].generated)
+        return out
+
+    def timed_out(self) -> List[int]:
+        """Handles whose FINAL placement still timed out (requeue also
+        failed or re-expired)."""
+        out = []
+        for h, (idx, rid) in self._handles.items():
+            if self.replicas[idx].engine._requests[rid].timed_out:
+                out.append(h)
+        return out
+
+    def placement(self, handle: int) -> Tuple[str, int]:
+        idx, rid = self._handles[handle]
+        return self.replicas[idx].name, rid

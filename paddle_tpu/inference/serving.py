@@ -56,6 +56,8 @@ _m_occupancy = _metrics.gauge("serving/batch_occupancy")
 _m_kv_util = _metrics.gauge("serving/kv_cache_utilization")
 _m_deadline = _metrics.counter("serving/deadline_evictions")
 _m_shed = _metrics.counter("serving/load_shed")
+_m_prefix_rate = _metrics.gauge("serving/prefix_hit_rate")
+_m_prefix_pages = _metrics.counter("serving/prefix_pages_reused")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
@@ -89,7 +91,7 @@ class PagedServingConfig:
                  num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
                  num_kv_heads=None, dtype="float32", cache_quant=None,
-                 max_queue=None):
+                 max_queue=None, prefix_cache=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -111,6 +113,11 @@ class PagedServingConfig:
         # load shedding: admission is rejected (EngineOverloadedError)
         # once this many requests are live; None = admit everything
         self.max_queue = max_queue
+        # prefix_cache=True: requests sharing a prompt prefix map their
+        # leading full blocks to the same physical pages (refcounted trie
+        # over the page pool, see inference/prefix_cache.py) — a cache
+        # hit skips straight past the shared tokens' prefill
+        self.prefix_cache = bool(prefix_cache)
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -269,17 +276,31 @@ class PagedCausalLM(Layer):
         self.ln_f = nn.RMSNorm(h)
         self.head = nn.Linear(h, cfg.vocab_size, bias_attr=False)
 
-    def _mlp(self, li, h):
+    def _lin(self, kind, li, h, w):
+        """One decoder Linear (bias-free): the layer's own weight, or —
+        when an int8 weight streamer is live (``w`` holds the layer's
+        dequantized group, prefetched while the PREVIOUS layer computed)
+        — a plain matmul against the streamed weight."""
+        if w is None:
+            return getattr(self, kind)[li](h)
+        mat = w[kind]
+
+        def mm(a):
+            return a @ mat
+
+        return apply(mm, h, op_name="stream_linear")
+
+    def _mlp(self, li, h, w=None):
         from ..incubate.nn.functional import swiglu
 
-        gu = self.gate_up[li](h)
+        gu = self._lin("gate_up", li, h, w)
         half = self.cfg.ffn_size
 
         def split(a):
             return a[..., :half], a[..., half:]
 
         g, u = apply(split, gu, op_name="split_gate_up")
-        return self.down[li](swiglu(g, u))
+        return self._lin("down", li, swiglu(g, u), w)
 
     # -- rope table shared by both paths ---------------------------------
     def _rope_table(self, positions):
@@ -326,9 +347,28 @@ class PagedCausalLM(Layer):
         new_kc, new_vc = key_caches, value_caches
         new_ks, new_vs = k_scales, v_scales
         quant = k_scales is not None
+        # int8 weight streaming (inference/weight_stream.py): dequantize
+        # layer i+1's Linear group BEFORE layer i's compute so XLA's
+        # latency-hiding scheduler overlaps the int8 weight read +
+        # dequant with matmuls it does not feed — the stage3_forward
+        # FSDP-prefetch shape applied to the weight-streaming-bound
+        # decode step
+        ws = getattr(self, "_wstream_live", None)
+        nxt_w = ws.dequant_layer(0) if ws is not None and ws.prefetch \
+            else None
         for li in range(cfg.num_layers):
+            if ws is None:
+                cur_w = None
+            elif ws.prefetch:
+                cur_w = nxt_w
+                nxt_w = ws.dequant_layer(li + 1) \
+                    if li + 1 < cfg.num_layers else None
+            else:
+                # no-prefetch baseline: dequant issued AT use — no
+                # overlap window (what the micro-bench prices against)
+                cur_w = ws.dequant_layer(li)
             h = self.ln1[li](x)
-            qkv = self.qkv[li](h)                      # [T, (HQ+2HKV)*D]
+            qkv = self._lin("qkv", li, h, cur_w)       # [T, (HQ+2HKV)*D]
             # stacked-cache mode: each layer reads/writes its slice of
             # the ONE [L, pool] cache pair (single dynamic-update-slice
             # chain — the list+jnp.stack pattern rebuilt the full cache
@@ -349,9 +389,9 @@ class PagedCausalLM(Layer):
                 out, _, new_kc, new_vc, new_ks, new_vs = outs
             else:
                 out, _, new_kc, new_vc = outs
-            x = x + self.proj[li](out)
+            x = x + self._lin("proj", li, out, cur_w)
             h = self.ln2[li](x)
-            x = x + self._mlp(li, h)
+            x = x + self._mlp(li, h, cur_w)
         x = self.ln_f(x)
         # last token of each row: cu_q[i+1]-1 (rows with 0 tokens this
         # step read their previous row's last token — masked host-side)
@@ -422,7 +462,9 @@ class PagedCausalLM(Layer):
 class _Request:
     __slots__ = ("rid", "prompt", "generated", "max_new", "pages",
                  "cached", "done", "sampling", "eos_token_id",
-                 "submit_t", "first_tok_t", "deadline_t", "timed_out")
+                 "submit_t", "first_tok_t", "deadline_t", "timed_out",
+                 "shared_keys", "prefix_registered", "salt_rid",
+                 "salt_seed")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
                  deadline_s=None):
@@ -440,6 +482,16 @@ class _Request:
         self.deadline_t = None if deadline_s is None \
             else self.submit_t + float(deadline_s)
         self.timed_out = False
+        # prefix-cache bookkeeping: trie node keys this request holds a
+        # ref on (leading shared pages), and whether its own full prompt
+        # blocks were registered after prefill
+        self.shared_keys = []
+        self.prefix_registered = False
+        # sampling-salt identity: a request migrated between engines
+        # (disaggregated prefill/decode) keeps its ORIGIN (seed, rid) so
+        # its token stream is bitwise-identical to the single-engine path
+        self.salt_rid = rid
+        self.salt_seed = None      # None = use the engine's seed
 
     @property
     def length(self):
@@ -500,22 +552,47 @@ class ServingEngine:
         self._requests = {}
         self._next_rid = 0
         self._window_fns = {}
+        # shared-prefix KV reuse (cfg.prefix_cache=True): refcounted trie
+        # over the page pool; consulted at admission so a hit skips the
+        # shared tokens' prefill entirely
+        if cfg.prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            self._prefix_cache = PrefixCache(cfg.block_size)
+        else:
+            self._prefix_cache = None
+        # deadline-evicted requests are surfaced here instead of dropped:
+        # the replica router installs a hook that retries them on another
+        # replica (hook receives the dict from _requeue_info; it must not
+        # raise — a failing hook fails the engine step sweeping it)
+        self.requeue_hook = None
 
     @classmethod
     def from_model(cls, model: PagedCausalLM, cfg: PagedServingConfig,
-                   seed=0):
+                   seed=0, weight_stream=None):
         """Build an engine directly over a live model (no disk artifact):
         the step function is jitted from the layer's functional form, with
         floating params cast to cfg.dtype (bf16 serving regime). The
         compiled step and staged weights are cached on the model, so
         several engines over the same model share one executable and one
-        HBM weight copy (weights are snapshotted at the first call)."""
+        HBM weight copy (weights are snapshotted at the first call).
+
+        ``weight_stream`` streams the decoder Linear stacks as
+        per-channel int8 (inference/weight_stream.py), dequantized on use
+        with the NEXT layer's group issued before the current layer's
+        compute — double-buffered, directly attacking the
+        weight-streaming-bound decode step (the PR 2 int8-KV finding).
+        ``"int8"`` prefetches; ``"int8-noprefetch"`` dequantizes at use
+        (the honest baseline the micro-bench prices the overlap
+        against).  Generations match an engine over the dequantized
+        weights bitwise; vs the full-precision engine they differ by the
+        quantization error."""
         from ..jit import functional as FB
 
         eng = cls(None, cfg, seed=seed)
+        share_key = (cfg.dtype, cfg.cache_quant, weight_stream)
         cached = getattr(model, "_serving_shared", None)
-        if cached is not None and cached[0] == (cfg.dtype,
-                                                cfg.cache_quant):
+        if cached is not None and cached[0] == share_key:
             (_, eng._compiled, eng._compiled_fresh, eng._params,
              eng._buffers) = cached
             return eng
@@ -526,13 +603,32 @@ class ServingEngine:
             lambda a: a.astype(tgt)
             if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
             params)
+        if weight_stream is not None:
+            from .weight_stream import WeightStreamer
+
+            streamer = WeightStreamer.build(
+                model, cast, tgt,
+                prefetch=weight_stream != "int8-noprefetch")
+        else:
+            streamer = None
         flat_p, tree_p = jax.tree_util.tree_flatten(cast)
+        n_base = len(flat_p)
+        if streamer is not None:
+            flat_p = flat_p + streamer.flat()
         flat_b, tree_b = jax.tree_util.tree_flatten(buffers)
 
         def pure(fp, fb, *ins):
-            ps = jax.tree_util.tree_unflatten(tree_p, fp)
+            ps = jax.tree_util.tree_unflatten(tree_p, fp[:n_base])
             bs = jax.tree_util.tree_unflatten(tree_b, fb)
-            out, _ = FB.call_functional(model, ps, bs, ins, train=False)
+            if streamer is not None:
+                object.__setattr__(model, "_wstream_live",
+                                   streamer.bind(fp[n_base:]))
+            try:
+                out, _ = FB.call_functional(model, ps, bs, ins,
+                                            train=False)
+            finally:
+                if streamer is not None:
+                    object.__setattr__(model, "_wstream_live", None)
             return tuple(out)
 
         def pure_fresh(fp, fb, *ins):
@@ -550,7 +646,7 @@ class ServingEngine:
         eng._compiled = jax.jit(pure)
         eng._compiled_fresh = jax.jit(pure_fresh)
         object.__setattr__(model, "_serving_shared",
-                           ((cfg.dtype, cfg.cache_quant), eng._compiled,
+                           (share_key, eng._compiled,
                             eng._compiled_fresh, eng._params,
                             eng._buffers))
         return eng
@@ -580,16 +676,46 @@ class ServingEngine:
                 f"(retry later or on another replica)")
         rid = self._next_rid
         self._next_rid += 1
-        self._requests[rid] = _Request(rid, prompt_tokens, max_new_tokens,
-                                       sampling, eos_token_id,
-                                       deadline_s=deadline_s)
+        req = _Request(rid, prompt_tokens, max_new_tokens,
+                       sampling, eos_token_id, deadline_s=deadline_s)
+        self._requests[rid] = req
+        self._try_prefix_match(req)
         _m_requests.inc()
         return rid
+
+    def _try_prefix_match(self, req):
+        """Map the request's leading full prompt blocks onto cached pages
+        (shared-prefix KV reuse): a hit sets ``cached`` past the shared
+        tokens so scheduling skips their prefill entirely."""
+        cache = self._prefix_cache
+        if cache is None or req.pages:
+            return
+        pages, keys, n_tok = cache.match(req.prompt)
+        if n_tok:
+            req.pages = list(pages)
+            req.shared_keys = keys
+            req.cached = n_tok
+            _m_prefix_pages.inc(len(pages))
+        _m_prefix_rate.set(cache.hit_rate())
+
+    def _maybe_register_prefix(self, req):
+        """After a request's prompt is fully prefilled, publish its full
+        prompt blocks into the prefix cache (ownership of those pages
+        transfers to the cache; the request keeps a ref)."""
+        cache = self._prefix_cache
+        if cache is None or req.prefix_registered \
+                or req.cached < len(req.prompt):
+            return
+        req.prefix_registered = True
+        req.shared_keys.extend(cache.insert(req.prompt, req.pages))
 
     def _evict_expired(self):
         """Deadline sweep, run before scheduling: requests past their
         per-request deadline finish NOW as timed out — their pages go
-        back to the pool instead of starving live traffic."""
+        back to the pool instead of starving live traffic.  Each evicted
+        request is surfaced through ``requeue_hook`` (when installed) so
+        a replica router can retry it elsewhere instead of dropping it
+        on the floor."""
         now = time.perf_counter()
         for r in self.pending():
             if r.deadline_t is not None and now > r.deadline_t:
@@ -597,10 +723,30 @@ class ServingEngine:
                 r.done = True
                 self._release(r)
                 _m_deadline.inc()
+                if self.requeue_hook is not None:
+                    self.requeue_hook(self._requeue_info(r))
+
+    @staticmethod
+    def _requeue_info(r):
+        """What a router needs to retry an evicted request on another
+        replica: the full prompt (the new replica re-prefills — or
+        prefix-cache-hits — it), progress so far, and the original
+        budget/sampling."""
+        return {"rid": r.rid, "prompt": list(r.prompt),
+                "generated": list(r.generated), "max_new": r.max_new,
+                "sampling": r.sampling, "eos_token_id": r.eos_token_id,
+                "timed_out": True}
 
     def timed_out_requests(self):
         """rids evicted by the deadline sweep (serving front-end: 504)."""
         return [r.rid for r in self._requests.values() if r.timed_out]
+
+    def _salt(self, r, n_generated):
+        """Sampling salt under the request's ORIGIN identity: a request
+        migrated from a prefill engine keeps its original (seed, rid) so
+        disaggregated decode draws the single-engine path's randomness."""
+        seed = self.seed if r.salt_seed is None else r.salt_seed
+        return sampling_salt(seed, r.salt_rid, n_generated)
 
     def _note_first_token(self, req, now):
         if req.first_tok_t is None:
@@ -613,15 +759,31 @@ class ServingEngine:
         live = cfg.num_blocks - 1 - len(self._free_pages)  # page 0 = trash
         _m_kv_util.set(live / max(cfg.num_blocks - 1, 1))
 
+    def _take_free_page(self):
+        """Pop one free page, reclaiming zero-ref prefix-cache pages
+        under pool pressure (cache residency never blocks live traffic)."""
+        if not self._free_pages and self._prefix_cache is not None:
+            self._free_pages.extend(self._prefix_cache.evict(1))
+        if not self._free_pages:
+            raise RuntimeError("KV page pool exhausted")
+        return self._free_pages.pop()
+
     def _ensure_pages(self, req, upto_len):
         need = math.ceil(upto_len / self.cfg.block_size)
         while len(req.pages) < need:
-            if not self._free_pages:
-                raise RuntimeError("KV page pool exhausted")
-            req.pages.append(self._free_pages.pop())
+            req.pages.append(self._take_free_page())
 
     def _release(self, req):
-        self._free_pages.extend(req.pages)
+        cache = self._prefix_cache
+        if req.shared_keys:
+            cache.release(req.shared_keys)
+            req.shared_keys = []
+        if cache is not None:
+            owned = cache.owned_pages()
+            self._free_pages.extend(p for p in req.pages
+                                    if p not in owned)
+        else:
+            self._free_pages.extend(req.pages)
         req.pages = []
 
     def _set_caches(self, kc, vc):
@@ -644,6 +806,9 @@ class ServingEngine:
         rows = []
         budget = cfg.token_budget
         avail = len(self._free_pages)
+        if self._prefix_cache is not None:
+            # zero-ref cache pages are reclaimable on demand
+            avail += self._prefix_cache.evictable_count()
         for r in self.pending():
             if len(rows) == cfg.max_batch or budget == 0:
                 break
@@ -673,6 +838,7 @@ class ServingEngine:
 
         self._evict_expired()
         rows = self._schedule()
+        preempted = set()
         while not rows and self.pending():
             # pool deadlock: in-flight requests hold pages but none can
             # grow — preempt the NEWEST holder (FCFS priority: the oldest
@@ -688,6 +854,15 @@ class ServingEngine:
             victim = max(holders, key=lambda r: r.rid)
             self._release(victim)
             victim.cached = 0
+            victim.prefix_registered = False
+            if victim.rid not in preempted:
+                # its shared prefix may still be resident: re-match so
+                # the re-prefill only covers tokens past the cached
+                # blocks — but only ONCE per sweep (a re-acquired prefix
+                # makes the victim a page holder again; re-matching it
+                # every pass would spin this loop forever)
+                self._try_prefix_match(victim)
+            preempted.add(victim.rid)
             _m_preempt.inc()
             rows = self._schedule()
         if not rows:
@@ -744,13 +919,13 @@ class ServingEngine:
                 temps[i] = sp.temperature
                 topks[i] = sp.top_k
                 topps[i] = sp.top_p
-                salts[i] = sampling_salt(self.seed, r.rid,
-                                         len(r.generated))
+                salts[i] = self._salt(r, len(r.generated))
         if not any(tip):
             # pure prefill-chunk step: nothing to sample — skip the
             # sampler dispatch AND the host round-trip entirely
             for r, chunk in rows:
                 r.cached += chunk
+                self._maybe_register_prefix(r)
             return []
         # fast paths: skip the full-vocab sort when no row samples, or
         # when every sampling row fits the exact top-k candidate sampler
@@ -767,6 +942,7 @@ class ServingEngine:
         now = time.perf_counter()
         for i, (r, chunk) in enumerate(rows):
             r.cached += chunk
+            self._maybe_register_prefix(r)
             if not tip[i]:
                 continue
             nxt = int(sampled[i])
@@ -870,6 +1046,7 @@ class ServingEngine:
         B1 = cfg.max_batch + 1
         for r in rows:
             self._ensure_pages(r, r.cached + n)
+            self._maybe_register_prefix(r)
         self._update_pool_gauges(B)
         _m_steps.inc(n)
 
@@ -910,8 +1087,7 @@ class ServingEngine:
         salts = np.zeros((n, B1), np.int32)
         for j in range(n):
             for i, r in enumerate(rows):
-                salts[j, i] = sampling_salt(self.seed, r.rid,
-                                            ngen0[i] + j)
+                salts[j, i] = self._salt(r, ngen0[i] + j)
         dec = np.zeros(B1, np.int32)
         dec[:B] = dec0
 

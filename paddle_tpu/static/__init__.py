@@ -293,14 +293,39 @@ def device_guard(device=None):
     yield
 
 
+def _metrics_inc_safe(name):
+    try:
+        from ..profiler import metrics as _metrics
+
+        _metrics.inc(name)
+    except Exception:
+        pass
+
+
 class Executor:
     """reference: python/paddle/base/executor.py:1179 (run :1637 via the
     StandaloneExecutor/PirInterpreter). Replays a recorded Program under
     jax.jit — first run builds+compiles the replay (the reference's
-    build-instruction-list phase), steady state reuses the executable."""
+    build-instruction-list phase), steady state reuses the executable.
 
-    def __init__(self, place=None):
+    The cost-model fusion pass (``auto_fuse``, the CINN-analog tier)
+    runs on the program's FIRST replay — verified (fetch-signature
+    equivalence) and counted in ``compiler/fused_regions`` from real
+    dispatches, not just artifact emission.  A later ``run`` that
+    fetches an intermediate the fusion collapsed transparently reverts
+    to the unfused op list (the record-replay contract — any recorded
+    tensor is fetchable — beats the optimization).  Opt out per
+    executor (``auto_fuse=False``) or globally
+    (``PT_EXECUTOR_AUTO_FUSE=0``)."""
+
+    def __init__(self, place=None, auto_fuse=None):
         self.place = place
+        if auto_fuse is None:
+            import os
+
+            auto_fuse = os.environ.get("PT_EXECUTOR_AUTO_FUSE",
+                                       "1").lower() not in ("0", "false")
+        self.auto_fuse = bool(auto_fuse)
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True):
@@ -320,6 +345,35 @@ class Executor:
                     for o in outs]
         return list(outs)
 
+    def _fused_ops(self, program):
+        """The verified cost-model fusion of ``program``'s op list,
+        computed lazily on a SHALLOW CLONE so the user-visible recorded
+        ops are never mutated by an optimization.  Returns None when
+        fusion is off, found nothing, or failed (abstractly unevaluable
+        capture / verifier mismatch) — the replay then runs the recorded
+        list verbatim."""
+        if not self.auto_fuse:
+            return None
+        sig = (id(program.ops), len(program.ops))
+        if getattr(program, "_fused_sig", None) == sig:
+            return program._fused_ops
+        program._fused_sig = sig
+        program._fused_ops = None
+        try:
+            import copy
+
+            from .passes import PassManager
+
+            clone = copy.copy(program)
+            clone.ops = list(program.ops)
+            clone._compiled = {}
+            PassManager(["auto_fuse"]).run(clone, verify=True)
+            if len(clone.ops) < len(program.ops):
+                program._fused_ops = clone.ops
+        except Exception:
+            _metrics_inc_safe("compiler/executor_fuse_reverts")
+        return program._fused_ops
+
     def _replay(self, program, feed, fetch_list, return_numpy):
         import numpy as np
 
@@ -330,6 +384,7 @@ class Executor:
         for f in fetch_list:
             if id(f) not in seen_fetch:
                 program.fetch_targets.append(f)
+        fused = self._fused_ops(program)
 
         fetch_uids = [Program._uid(f) for f in fetch_list]
         key = (tuple(fetch_uids),
@@ -338,39 +393,47 @@ class Executor:
                      for n, v in sorted(feed.items())))
         cached = program._compiled.get(key)
         if cached is None:
-            # feeds actually consumed by recorded ops; unused declared
-            # feeds may be omitted (reference prunes them too)
-            used_uids = {u for (_, _, _, _, in_uids, _, _, _)
-                         in program.ops for u in in_uids}
-            feed_uid_of = {n: Program._uid(t)
-                           for n, t in program.feed_targets.items()}
-            feed_names = sorted(n for n in feed_uid_of
-                                if feed_uid_of[n] in used_uids
-                                or n in feed)
-            missing = [n for n in feed_names if n not in feed]
-            if missing:
-                raise KeyError(f"feed targets {missing} are consumed by "
-                               f"the program but absent from feed")
-            feed_uids_used = {feed_uid_of[n] for n in feed_names}
-            ext_uids = [u for u in program._live
-                        if u in used_uids and u not in feed_uids_used]
-            producible = set(feed_uids_used) | set(ext_uids)
-            for (_, _, _, _, _, _, _, out_uids) in program.ops:
-                producible.update(out_uids)
-            bad = [f for f, u in zip(fetch_list, fetch_uids)
-                   if u not in producible]
-            if bad:
-                raise ValueError(
-                    "fetch_list contains tensors the program neither "
-                    "produces nor feeds (fetched placeholder without a "
-                    f"feed, or value never recorded): {bad}")
+            ops_list = fused if fused is not None else program.ops
+            while True:
+                # feeds actually consumed by replayed ops; unused
+                # declared feeds may be omitted (reference prunes them)
+                used_uids = {u for (_, _, _, _, in_uids, _, _, _)
+                             in ops_list for u in in_uids}
+                feed_uid_of = {n: Program._uid(t)
+                               for n, t in program.feed_targets.items()}
+                feed_names = sorted(n for n in feed_uid_of
+                                    if feed_uid_of[n] in used_uids
+                                    or n in feed)
+                missing = [n for n in feed_names if n not in feed]
+                if missing:
+                    raise KeyError(f"feed targets {missing} are consumed "
+                                   f"by the program but absent from feed")
+                feed_uids_used = {feed_uid_of[n] for n in feed_names}
+                ext_uids = [u for u in program._live
+                            if u in used_uids and u not in feed_uids_used]
+                producible = set(feed_uids_used) | set(ext_uids)
+                for (_, _, _, _, _, _, _, out_uids) in ops_list:
+                    producible.update(out_uids)
+                bad = [f for f, u in zip(fetch_list, fetch_uids)
+                       if u not in producible]
+                if bad and ops_list is not program.ops:
+                    # the fetch wants an intermediate auto_fuse
+                    # collapsed: replay the recorded op list verbatim
+                    ops_list = program.ops
+                    continue
+                if bad:
+                    raise ValueError(
+                        "fetch_list contains tensors the program neither "
+                        "produces nor feeds (fetched placeholder without "
+                        f"a feed, or value never recorded): {bad}")
+                break
             feed_uid_list = [feed_uid_of[n] for n in feed_names]
 
-            def replay(feed_arrays, ext_arrays):
+            def replay(feed_arrays, ext_arrays, _ops=ops_list):
                 env = dict(zip(feed_uid_list, feed_arrays))
                 env.update(zip(ext_uids, ext_arrays))
                 for (name, fn, entry_flat, tpos, in_uids, treedef,
-                     out_positions, out_uids) in program.ops:
+                     out_positions, out_uids) in _ops:
                     flat2 = list(entry_flat)
                     for i, u in zip(tpos, in_uids):
                         flat2[i] = env[u]

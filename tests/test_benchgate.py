@@ -104,8 +104,8 @@ def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
 
 def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
-    assert gate == ["llama_train", "eager_dispatch", "serving"]
-    assert len(bench.WORKLOADS) == 8
+    assert gate == ["llama_train", "eager_dispatch", "serving", "fleet"]
+    assert len(bench.WORKLOADS) == 9
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +165,39 @@ def test_benchgate_parses_driver_wrapper_and_skips_empty_rounds(tmp_path):
     cand.write_text(json.dumps(_result(tps=15900.0)))
     assert benchgate.main(["-c", str(cand),
                            "--baseline-dir", str(tmp_path)]) == 0
+
+
+def _fleet_result(rps=640.0, hit=0.94, ttft=0.012, **kw):
+    out = _result(**kw)
+    out["extra"]["fleet"] = {
+        "fleet": {"requests_per_sec": rps, "prefix_hit_rate": hit,
+                  "ttft_mean_s": ttft, "speedup_vs_nocache": 2.7},
+        "weight_stream": {"step_ms_bf16_min": 5.4,
+                          "step_ms_int8_stream_min": 4.1},
+    }
+    return out
+
+
+def test_benchgate_fleet_rows_pass_within_threshold(tmp_path):
+    assert _gate(tmp_path, _fleet_result(rps=625.0),
+                 _fleet_result()) == 0
+    # a baseline without fleet rows gates only the shared signals
+    assert _gate(tmp_path, _fleet_result(), _result()) == 0
+
+
+def test_benchgate_fails_fleet_requests_per_sec_drop(tmp_path):
+    assert _gate(tmp_path, _fleet_result(rps=540.0),
+                 _fleet_result()) == 1
+
+
+def test_benchgate_fails_fleet_hit_rate_drop(tmp_path):
+    assert _gate(tmp_path, _fleet_result(hit=0.80),
+                 _fleet_result()) == 1
+
+
+def test_benchgate_fails_fleet_ttft_rise(tmp_path):
+    assert _gate(tmp_path, _fleet_result(ttft=0.020),
+                 _fleet_result()) == 1
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

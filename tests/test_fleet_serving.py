@@ -1,0 +1,440 @@
+"""Fleet serving tier (ISSUE 7): shared-prefix KV reuse, disaggregated
+prefill/decode over the CRC/ACK TensorTransport (chaos-tested), health-
+aware multi-replica routing with deadline requeue, and int8 double-
+buffered weight streaming.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import transport as tr
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.inference import disagg
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import (EngineOverloadedError,
+                                          PagedCausalLM,
+                                          PagedServingConfig,
+                                          SamplingParams, ServingEngine)
+from paddle_tpu.profiler import metrics as _metrics
+
+
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+
+
+def _cval(name):
+    return _metrics.counter(name).value
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def _fresh_engine(model, seed=0, **over):
+    """Engine over `model`, reusing the model's shared executable when
+    the (dtype, cache_quant, weight_stream) mode matches — pool/queue
+    dims don't shape the step function, so recompiling per test would
+    only burn tier-1 budget."""
+    ws = over.pop("_weight_stream", None)
+    cfg = PagedServingConfig(**{**BASE, **over})
+    cached = getattr(model, "_serving_shared", None)
+    if cached is not None and cached[0] != (cfg.dtype, cfg.cache_quant,
+                                            ws):
+        model._serving_shared = None
+    return ServingEngine.from_model(model, cfg, seed=seed,
+                                    weight_stream=ws)
+
+
+def _dense_greedy(model, prompt, n):
+    ids = list(prompt)
+    for _ in range(n):
+        lg = model.forward_dense(
+            paddle.to_tensor(np.asarray([ids], np.int64))).numpy()
+        ids.append(int(np.argmax(lg[0, -1])))
+    return ids[len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# shared-prefix KV reuse
+# ---------------------------------------------------------------------------
+
+def test_prefix_hit_skips_prefill_and_matches_reference(model):
+    eng = _fresh_engine(model, prefix_cache=True)
+    rng = np.random.RandomState(0)
+    prefix = list(rng.randint(1, 97, 24))               # 3 full blocks
+    p1 = prefix + list(rng.randint(1, 97, 5))
+    p2 = prefix + list(rng.randint(1, 97, 3))
+
+    r1 = eng.add_request(p1, max_new_tokens=4)
+    assert eng._requests[r1].cached == 0                # cold cache
+    out1 = eng.run_to_completion()[r1]
+
+    pages1 = None
+    r2 = eng.add_request(p2, max_new_tokens=4)
+    req2 = eng._requests[r2]
+    # the shared 3 blocks are served from cache: prefill starts at 24
+    assert req2.cached == 24
+    pages1 = list(req2.pages)
+    out = eng.run_to_completion()
+    assert out[r1] == out1 == _dense_greedy(model, p1, 4)
+    assert out[r2] == _dense_greedy(model, p2, 4)
+    assert eng._prefix_cache.hit_rate() == 0.5          # 1 hit / 2 lookups
+    assert _metrics.gauge("serving/prefix_hit_rate").value == 0.5
+    assert len(pages1) == 3
+
+
+def test_prefix_shared_pages_are_same_physical_blocks(model):
+    """Two live requests with a common prefix address the SAME pool
+    pages for the shared blocks and diverge into private pages (the
+    copy-on-write point is the first non-shared block)."""
+    eng = _fresh_engine(model, prefix_cache=True)
+    rng = np.random.RandomState(1)
+    prefix = list(rng.randint(1, 97, 16))               # 2 full blocks
+    pa = prefix + list(rng.randint(1, 97, 6))
+    pb = prefix + list(rng.randint(1, 97, 9))
+
+    ra = eng.add_request(pa, max_new_tokens=3)
+    eng.step()                                          # prefill a
+    pages_a = list(eng._requests[ra].pages)
+    eng.run_to_completion()
+    rb = eng.add_request(pb, max_new_tokens=3)
+    reqb = eng._requests[rb]
+    assert reqb.pages[:2] == pages_a[:2]                # shared blocks
+    eng.step()                                          # prefill b's tail
+    assert reqb.pages[2:] and reqb.pages[2:] != pages_a[2:]   # private
+    out = eng.run_to_completion()
+    assert out[ra] == _dense_greedy(model, pa, 3)
+    assert out[rb] == _dense_greedy(model, pb, 3)
+
+
+def test_prefix_cache_eviction_under_pool_pressure(model):
+    """Zero-ref cached pages are reclaimed when the free pool runs dry —
+    cache residency never blocks live traffic, and the pool accounting
+    stays exact across generations of requests."""
+    eng = _fresh_engine(model, prefix_cache=True, num_blocks=16)
+    rng = np.random.RandomState(2)
+    free0 = len(eng._free_pages)
+    for wave in range(6):
+        prompt = list(rng.randint(1, 97, 17))           # distinct prompts
+        rid = eng.add_request(prompt, max_new_tokens=2)
+        out = eng.run_to_completion()
+        assert len(out[rid]) == 2
+    # resident cache pages account for exactly the missing free pages
+    resident = len(eng._prefix_cache.owned_pages())
+    assert len(eng._free_pages) + resident == free0
+    assert eng._prefix_cache.evictable_count() == resident
+    # force reclamation: a burst needing more pages than the free pool
+    rids = [eng.add_request(list(rng.randint(1, 97, 30)),
+                            max_new_tokens=2) for _ in range(3)]
+    out = eng.run_to_completion()
+    for rid in rids:
+        assert len(out[rid]) == 2
+
+
+def test_prefix_cache_trie_unit():
+    from paddle_tpu.inference.prefix_cache import PrefixCache
+
+    c = PrefixCache(block_size=4)
+    toks = list(range(1, 13))                           # 3 full blocks
+    new = c.insert(toks, [10, 11, 12])
+    assert len(new) == 3 and len(c) == 3
+    # full-prompt match caps at len-1: only 2 blocks of an identical
+    # 12-token prompt are served (the tip token must be recomputed)
+    pages, keys, n = c.match(toks)
+    assert pages == [10, 11] and n == 8
+    # divergence in block 1: only block 0 matches
+    pages2, keys2, n2 = c.match([1, 2, 3, 4, 99, 99, 99, 99, 9] )
+    assert pages2 == [10] and n2 == 4
+    # nothing evictable while refs are held; everything after release
+    assert c.evictable_count() == 0
+    c.release(keys)
+    c.release(keys2)
+    c.release(new)
+    assert c.evictable_count() == 3
+    freed = c.evict(10)
+    assert sorted(freed) == [10, 11, 12] and len(c) == 0
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode over TensorTransport
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pair():
+    store = TCPStore("127.0.0.1", 0, is_master=True)
+    t0 = tr.TensorTransport(0, 2, store, bind_host="127.0.0.1",
+                            timeout=15.0, ack_timeout=3.0)
+    t1 = tr.TensorTransport(1, 2, store, bind_host="127.0.0.1",
+                            timeout=15.0, ack_timeout=3.0)
+    yield t0, t1
+    faults.disarm()
+    t0.close()
+    t1.close()
+    store.close()
+
+
+def _disagg_vs_single(model, pair, prompts, sampling, seed=5,
+                      max_new=6, **cfg_over):
+    """Run the same workload through one engine and through a
+    prefill->decode pair; returns (single tokens, disagg tokens) lists
+    in submission order."""
+    t0, t1 = pair
+    ref_eng = _fresh_engine(model, seed=seed, **cfg_over)
+    rids = [ref_eng.add_request(p, max_new_tokens=max_new,
+                                sampling=sampling) for p in prompts]
+    ref = ref_eng.run_to_completion()
+    ref_tokens = [ref[r] for r in rids]
+
+    pre = _fresh_engine(model, seed=seed, **cfg_over)
+    dec = _fresh_engine(model, seed=seed, **cfg_over)
+    pw = disagg.PrefillWorker(pre, t0, decode_rank=1)
+    dw = disagg.DecodeWorker(dec, t1, prefill_rank=0)
+    for p in prompts:
+        pw.submit(p, max_new_tokens=max_new, sampling=sampling)
+    moved = pw.pump()
+    assert len(moved) == len(prompts)
+    local = dw.accept(len(prompts))
+    res = dw.run(window=4)
+    return ref_tokens, [res[r] for r in local]
+
+
+def test_disagg_handoff_bitwise_identical(model, pair):
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, 97, n)) for n in (9, 14)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.9)
+    ref, got = _disagg_vs_single(model, pair, prompts, sp)
+    assert got == ref          # token-bitwise identical, sampled decode
+    # decode engine never saw a prefill chunk: every scheduled step on
+    # it was a 1-token decode row
+    assert _cval("serving/migrations") >= 4      # 2 sends + 2 receives
+
+
+def test_disagg_handoff_int8_cache(model, pair):
+    """The scale pools migrate with the pages for int8-quantized KV."""
+    rng = np.random.RandomState(8)
+    prompts = [list(rng.randint(1, 97, 11))]
+    ref, got = _disagg_vs_single(model, pair, prompts, None,
+                                 cache_quant="int8")
+    assert got == ref
+
+
+def test_disagg_handoff_under_chaos_plan(model, pair):
+    """PT_FAULT_PLAN drop+corrupt+dup+delay at the transport sites: the
+    CRC/ACK layer retries/dedups, the migration completes, and the
+    decode stream stays token-bitwise identical; retries are counted."""
+    r0, c0 = _cval("comm/retries"), _cval("comm/corrupt_frames")
+    faults.arm("drop@send#1:rank=0,corrupt@send#2:rank=0,"
+               "dup@send#3:rank=0,delay@send#4:rank=0:ms=30")
+    rng = np.random.RandomState(9)
+    prompts = [list(rng.randint(1, 97, n)) for n in (10, 6)]
+    sp = SamplingParams(temperature=0.7, top_k=16, top_p=0.95)
+    ref, got = _disagg_vs_single(model, pair, prompts, sp)
+    assert got == ref
+    assert _cval("comm/retries") >= r0 + 2       # drop + corrupt retried
+    assert _cval("comm/corrupt_frames") >= c0 + 1
+
+
+def test_migrate_requires_decode_tip(model, pair):
+    t0, _ = pair
+    eng = _fresh_engine(model)
+    rid = eng.add_request(list(range(1, 9)), max_new_tokens=4)
+    with pytest.raises(ValueError):
+        disagg.migrate_request(eng, rid, t0, 1)   # prefill not finished
+
+
+# ---------------------------------------------------------------------------
+# health-aware multi-replica routing
+# ---------------------------------------------------------------------------
+
+def test_router_reroutes_on_overload(model):
+    """An overloaded replica (max_queue) is skipped — the request lands
+    on the next replica instead of failing, and the reroute is counted."""
+    e0 = _fresh_engine(model, max_queue=1)
+    e1 = _fresh_engine(model, max_queue=None)
+    router = ReplicaRouter([Replica(e0, "a"), Replica(e1, "b")])
+    rr0 = _cval("serving/reroutes")
+    rng = np.random.RandomState(11)
+    handles = [router.submit(list(rng.randint(1, 97, 6)),
+                             max_new_tokens=2) for _ in range(4)]
+    placements = {h: router.placement(h)[0] for h in handles}
+    # replica "a" saturates after 1 live request; the spill reroutes
+    assert sum(1 for p in placements.values() if p == "a") == 1
+    assert sum(1 for p in placements.values() if p == "b") == 3
+    assert _cval("serving/reroutes") >= rr0 + 1
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 2 for h in handles)
+
+
+def test_router_health_demotion(model):
+    e0 = _fresh_engine(model)
+    e1 = _fresh_engine(model)
+    r0, r1 = Replica(e0, "sick"), Replica(e1, "ok")
+    router = ReplicaRouter([r0, r1])
+    r0.mark_unhealthy()
+    rng = np.random.RandomState(12)
+    hs = [router.submit(list(rng.randint(1, 97, 5)), max_new_tokens=2)
+          for _ in range(3)]
+    assert all(router.placement(h)[0] == "ok" for h in hs)
+    # every replica demoted -> honest saturation error
+    r1.mark_unhealthy()
+    with pytest.raises(EngineOverloadedError):
+        router.submit([1, 2, 3], max_new_tokens=2)
+    r0.mark_healthy()
+    h = router.submit([1, 2, 3], max_new_tokens=2)
+    assert router.placement(h)[0] == "sick"
+
+
+def test_router_health_fn_probe(model):
+    """A health probe (e.g. transport_healthy over the replica's
+    transport) demotes automatically — and a raising probe counts as
+    unhealthy rather than crashing admission."""
+    healthy = {"v": True}
+    e0 = _fresh_engine(model)
+    e1 = _fresh_engine(model)
+    router = ReplicaRouter([
+        Replica(e0, "probed", health_fn=lambda: healthy["v"]),
+        Replica(e1, "other")])
+    h0 = router.submit([1, 2, 3, 4], max_new_tokens=2)
+    healthy["v"] = False
+    h1 = router.submit([1, 2, 3, 4], max_new_tokens=2)
+    assert router.placement(h1)[0] == "other"
+    router.replicas[0].health_fn = lambda: 1 / 0
+    h2 = router.submit([1, 2, 3, 4], max_new_tokens=2)
+    assert router.placement(h2)[0] == "other"
+    router.run_to_completion()
+
+
+def test_deadline_eviction_requeues_on_another_replica(model):
+    """The satellite contract: _evict_expired surfaces the evicted
+    request through requeue_hook; the router retries it on a different
+    replica and the handle follows."""
+    e0 = _fresh_engine(model)
+    e1 = _fresh_engine(model)
+    router = ReplicaRouter([Replica(e0, "a"), Replica(e1, "b")])
+    rq0 = _cval("serving/requeues")
+    # deadline already expired at the first sweep -> evicted immediately
+    h = router.submit(list(range(1, 10)), max_new_tokens=3,
+                      deadline_s=0.0)
+    assert router.placement(h)[0] == "a"        # both idle: stable sort
+    import time as _t
+
+    _t.sleep(0.01)
+    out = router.run_to_completion()
+    assert _cval("serving/requeues") >= rq0 + 1
+    assert router.placement(h)[0] == "b"        # followed the requeue
+    assert len(out[h]) == 3                     # served to completion
+    assert router.timed_out() == []
+
+
+def test_requeue_hook_direct(model):
+    """Engine-level contract without a router: the hook receives the
+    full retry payload."""
+    eng = _fresh_engine(model)
+    seen = []
+    eng.requeue_hook = seen.append
+    rid = eng.add_request(list(range(1, 8)), max_new_tokens=2,
+                          deadline_s=0.0)
+    import time as _t
+
+    _t.sleep(0.01)
+    eng.step()
+    assert len(seen) == 1
+    info = seen[0]
+    assert info["rid"] == rid and info["prompt"] == list(range(1, 8))
+    assert info["max_new"] == 2 and info["timed_out"]
+    assert eng._requests[rid].timed_out
+
+
+# ---------------------------------------------------------------------------
+# int8 double-buffered weight streaming
+# ---------------------------------------------------------------------------
+
+def test_weight_stream_prefetch_parity(model):
+    """Double buffering is a SCHEDULING change: prefetched and
+    at-use dequant produce bitwise-identical generations."""
+    rng = np.random.RandomState(21)
+    prompts = [list(rng.randint(1, 97, n)) for n in (7, 12)]
+    sp = SamplingParams(temperature=0.9, top_k=20, top_p=0.9)
+    outs = []
+    for mode in ("int8", "int8-noprefetch"):
+        eng = _fresh_engine(model, seed=4, _weight_stream=mode)
+        rids = [eng.add_request(p, max_new_tokens=5, sampling=sp)
+                for p in prompts]
+        res = eng.run_to_completion()
+        outs.append([res[r] for r in rids])
+    assert outs[0] == outs[1]
+
+
+def test_weight_stream_matches_dequantized_reference(model):
+    """A streaming engine reproduces a PLAIN engine whose weights were
+    replaced by the dequantized int8 values — the streamed matmuls are
+    the same numbers, just double-buffered."""
+    from paddle_tpu.inference.weight_stream import (STREAM_KINDS,
+                                                    dequantize,
+                                                    quantize_per_channel)
+
+    rng = np.random.RandomState(22)
+    prompt = list(rng.randint(1, 97, 10))
+    eng = _fresh_engine(model, seed=0, _weight_stream="int8")
+    rid = eng.add_request(prompt, max_new_tokens=6)
+    got = eng.run_to_completion()[rid]
+
+    # reference: clone dims, load dequantized weights
+    paddle.seed(3)
+    ref_model = PagedCausalLM(PagedServingConfig(**BASE))
+    ref_model.eval()
+    ref_model.set_state_dict(model.state_dict())
+    import jax.numpy as jnp
+
+    for kind in STREAM_KINDS:
+        stack = getattr(ref_model, kind)
+        for li in range(ref_model.cfg.num_layers):
+            w = stack[li].weight
+            q, s = quantize_per_channel(np.asarray(w.numpy(), np.float32))
+            w.set_value(np.asarray(dequantize(q, s, jnp.float32)))
+    ref_eng = _fresh_engine(ref_model, seed=0)
+    rr = ref_eng.add_request(prompt, max_new_tokens=6)
+    ref = ref_eng.run_to_completion()[rr]
+    assert got == ref
+
+
+def test_weight_stream_decode_window_and_win_metric(model):
+    """decode_run works over the streamed weights, and the micro-bench
+    helper records the (honest, possibly negative) prefetch win."""
+    from paddle_tpu.inference.weight_stream import measure_stream_win
+
+    rng = np.random.RandomState(23)
+    eng = _fresh_engine(model, seed=0, _weight_stream="int8")
+    for n in (6, 9):
+        eng.add_request(list(rng.randint(1, 97, n)), max_new_tokens=8)
+    while any(r.length - r.cached > 1 for r in eng.pending()):
+        eng.step()
+    produced = eng.decode_run(8)
+    assert len(produced) >= 8
+
+    h0 = _metrics.histogram("weights/stream_prefetch_ms").count
+    win_ms, t_s, t_b = measure_stream_win(
+        lambda: 1 + 1, lambda: 2 + 2, repeats=2, sync=lambda x: x)
+    assert _metrics.histogram("weights/stream_prefetch_ms").count == h0 + 1
+    assert t_s >= 0 and t_b >= 0
+
+
+def test_weight_stream_quantize_roundtrip():
+    from paddle_tpu.inference.weight_stream import quantize_per_channel
+
+    rng = np.random.RandomState(5)
+    w = rng.randn(32, 16).astype(np.float32)
+    q, s = quantize_per_channel(w)
+    assert q.dtype == np.int8 and s.shape == (16,)
+    err = np.abs(q.astype(np.float32) * s - w).max()
+    assert err <= np.abs(w).max() / 127.0 + 1e-6      # half-ULP of scale
+    # zero column stays representable
+    w[:, 3] = 0
+    q, s = quantize_per_channel(w)
+    assert np.all(q[:, 3] == 0) and s[3] == 1.0

@@ -10,6 +10,9 @@ signal regressed:
   (default 5%),
 - serving ``ttft_s_p50`` / ``ttft_s_p95`` / ``tpot_ms_min`` rising more
   than the threshold on any decode batch present in both runs,
+- fleet serving ``requests_per_sec`` or ``prefix_hit_rate`` dropping
+  more than the threshold, or ``ttft_mean_s`` rising more than it
+  (the shared-prefix wave of bench.py's ``fleet`` gate row),
 - the candidate missing the flagship metric entirely (a timed-out
   flagship row must fail the gate, not silently pass it — the r05
   failure mode).
@@ -115,6 +118,20 @@ def _serving_metrics(result):
     return out
 
 
+# fleet row signals: value is True when HIGHER is better (a drop fails),
+# False for latencies (a rise fails)
+_FLEET_GATES = {"requests_per_sec": True, "prefix_hit_rate": True,
+                "ttft_mean_s": False}
+
+
+def _fleet_metrics(result):
+    """{metric: value} for the gated fleet-serving signals."""
+    fleet = ((result.get("extra") or {}).get("fleet") or {}).get("fleet") \
+        or {}
+    return {m: float(fleet[m]) for m in _FLEET_GATES
+            if isinstance(fleet.get(m), (int, float))}
+
+
 def compare(candidate, baseline, threshold=0.05):
     """Returns (failures, report_lines). A failure is a formatted
     string; an empty list means the gate passes."""
@@ -149,6 +166,27 @@ def compare(candidate, baseline, threshold=0.05):
         if rise > threshold:
             failures.append(
                 f"{key[0]}.{key[1]} rose {rise * 100:.1f}% "
+                f"(> {threshold * 100:.0f}%)")
+
+    cand_fl = _fleet_metrics(candidate)
+    base_fl = _fleet_metrics(baseline)
+    for m in sorted(set(cand_fl) & set(base_fl)):
+        b, c = base_fl[m], cand_fl[m]
+        if b <= 0:
+            continue
+        if _FLEET_GATES[m]:                # throughput/hit-rate: drop bad
+            delta = (b - c) / b
+            word = "dropped"
+        else:                              # latency: rise bad
+            delta = (c - b) / b
+            word = "rose"
+        verdict = "FAIL" if delta > threshold else "ok"
+        lines.append(f"fleet.{m}: {b:g} -> {c:g}  "
+                     f"({-delta * 100 if _FLEET_GATES[m] else delta * 100:+.1f}%) "
+                     f"[{verdict}]")
+        if delta > threshold:
+            failures.append(
+                f"fleet.{m} {word} {delta * 100:.1f}% "
                 f"(> {threshold * 100:.0f}%)")
     return failures, lines
 

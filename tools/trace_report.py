@@ -75,6 +75,15 @@ KNOWN_METRICS = (
     "serving/preemptions", "serving/batch_occupancy",
     "serving/kv_cache_utilization", "serving/deadline_evictions",
     "serving/load_shed",
+    # fleet serving tier: shared-prefix KV reuse (inference/
+    # prefix_cache.py), multi-replica routing (inference/router.py),
+    # disaggregated prefill/decode hand-offs (inference/disagg.py)
+    "serving/prefix_hit_rate", "serving/prefix_pages_reused",
+    "serving/reroutes", "serving/requeues", "serving/migrations",
+    # int8 double-buffered weight streaming (inference/weight_stream.py)
+    "weights/stream_prefetch_ms",
+    # Executor-tier auto_fuse fallback (static/__init__.py)
+    "compiler/executor_fuse_reverts",
     # IR-level program analyzer (paddle_tpu/analysis/program/)
     "analysis/programs_analyzed", "analysis/ops_analyzed",
     "analysis/findings", "analysis/peak_bytes",
