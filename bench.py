@@ -689,6 +689,90 @@ def bench_fleet_serving(on_tpu):
     }
 
 
+def bench_fleet_recovery(on_tpu):
+    """Fleet recovery gate row (ISSUE 9): two replicas behind the
+    router + fleet supervisor; PT_FAULT_PLAN kills one mid-decode.
+    Gate signals: every admitted request completes (drain migrates
+    decode-tip requests to the peer, requeues the rest) and how many
+    seconds the drain + backoff restart takes.  Bitwise parity vs an
+    uninterrupted reference run is recorded alongside."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.inference.fleet_supervisor import (
+        FleetSupervisor, FleetSupervisorConfig)
+    from paddle_tpu.inference.router import Replica, ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+
+    n_req, prompt_len, max_new = 8, 12, 6
+    cfg = PagedServingConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=4, max_blocks_per_seq=6, token_budget=32)
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    def factory(idx):
+        return ServingEngine.from_model(model, cfg, seed=10 + idx)
+
+    def build():
+        engines = [factory(i) for i in range(2)]
+        for i, e in enumerate(engines):
+            e.fault_rank = i
+        router = ReplicaRouter(
+            [Replica(e, name=f"r{i}", restore_after=2)
+             for i, e in enumerate(engines)])
+        sup = FleetSupervisor(router, engine_factory=factory,
+                              cfg=FleetSupervisorConfig(
+                                  backoff_base_s=0.005))
+        return router, sup
+
+    def drive(router):
+        hs = [router.submit(p, max_new_tokens=max_new, sampling=sp)
+              for p in prompts]
+        out = router.run_to_completion()
+        return {h: out[h] for h in hs}
+
+    faults.disarm()
+    router, _ = build()
+    ref = drive(router)                      # warm + reference streams
+
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = build()
+    recovery = {}
+    on_failure = sup.on_failure
+
+    def timed_failure(idx):
+        t0 = time.perf_counter()
+        on_failure(idx)
+        recovery["s"] = recovery.get("s", 0.0) \
+            + (time.perf_counter() - t0)
+    router.failure_hook = timed_failure
+    t0 = time.perf_counter()
+    out = drive(router)
+    total_s = time.perf_counter() - t0
+    faults.disarm()
+
+    completed = sum(1 for toks in out.values() if len(toks) == max_new)
+    return {"fleet_recovery": {
+        "n_requests": n_req, "max_new": max_new,
+        "requests_completed": completed,
+        "recovery_s": round(recovery.get("s", 0.0), 4),
+        "total_s": round(total_s, 4),
+        "replica_restarts": sum(sup.restarts),
+        "drained": len(sup.drained_handles),
+        "bitwise_match": out == ref,
+    }}
+
+
 def host_dispatch_bench(measure_us):
     """Host-path dispatch cost (tunnel-free), shared by bench.py and
     tools/op_bench.py: the same grad-recorded matmul+add dispatches
@@ -913,6 +997,7 @@ WORKLOADS = (
     ("llama13b_block", bench_llama13b_block, False),
     ("serving", bench_serving, True),
     ("fleet", bench_fleet_serving, True),
+    ("fleet_recovery", bench_fleet_recovery, True),
     ("second_order", bench_second_order, False),
 )
 
@@ -1070,6 +1155,14 @@ def update_readme_table(result):
             "req/s with prefix cache (vs without)",
             f"{fl['requests_per_sec']:.2f} "
             f"({fl.get('speedup_vs_nocache', '?')}x)"))
+    fr = x.get("fleet_recovery", {}).get("fleet_recovery", {})
+    if fr.get("requests_completed") is not None:
+        rows.append((
+            f"Fleet recovery ({fr.get('n_requests')} reqs, one replica "
+            f"killed mid-decode)",
+            "requests completed / recovery s",
+            f"{fr['requests_completed']}/{fr.get('n_requests')} / "
+            f"{fr.get('recovery_s', '?')}s"))
     wsr = x.get("fleet", {}).get("weight_stream", {})
     if wsr.get("step_ms_int8_stream_min") is not None:
         rows.append((

@@ -1,4 +1,4 @@
-"""PT5xx — error-surfacing rules for the distributed layer.
+"""PT5xx — error-surfacing rules for the resilience-bearing layers.
 
 The fault-tolerance contract (distributed/resilience/) is that failures
 surface as structured errors or at least as metric counts — never
@@ -8,10 +8,13 @@ store hiccup, torn frame) turns into an undebuggable hang three layers
 up: the recovery loop can only react to failures it can see.
 
 Scope: files under a ``distributed/`` directory (the subsystem where
-every swallowed error is a potential silent desync). Sites that are
-genuinely by-design (e.g. best-effort probes on a hot poll path) are
-grandfathered in ``.ptlint-baseline.json`` or suppressed in place with
-an explained ``# ptlint: disable=PT5xx``.
+every swallowed error is a potential silent desync) AND under
+``inference/`` — the serving fleet runs the same recovery loop
+(EngineDeadError -> drain -> restart, see inference/fleet_supervisor)
+and a swallowed error there silently strands in-flight requests.
+Sites that are genuinely by-design (e.g. best-effort probes on a hot
+poll path) are grandfathered in ``.ptlint-baseline.json`` or
+suppressed in place with an explained ``# ptlint: disable=PT5xx``.
 """
 from __future__ import annotations
 
@@ -21,9 +24,12 @@ from .engine import rule
 
 _BROAD = ("Exception", "BaseException")
 
+_SCOPED_DIRS = ("distributed/", "inference/")
+
 
 def _in_scope(mod) -> bool:
-    return "distributed/" in ("/" + mod.relpath)
+    path = "/" + mod.relpath
+    return any(d in path for d in _SCOPED_DIRS)
 
 
 def _is_broad(handler: ast.ExceptHandler) -> bool:
@@ -56,7 +62,8 @@ def _body_swallows(handler: ast.ExceptHandler) -> bool:
 
 
 @rule("PT501", "error",
-      "bare 'except:' in distributed/ — also traps SystemExit/"
+      "bare 'except:' in distributed//inference/ — also traps "
+      "SystemExit/"
       "KeyboardInterrupt, so a killed rank can't even die")
 def check_bare_except(mod):
     if not _in_scope(mod):
@@ -108,7 +115,8 @@ def _has_handler(loop) -> bool:
 
 
 @rule("PT503", "warning",
-      "constant time.sleep retry loop in distributed/ — use the "
+      "constant time.sleep retry loop in distributed//inference/ — "
+      "use the "
       "resilience.backoff helpers so retries back off exponentially")
 def check_constant_sleep_retry(mod):
     """A loop that catches errors and re-tries after a CONSTANT
@@ -135,7 +143,8 @@ def check_constant_sleep_retry(mod):
 
 
 @rule("PT502", "warning",
-      "'except Exception: pass' in distributed/ — the error must be "
+      "'except Exception: pass' in distributed//inference/ — the "
+      "error must be "
       "surfaced (raise/log) or counted (profiler metrics)")
 def check_swallowed_exception(mod):
     if not _in_scope(mod):

@@ -27,14 +27,16 @@ from __future__ import annotations
 from . import backoff
 from . import errors
 from . import faults
-from .errors import (CommTimeoutError, FrameCorruptError,
-                     PeerUnreachableError, TransportClosedError,
-                     TransportError, TransportTimeoutError)
+from .errors import (CommTimeoutError, EngineDeadError,
+                     FrameCorruptError, PeerUnreachableError,
+                     TransportClosedError, TransportError,
+                     TransportTimeoutError)
 from .faults import FaultAction, FaultInjector, FaultPlan, FaultRule
 
 __all__ = [
     "backoff", "errors", "faults", "recovery", "supervisor", "guards",
-    "CommTimeoutError", "FrameCorruptError", "PeerUnreachableError",
+    "CommTimeoutError", "EngineDeadError", "FrameCorruptError",
+    "PeerUnreachableError",
     "TransportClosedError", "TransportError", "TransportTimeoutError",
     "FaultAction", "FaultInjector", "FaultPlan", "FaultRule",
     "resume_from_latest", "save_checkpoint", "latest_checkpoint",

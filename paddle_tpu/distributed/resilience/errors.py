@@ -13,6 +13,7 @@ from typing import List, Optional
 __all__ = [
     "TransportError", "TransportClosedError", "TransportTimeoutError",
     "FrameCorruptError", "PeerUnreachableError", "CommTimeoutError",
+    "EngineDeadError",
 ]
 
 
@@ -67,6 +68,25 @@ class PeerUnreachableError(TransportError, ConnectionError):
         super().__init__(
             f"cannot reach rank {peer} at {addr} after {attempts} "
             f"dial attempts: {last_error!r}")
+
+
+class EngineDeadError(RuntimeError):
+    """A serving engine (replica) died mid-step: its scheduler loop is
+    gone and its in-flight requests need a new home. Raised by the
+    engine when a ``kill@prefill``/``kill@decode``/``kill@cache_save``
+    chaos fault fells it in-process (the single-host analog of a replica
+    process dying on a pod), and by any call into an engine whose
+    ``dead`` flag is already set. The fleet supervisor treats this as
+    the drain trigger: migrate the replica's in-flight requests to
+    healthy peers, then restart the engine under backoff."""
+
+    def __init__(self, name: str, site: Optional[str] = None):
+        self.replica = name
+        self.site = site
+        at = f" at {site} site" if site else ""
+        super().__init__(
+            f"serving engine {name} is dead{at}: drain its in-flight "
+            f"requests to a healthy replica and restart it")
 
 
 class CommTimeoutError(TransportError):

@@ -7,7 +7,14 @@ plus two training-loop sites: ``step`` (the elastic supervisor consults
 it at the top of every train step) and ``save`` (the distributed
 checkpoint consults it between writing shard files and publishing the
 manifest — a ``kill@save`` leaves exactly the torn checkpoint a real
-mid-save death leaves). A ``FaultPlan`` names which fault fires where —
+mid-save death leaves), plus four SERVING sites the fleet tier consults
+(``inference/``): ``prefill`` and ``decode`` (the engine, once per step
+that schedules a prefill chunk / a decode row), ``migrate`` (per
+in-flight KV hand-off in ``disagg.migrate_request``) and ``cache_save``
+(the prefix-cache snapshot, between writing the page data and
+publishing the manifest — a ``kill@cache_save`` leaves exactly the torn
+snapshot a real mid-save death leaves). A ``FaultPlan`` names which
+fault fires where —
 armed from the ``PT_FAULT_PLAN`` environment variable or
 programmatically — so the failure modes a TPU pod actually exhibits
 (dropped DCN connections, slow hosts, corrupted frames, killed ranks)
@@ -40,6 +47,15 @@ Optional filters: ``:rank=R`` (only this global rank injects) and
 
 At the ``step``/``save`` sites only ``kill`` and ``delay`` are
 meaningful; frame-level kinds (drop/dup/corrupt) are ignored there.
+At the serving engine sites (``prefill``/``decode``/``cache_save``)
+``kill`` fells the ENGINE, not the process: the engine sets its
+``dead`` flag and raises ``EngineDeadError`` — the in-process replica
+analog of a replica process dying, which the fleet supervisor answers
+by draining + restarting (``inference/fleet_supervisor.py``). At
+``migrate``, ``drop`` raises ``PeerUnreachableError`` (the dying
+engine cannot ship its KV pages — exercises the requeue fallback) and
+``kill`` again fells the source engine. Use ``:rank=R`` with the
+engine's ``fault_rank`` to target one replica of an in-process fleet.
 
 Every injected fault increments ``faults/injected`` and
 ``faults/<kind>`` in the metrics registry so a chaos run's report shows
@@ -65,7 +81,8 @@ __all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
            "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
 
 FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill")
-FAULT_SITES = ("send", "dial", "recv", "step", "save")
+FAULT_SITES = ("send", "dial", "recv", "step", "save",
+               "prefill", "decode", "migrate", "cache_save")
 
 
 @dataclass(frozen=True)

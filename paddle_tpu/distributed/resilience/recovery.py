@@ -31,10 +31,96 @@ from ...profiler import metrics as _metrics
 from ..checkpoint import load_state_dict, save_state_dict
 
 __all__ = ["save_checkpoint", "latest_checkpoint", "list_checkpoints",
-           "resume_from_latest", "sweep_incomplete", "CKPT_DIR_RE"]
+           "resume_from_latest", "sweep_incomplete", "CKPT_DIR_RE",
+           "publish_manifest", "read_manifest", "complete_dirs",
+           "sweep_torn_dirs", "MANIFEST_JSON"]
 
 CKPT_DIR_RE = re.compile(r"^step_(\d+)$")
 _MANIFEST = "0.metadata"
+
+# ---------------------------------------------------------------------------
+# generic manifest-is-completeness-marker helpers
+#
+# The step_<N> checkpoint pattern above, factored so other snapshot
+# families (the serving prefix-cache persistence in
+# inference/prefix_cache.py) can reuse it: write every data file first,
+# then publish a JSON manifest atomically (tmp+rename) — a directory
+# whose manifest is missing is torn by definition and gets swept.
+# ---------------------------------------------------------------------------
+
+MANIFEST_JSON = "MANIFEST.json"
+
+
+def publish_manifest(path: str, payload: Dict) -> str:
+    """Atomically publish `payload` as ``MANIFEST.json`` inside `path`.
+    Written via tmp+rename so the manifest either exists complete or not
+    at all — its presence IS the snapshot's completeness marker. Call it
+    LAST, after every data file has landed."""
+    import json
+
+    tmp = os.path.join(path, MANIFEST_JSON + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    final = os.path.join(path, MANIFEST_JSON)
+    os.replace(tmp, final)
+    return final
+
+
+def read_manifest(path: str) -> Optional[Dict]:
+    """The published manifest of snapshot dir `path`, or None when the
+    snapshot is torn (no manifest) or unreadable/corrupt."""
+    import json
+
+    try:
+        with open(os.path.join(path, MANIFEST_JSON)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def complete_dirs(root: str, pattern: "re.Pattern") -> List[Tuple[int, str]]:
+    """All COMPLETE snapshot dirs under `root` whose name matches
+    `pattern` (one integer group = sequence number), as (seq, path)
+    ascending. Complete iff the JSON manifest exists."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        m = pattern.match(name)
+        if not m:
+            continue
+        path = os.path.join(root, name)
+        if os.path.isfile(os.path.join(path, MANIFEST_JSON)):
+            out.append((int(m.group(1)), path))
+    out.sort()
+    return out
+
+
+def sweep_torn_dirs(root: str, pattern: "re.Pattern",
+                    metric: str = "ckpt/swept_incomplete",
+                    skip: Optional[str] = None) -> List[str]:
+    """Delete torn snapshot dirs (name matches, no manifest) under
+    `root`; returns the removed paths. Same caveat as
+    ``sweep_incomplete``: never run concurrently with an in-flight save
+    (pass its path as `skip`)."""
+    removed = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return removed
+    complete = {p for _, p in complete_dirs(root, pattern)}
+    for name in names:
+        cand = os.path.join(root, name)
+        if pattern.match(name) and os.path.isdir(cand) \
+                and cand not in complete and cand != skip:
+            shutil.rmtree(cand, ignore_errors=True)
+            removed.append(cand)
+            _metrics.inc(metric)
+    return removed
 
 
 def _step_dir(root: str, step: int) -> str:

@@ -34,6 +34,9 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from ..distributed.resilience import faults as _faults
+from ..distributed.resilience.errors import (EngineDeadError,
+                                             PeerUnreachableError)
 from ..profiler import metrics as _metrics
 from .serving import SamplingParams, ServingEngine, _Request
 
@@ -59,6 +62,25 @@ def migrate_request(engine: ServingEngine, rid: int, transport,
             f"request {rid} is not at its decode tip "
             f"(cached={r.cached}, length={r.length}): finish prefill "
             f"before migrating")
+    # chaos site, consulted BEFORE the first frame ships so a failure
+    # here never leaves a half-sent hand-off on the wire: ``drop`` means
+    # the dying engine cannot ship its pages (PeerUnreachableError — the
+    # supervisor falls back to requeue), ``kill`` fells the source
+    # engine itself
+    act = _faults.injector.on_event("migrate",
+                                    getattr(engine, "fault_rank", 0),
+                                    peer=dst)
+    if act is not None:
+        if act.kind == "drop":
+            raise PeerUnreachableError(dst, None, 1)
+        if act.kind == "kill":
+            engine.dead = True
+            raise EngineDeadError(getattr(engine, "name", "engine"),
+                                  "migrate")
+        if act.kind == "delay":
+            import time as _time
+
+            _time.sleep(act.delay_ms / 1e3)
     pages = np.asarray(r.pages, np.int32)
     sp = r.sampling
     meta = {

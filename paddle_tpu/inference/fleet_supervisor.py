@@ -1,0 +1,307 @@
+"""Self-healing serving fleet: drain, restart, and re-admit replicas.
+
+The serving analog of ``distributed/resilience/supervisor.py``'s
+elastic training loop.  The training supervisor answers a killed RANK
+with re-form + snapshot restore; the fleet supervisor answers a killed
+REPLICA (an engine that raised ``EngineDeadError`` — chaos
+``kill@prefill``/``kill@decode``/``kill@cache_save``, or a real crash
+surfaced the same way) with a three-step recovery:
+
+1. **Drain**: every in-flight request on the dead replica moves to a
+   healthy peer.  Requests at their decode tip migrate VERBATIM over
+   the existing ``disagg.migrate_request`` KV hand-off (an in-process
+   ``LoopbackTransport`` carries the frames between co-hosted engines;
+   cross-host fleets pass a real ``TensorTransport``), so the peer
+   resumes mid-generation without re-prefilling.  Requests the dying
+   engine cannot ship — mid-prefill, or the hand-off itself fails
+   (``drop@migrate`` -> ``PeerUnreachableError``) — fall back to a
+   REQUEUE on a peer that re-decodes from the prompt.
+2. **Identity**: both paths preserve the request's ORIGIN sampling-salt
+   identity (``salt_seed``/``salt_rid``), and ownership is single at
+   every instant (the source request finishes before the peer copy
+   runs), so a drained request is never decoded twice and its final
+   token stream is BITWISE-identical to an uninterrupted run —
+   migration resumes the exact stream, and a requeued request
+   deterministically regenerates the same tokens from the prompt.
+3. **Restart**: the replica's engine is rebuilt through the caller's
+   factory under bounded exponential backoff (``resilience/backoff``),
+   inherits the dead engine's finished results and rid namespace (the
+   router's handles stay valid), restores its prefix cache from the
+   newest complete snapshot (``cfg.prefix_snapshot_root``), and rejoins
+   rotation through the router's half-open probes
+   (``Replica.probe`` — ``serving/replica_restored``).
+
+Wire-up::
+
+    router = ReplicaRouter([eng_a, eng_b])
+    sup = FleetSupervisor(router, engine_factory=make_engine)
+    ...
+    router.run_to_completion()     # deaths drain+restart transparently
+
+The supervisor installs itself as the router's ``failure_hook`` (fires
+the moment ``step_all`` catches a dead engine) and ``pump()`` is the
+poll-style equivalent for deaths that happen outside a router step
+(e.g. during a cache snapshot).  ``snapshot_caches()`` runs the
+periodic prefix-cache persistence pass for every replica configured
+with a snapshot root.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..distributed.resilience import backoff as _backoff
+from ..distributed.resilience.errors import (EngineDeadError,
+                                             PeerUnreachableError,
+                                             TransportClosedError,
+                                             TransportError)
+from ..profiler import metrics as _metrics
+from .router import ReplicaRouter
+from .serving import EngineOverloadedError, ServingEngine
+
+__all__ = ["FleetSupervisor", "FleetSupervisorConfig",
+           "LoopbackTransport"]
+
+_m_restarts = _metrics.counter("serving/replica_restarts")
+_m_drains = _metrics.counter("serving/drains")
+_m_drain_requeues = _metrics.counter("serving/drain_requeues")
+
+
+class LoopbackTransport:
+    """In-process stand-in for ``TensorTransport`` between co-hosted
+    engines: same ``send(arr, dst, channel)`` / ``recv(src, channel)``
+    surface, frames carried through a FIFO per channel.  One instance
+    per hand-off, so an aborted migration can never leave stale frames
+    for the next one."""
+
+    def __init__(self):
+        self._q: Dict[str, deque] = {}
+
+    def send(self, arr, dst: int, channel: str = "") -> None:
+        self._q.setdefault(channel, deque()).append(
+            np.array(arr, copy=True))
+
+    def recv(self, src: int, channel: str = ""):
+        q = self._q.get(channel)
+        if not q:
+            raise TransportClosedError(
+                f"loopback channel {channel!r} has no pending frame")
+        return q.popleft()
+
+
+@dataclass
+class FleetSupervisorConfig:
+    """Knobs for the drain + restart loop.
+
+    ``max_restarts`` bounds restarts PER REPLICA (a crash-looping
+    replica eventually stays demoted rather than flapping);
+    ``backoff_base_s``/``backoff_cap_s`` shape the bounded exponential
+    restart delay; ``migrate=False`` forces the requeue-only drain
+    (operationally: the fleet has no KV hand-off path);
+    ``snapshot_keep`` is the retention for ``snapshot_caches``."""
+
+    max_restarts: int = 3
+    backoff_base_s: float = 0.02
+    backoff_cap_s: float = 2.0
+    migrate: bool = True
+    restart: bool = True
+    snapshot_keep: int = 2
+
+
+class FleetSupervisor:
+    """Watches a ``ReplicaRouter``'s replicas and self-heals engine
+    death: drain in-flight requests to healthy peers, restart the dead
+    engine under backoff, let half-open probes re-admit it."""
+
+    def __init__(self, router: ReplicaRouter,
+                 engine_factory: Callable[[int], ServingEngine],
+                 cfg: Optional[FleetSupervisorConfig] = None):
+        self.router = router
+        self.engine_factory = engine_factory
+        self.cfg = cfg or FleetSupervisorConfig()
+        self.restarts: List[int] = [0] * len(router.replicas)
+        # handles drained (migrated or requeued) across this
+        # supervisor's lifetime — the observable idempotency record
+        self.drained_handles: set = set()
+        router.failure_hook = self.on_failure
+
+    # -- failure entry points --------------------------------------------
+    def on_failure(self, idx: int) -> None:
+        """Full recovery for replica ``idx``: drain, then restart."""
+        self.drain(idx)
+        if self.cfg.restart:
+            self.restart(idx)
+
+    def pump(self) -> List[int]:
+        """One supervision pass outside the router's step loop: recover
+        replicas whose engine died elsewhere (e.g. mid-snapshot) and
+        probe demoted ones.  Returns the indices recovered."""
+        recovered = []
+        for idx, rep in enumerate(self.router.replicas):
+            if getattr(rep.engine, "dead", False):
+                rep.mark_unhealthy()
+                self.on_failure(idx)
+                recovered.append(idx)
+            elif rep._demoted:
+                rep.probe()
+        return recovered
+
+    # -- drain ------------------------------------------------------------
+    def _capacity(self, engine: ServingEngine) -> int:
+        cap = len(engine._free_pages)
+        if engine._prefix_cache is not None:
+            cap += engine._prefix_cache.evictable_count()
+        return cap
+
+    def _remap(self, handle: Optional[int], src_idx: int, src_rid: int,
+               dst_idx: int, dst_rid: int) -> None:
+        if handle is None:
+            return
+        self.router._by_engine.pop((src_idx, src_rid), None)
+        self.router._handles[handle] = (dst_idx, dst_rid)
+        self.router._by_engine[(dst_idx, dst_rid)] = handle
+        self.drained_handles.add(handle)
+
+    def _migrate_one(self, src_idx: int, rid: int,
+                     targets: List[int]) -> bool:
+        """Ship one decode-tip request's KV pages to the least-loaded
+        peer with pool room.  True on success (handle remapped)."""
+        from . import disagg
+
+        src = self.router.replicas[src_idx].engine
+        r = src._requests[rid]
+        for dst_idx in targets:
+            dst = self.router.replicas[dst_idx].engine
+            if self._capacity(dst) < len(r.pages):
+                continue
+            tp = LoopbackTransport()
+            try:
+                disagg.migrate_request(src, rid, tp, dst=1)
+            except (PeerUnreachableError, EngineDeadError):
+                # the dying engine cannot ship its pages at all (the
+                # drop@migrate failure mode): no peer will do better
+                return False
+            new_rid = disagg.receive_request(dst, tp, src=0)
+            h = self.router._by_engine.get((src_idx, rid))
+            self._remap(h, src_idx, rid, dst_idx, new_rid)
+            _m_drains.inc()
+            return True
+        return False
+
+    def _requeue_one(self, src_idx: int, rid: int,
+                     targets: List[int]) -> bool:
+        """Fallback drain: re-admit the request's PROMPT on a peer under
+        its origin salt identity.  Sampling salts depend only on (seed,
+        rid, token index), so the peer deterministically regenerates the
+        same stream the dead engine was producing — token-bitwise equal
+        to an uninterrupted run, just re-paying the prefill."""
+        src = self.router.replicas[src_idx].engine
+        r = src._requests[rid]
+        origin_seed = src.seed if r.salt_seed is None else r.salt_seed
+        for dst_idx in targets:
+            dst = self.router.replicas[dst_idx].engine
+            try:
+                new_rid = dst.add_request(
+                    list(r.prompt), max_new_tokens=r.max_new,
+                    sampling=r.sampling, eos_token_id=r.eos_token_id)
+            except (EngineOverloadedError, EngineDeadError):
+                continue
+            req = dst._requests[new_rid]
+            req.salt_rid = r.salt_rid
+            req.salt_seed = int(origin_seed)
+            h = self.router._by_engine.get((src_idx, rid))
+            self._remap(h, src_idx, rid, dst_idx, new_rid)
+            # single ownership: the source copy finishes NOW, before the
+            # peer copy takes a step — never decoded twice
+            r.done = True
+            src._release(r)
+            _m_drain_requeues.inc()
+            return True
+        return False
+
+    def drain(self, idx: int) -> int:
+        """Move every in-flight request off replica ``idx``: KV
+        migration for decode-tip requests, requeue for the rest (and
+        for hand-offs the dying engine fails to ship).  Returns how
+        many requests found a new home."""
+        src = self.router.replicas[idx].engine
+        targets = self.router._ordered(exclude=idx)
+        moved = 0
+        for rid, r in list(src._requests.items()):
+            if r.done or r.timed_out:
+                continue       # finished/evicted before death: nothing live
+            migrated = False
+            if self.cfg.migrate and targets \
+                    and r.length - r.cached == 1:
+                try:
+                    migrated = self._migrate_one(idx, rid, targets)
+                except (TransportError, ValueError):
+                    migrated = False
+            if not migrated and targets:
+                migrated = self._requeue_one(idx, rid, targets)
+            if migrated:
+                moved += 1
+            # else: no healthy peer with room — the request stays on the
+            # dead engine and results() reports it honestly as stuck
+        return moved
+
+    # -- restart ----------------------------------------------------------
+    def restart(self, idx: int) -> bool:
+        """Rebuild replica ``idx``'s engine under bounded exponential
+        backoff.  The new engine inherits the dead one's name/rank,
+        finished results, and rid namespace (router handles stay
+        valid); with a snapshot root configured it restores its prefix
+        cache during construction.  The replica stays demoted until the
+        half-open probes pass.  False once ``max_restarts`` is spent —
+        the replica is left out of rotation for good."""
+        if self.restarts[idx] >= self.cfg.max_restarts:
+            return False
+        rep = self.router.replicas[idx]
+        old = rep.engine
+        time.sleep(_backoff.delay(self.restarts[idx],
+                                  base=self.cfg.backoff_base_s,
+                                  cap=self.cfg.backoff_cap_s))
+        self.restarts[idx] += 1
+        new = self.engine_factory(idx)
+        new.name = getattr(old, "name", new.name)
+        new.fault_rank = getattr(old, "fault_rank", 0)
+        # rid continuity: finished requests keep answering results(),
+        # and fresh rids never collide with handles minted pre-death
+        new._next_rid = max(new._next_rid, old._next_rid)
+        for rid, r in old._requests.items():
+            if r.done and rid not in new._requests:
+                new._requests[rid] = r
+        new.requeue_hook = self.router._make_requeue_hook(idx)
+        rep.engine = new
+        _m_restarts.inc()
+        return True
+
+    # -- cache persistence cadence ----------------------------------------
+    def snapshot_caches(self, root_override: Optional[str] = None):
+        """Persist every replica's prefix cache (those with a snapshot
+        root configured, or all under ``root_override``).  Returns
+        {replica name: snapshot path} for the snapshots written.  A
+        replica felled mid-snapshot (``kill@cache_save``) is recovered
+        like any other death — the torn directory is swept at its next
+        restore."""
+        out = {}
+        for idx, rep in enumerate(self.router.replicas):
+            eng = rep.engine
+            root = root_override or eng.cfg.prefix_snapshot_root
+            if eng._prefix_cache is None or not root \
+                    or getattr(eng, "dead", False):
+                continue
+            try:
+                path = eng.save_prefix_cache(
+                    root=root, keep=self.cfg.snapshot_keep)
+            except EngineDeadError:
+                rep.mark_unhealthy()
+                self.on_failure(idx)
+                continue
+            if path is not None:
+                out[rep.name] = path
+        return out

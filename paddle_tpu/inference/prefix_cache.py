@@ -30,19 +30,43 @@ from the request's private allocation to the cache); live requests
 co-own via refcounts and the engine reclaims zero-ref pages through
 ``evict`` when the free pool runs dry — cache residency is a *use* of
 free HBM, never a reservation against live traffic.
+
+Persistence (``save_snapshot`` / ``restore_snapshot``): the trie plus
+its cache-owned KV pages snapshot to ``cache_<seq>`` directories under
+a root, through the same atomic manifest-is-completeness-marker
+pattern as ``resilience/recovery.py`` checkpoints — page data lands
+first (``pages.npz``), the JSON manifest last via tmp+rename, so an
+engine killed mid-save (``kill@cache_save``) leaves a torn directory
+that restore ignores and the startup sweep deletes.  A restarted
+replica restores the newest complete snapshot at engine start and
+serves shared-prefix hits without re-running the shared prefill
+(``serving/cache_restore_ms``, ``serving/prefix_hits_restored``).
 """
 from __future__ import annotations
 
 import hashlib
+import os
+import re
+import shutil
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+from ..profiler import metrics as _metrics
+
+__all__ = ["PrefixCache", "save_snapshot", "restore_snapshot",
+           "sweep_snapshots", "latest_snapshot", "CACHE_DIR_RE"]
+
+CACHE_DIR_RE = re.compile(r"^cache_(\d+)$")
+
+_m_hits_restored = _metrics.counter("serving/prefix_hits_restored")
+_m_restore_ms = _metrics.histogram("serving/cache_restore_ms")
+_m_snapshots = _metrics.counter("serving/cache_snapshots")
 
 
 class _Node:
-    __slots__ = ("page", "refs", "lru", "parent", "children")
+    __slots__ = ("page", "refs", "lru", "parent", "children", "restored")
 
     def __init__(self, page: int, parent: Optional[bytes], lru: int):
         self.page = page
@@ -50,6 +74,7 @@ class _Node:
         self.lru = lru
         self.parent = parent
         self.children = 0
+        self.restored = False  # re-materialized from a disk snapshot
 
 
 class PrefixCache:
@@ -96,6 +121,10 @@ class PrefixCache:
             node.refs += 1
             self._tick += 1
             node.lru = self._tick
+            if node.restored:
+                # this block's prefill was saved by a PREVIOUS engine
+                # incarnation — the restart paid zero re-prefill for it
+                _m_hits_restored.inc()
             held.append(k)
             pages.append(node.page)
         if held:
@@ -181,3 +210,207 @@ class PrefixCache:
 
     def __len__(self) -> int:
         return len(self._nodes)
+
+
+# ---------------------------------------------------------------------------
+# snapshot persistence: cache_<seq>/pages.npz + MANIFEST.json (atomic,
+# manifest last — recovery.py's completeness-marker pattern)
+# ---------------------------------------------------------------------------
+
+def _topo_nodes(cache: PrefixCache):
+    """Trie nodes ordered parent-before-child, so any PREFIX of the
+    order is itself a consistent trie (restore can stop early when the
+    target pool runs out of pages and still leave every resident node
+    reachable from the root)."""
+    order = []
+    placed = set()
+    pending = dict(cache._nodes)
+    while pending:
+        progressed = False
+        for k in list(pending):
+            node = pending[k]
+            if node.parent is None or node.parent in placed:
+                order.append((k, node))
+                placed.add(k)
+                del pending[k]
+                progressed = True
+        if not progressed:
+            break              # orphaned chain fragment: not snapshotted
+    return order
+
+
+def _savable(a: np.ndarray) -> np.ndarray:
+    """npz-safe view of a KV slab: int8/f32 pass through, bf16 widens to
+    float32 (exact — restore casts back to the engine's cache dtype)."""
+    a = np.asarray(a)
+    if a.dtype in (np.int8, np.float32):
+        return a
+    return a.astype(np.float32)
+
+
+def sweep_snapshots(root: str, skip: Optional[str] = None) -> List[str]:
+    """Startup sweep: delete torn ``cache_<seq>`` dirs (no manifest — a
+    writer died mid-save) under `root`; returns the removed paths."""
+    from ..distributed.resilience import recovery as _rec
+
+    return _rec.sweep_torn_dirs(root, CACHE_DIR_RE,
+                                metric="serving/cache_snapshots_swept",
+                                skip=skip)
+
+
+def latest_snapshot(root: str) -> Optional[Tuple[int, str]]:
+    """(seq, path) of the newest COMPLETE snapshot under `root`, or
+    None.  Torn directories never qualify: completeness is the
+    manifest's existence."""
+    from ..distributed.resilience import recovery as _rec
+
+    found = _rec.complete_dirs(root, CACHE_DIR_RE)
+    return found[-1] if found else None
+
+
+def save_snapshot(engine, root: str,
+                  keep: Optional[int] = None) -> Optional[str]:
+    """Snapshot `engine`'s prefix cache (trie + cache-owned KV pages)
+    into a new ``cache_<seq>`` dir under `root`.  Page data is written
+    first; the manifest publishes LAST and atomically, so a death at
+    the ``cache_save`` fault site (or a real one) leaves a torn dir the
+    next restore ignores and sweeps.  With `keep`, prunes complete
+    snapshots beyond the newest `keep`.  Returns the snapshot path, or
+    None when the cache is empty/absent (nothing to persist)."""
+    from ..distributed.resilience import faults as _faults
+    from ..distributed.resilience import recovery as _rec
+    from ..distributed.resilience.errors import EngineDeadError
+
+    cache = engine._prefix_cache
+    if cache is None:
+        return None
+    order = _topo_nodes(cache)
+    if not order:
+        return None
+    os.makedirs(root, exist_ok=True)
+    existing = _rec.complete_dirs(root, CACHE_DIR_RE)
+    seq = existing[-1][0] + 1 if existing else 0
+    path = os.path.join(root, f"cache_{seq:08d}")
+    os.makedirs(path, exist_ok=True)
+
+    pages = np.asarray([node.page for _, node in order], np.int32)
+    quant = engine._ks is not None
+    slabs = {"kc": _savable(engine._kc[:, pages]),
+             "vc": _savable(engine._vc[:, pages])}
+    if quant:
+        slabs["ks"] = np.asarray(engine._ks[:, pages])
+        slabs["vs"] = np.asarray(engine._vs[:, pages])
+    np.savez(os.path.join(path, "pages.npz"), **slabs)
+
+    # chaos site: a kill here is a death AFTER the page data landed but
+    # BEFORE the manifest — exactly the torn snapshot the sweep exists
+    # for.  The engine (not the process) dies, per the serving-site
+    # contract in resilience/faults.py.
+    act = _faults.injector.on_event("cache_save",
+                                    getattr(engine, "fault_rank", 0))
+    if act is not None:
+        if act.kind == "kill":
+            engine.dead = True
+            raise EngineDeadError(getattr(engine, "name", "engine"),
+                                  "cache_save")
+        if act.kind == "delay":
+            time.sleep(act.delay_ms / 1e3)
+
+    key_index = {k: i for i, (k, _) in enumerate(order)}
+    _rec.publish_manifest(path, {
+        "kind": "prefix_cache",
+        "seq": seq,
+        "block_size": int(cache.block_size),
+        "quant": bool(quant),
+        "n_pages": int(pages.size),
+        "nodes": [{"key": k.hex(),
+                   "parent": (node.parent.hex()
+                              if node.parent is not None else None),
+                   "slab": key_index[k]}
+                  for k, node in order],
+    })
+    _m_snapshots.inc()
+    if keep is not None and keep > 0:
+        for _, old in _rec.complete_dirs(root, CACHE_DIR_RE)[:-keep]:
+            if old != path:
+                shutil.rmtree(old, ignore_errors=True)
+                _metrics.inc("serving/cache_snapshots_pruned")
+    return path
+
+
+def restore_snapshot(engine, root: str, sweep: bool = True) -> int:
+    """Restore `engine`'s prefix cache from the newest complete snapshot
+    under `root`: allocate pool pages, scatter the saved KV into the
+    engine's cache pools, and rebuild the trie with zero-ref RESTORED
+    nodes (hits on them count ``serving/prefix_hits_restored``).
+    Returns the number of blocks restored (0: no/unusable snapshot —
+    torn ones are ignored and, with `sweep`, deleted).  Restoration
+    stops early, consistently, if the free pool cannot hold every saved
+    page; it never evicts to make room."""
+    cache = getattr(engine, "_prefix_cache", None)
+    if cache is None or not root:
+        return 0
+    t0 = time.perf_counter()
+    if sweep:
+        sweep_snapshots(root)
+    found = latest_snapshot(root)
+    if found is None:
+        return 0
+    from ..distributed.resilience import recovery as _rec
+
+    _, path = found
+    man = _rec.read_manifest(path)
+    if man is None or man.get("kind") != "prefix_cache":
+        return 0
+    quant = engine._ks is not None
+    if int(man["block_size"]) != cache.block_size \
+            or bool(man["quant"]) != quant:
+        return 0               # engine config changed; snapshot unusable
+    try:
+        data = np.load(os.path.join(path, "pages.npz"))
+    except (OSError, ValueError):
+        return 0
+
+    alloc = []                 # (record, pool page)
+    seen = set(cache._nodes)
+    for rec in man["nodes"]:
+        key = bytes.fromhex(rec["key"])
+        parent = rec["parent"]
+        if key in seen:
+            continue           # already resident (warm restart)
+        if parent is not None and bytes.fromhex(parent) not in seen:
+            continue           # parent not restored: child unreachable
+        if not engine._free_pages:
+            break              # pool full: partial prefix restore
+        alloc.append((rec, engine._free_pages.pop()))
+        seen.add(key)
+    if not alloc:
+        return 0
+
+    import jax.numpy as jnp
+
+    idx = jnp.asarray([p for _, p in alloc], jnp.int32)
+    slab = [int(rec["slab"]) for rec, _ in alloc]
+    engine._kc = engine._kc.at[:, idx].set(
+        jnp.asarray(data["kc"][:, slab], engine._cache_dt))
+    engine._vc = engine._vc.at[:, idx].set(
+        jnp.asarray(data["vc"][:, slab], engine._cache_dt))
+    if quant:
+        engine._ks = engine._ks.at[:, idx].set(
+            jnp.asarray(data["ks"][:, slab]))
+        engine._vs = engine._vs.at[:, idx].set(
+            jnp.asarray(data["vs"][:, slab]))
+
+    for rec, page in alloc:
+        key = bytes.fromhex(rec["key"])
+        parent = bytes.fromhex(rec["parent"]) if rec["parent"] else None
+        cache._tick += 1
+        node = _Node(int(page), parent, cache._tick)
+        node.refs = 0          # no live request holds restored blocks
+        node.restored = True
+        cache._nodes[key] = node
+        cache._page_owner[int(page)] = key
+        if parent is not None and parent in cache._nodes:
+            cache._nodes[parent].children += 1
+    _m_restore_ms.observe((time.perf_counter() - t0) * 1e3)
+    return len(alloc)

@@ -12,6 +12,17 @@ host without dropping in-flight work elsewhere, and (4) installs each
 engine's ``requeue_hook`` so a deadline-evicted request is retried on
 another replica (``serving/requeues``) instead of dying with a 504.
 
+Demotion is a CIRCUIT BREAKER, not a death sentence: a demoted replica
+stops receiving admissions but keeps earning half-open recovery probes
+(``Replica.probe``, run by ``step_all`` and the fleet supervisor);
+``restore_after`` consecutive passing probes restore it to rotation
+(``serving/replica_restored``) — a replica that heals, or is restarted
+by ``inference/fleet_supervisor.py``, rejoins instead of staying out
+for the process lifetime.  A replica whose engine raises
+``EngineDeadError`` mid-step is demoted on the spot
+(``serving/replica_failures``) and surfaced through the router's
+``failure_hook`` so the supervisor can drain + restart it.
+
 This is the same decision loop a production LB runs off a metrics
 scrape, shrunk to process-local method calls: the scores read the
 exact values the ``serving/*`` gauges export.
@@ -28,6 +39,8 @@ __all__ = ["Replica", "ReplicaRouter", "transport_healthy",
 
 _m_reroutes = _metrics.counter("serving/reroutes")
 _m_requeues = _metrics.counter("serving/requeues")
+_m_restored = _metrics.counter("serving/replica_restored")
+_m_failures = _metrics.counter("serving/replica_failures")
 
 
 def transport_healthy(tp) -> bool:
@@ -53,17 +66,26 @@ class Replica:
     ``health_fn`` is any zero-arg predicate — compose it from
     ``transport_healthy`` / ``watchdog_healthy`` for real deployments;
     a probe that raises counts as unhealthy.  ``mark_unhealthy`` is the
-    manual demotion lever (ops taking a replica out of rotation)."""
+    manual demotion lever (ops taking a replica out of rotation).
+
+    Demotion is half-open: ``probe()`` (called by the router's
+    ``step_all`` and the fleet supervisor) re-evaluates a demoted
+    replica, and ``restore_after`` CONSECUTIVE passing probes restore
+    it to rotation (``serving/replica_restored``).  A dead engine
+    (``engine.dead``) always probes unhealthy until replaced."""
 
     def __init__(self, engine: ServingEngine, name: Optional[str] = None,
-                 health_fn: Optional[Callable[[], bool]] = None):
+                 health_fn: Optional[Callable[[], bool]] = None,
+                 restore_after: int = 3):
         self.engine = engine
         self.name = name or f"replica{id(engine) & 0xffff:04x}"
         self.health_fn = health_fn
+        self.restore_after = max(int(restore_after), 1)
         self._demoted = False
+        self._streak = 0       # consecutive passing half-open probes
 
-    def healthy(self) -> bool:
-        if self._demoted:
+    def _probe_raw(self) -> bool:
+        if getattr(self.engine, "dead", False):
             return False
         if self.health_fn is not None:
             try:
@@ -72,11 +94,35 @@ class Replica:
                 return False
         return True
 
+    def healthy(self) -> bool:
+        if self._demoted:
+            return False
+        return self._probe_raw()
+
+    def probe(self) -> bool:
+        """One health probe with half-open accounting: while demoted,
+        each passing probe extends the streak and ``restore_after`` in a
+        row restore the replica; any failing probe resets the streak."""
+        ok = self._probe_raw()
+        if not self._demoted:
+            return ok
+        if ok:
+            self._streak += 1
+            if self._streak >= self.restore_after:
+                self._demoted = False
+                self._streak = 0
+                _m_restored.inc()
+        else:
+            self._streak = 0
+        return ok
+
     def mark_unhealthy(self):
         self._demoted = True
+        self._streak = 0
 
     def mark_healthy(self):
         self._demoted = False
+        self._streak = 0
 
     def load_score(self) -> float:
         """Live load from the same values the serving gauges export:
@@ -106,6 +152,10 @@ class ReplicaRouter:
         self._handles: Dict[int, Tuple[int, int]] = {}   # h -> (idx, rid)
         self._by_engine: Dict[Tuple[int, int], int] = {}
         self._next_handle = 0
+        # called with the replica index when an engine dies mid-step
+        # (EngineDeadError): the fleet supervisor installs its drain +
+        # restart here
+        self.failure_hook: Optional[Callable[[int], None]] = None
         for idx, rep in enumerate(self.replicas):
             rep.engine.requeue_hook = self._make_requeue_hook(idx)
 
@@ -175,20 +225,42 @@ class ReplicaRouter:
     # -- driving -----------------------------------------------------------
     def step_all(self) -> Dict[int, List[int]]:
         """One scheduling step on every replica with pending work;
-        returns {handle: [tokens produced this step]}."""
+        returns {handle: [tokens produced this step]}.  Demoted replicas
+        get a half-open recovery probe instead of traffic; an engine
+        that dies mid-step (EngineDeadError) is demoted on the spot and
+        reported through ``failure_hook``."""
+        from ..distributed.resilience.errors import EngineDeadError
+
         produced: Dict[int, List[int]] = {}
         for idx, rep in enumerate(self.replicas):
-            if not rep.engine.pending():
+            if rep._demoted:
+                rep.probe()
+                if rep._demoted:
+                    continue
+            if getattr(rep.engine, "dead", False) \
+                    or not rep.engine.pending():
                 continue
-            for rid, tok in rep.engine.step():
+            try:
+                stepped = rep.engine.step()
+            except EngineDeadError:
+                rep.mark_unhealthy()
+                _m_failures.inc()
+                if self.failure_hook is not None:
+                    self.failure_hook(idx)
+                continue
+            for rid, tok in stepped:
                 h = self._by_engine.get((idx, rid))
                 if h is not None:
                     produced.setdefault(h, []).append(tok)
         return produced
 
+    def _live_pending(self) -> bool:
+        return any(rep.engine.pending() for rep in self.replicas
+                   if not getattr(rep.engine, "dead", False))
+
     def run_to_completion(self, max_steps: int = 1000) -> Dict[int, List[int]]:
         for _ in range(max_steps):
-            if not any(rep.engine.pending() for rep in self.replicas):
+            if not self._live_pending():
                 break
             self.step_all()
         return self.results()

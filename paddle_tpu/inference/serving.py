@@ -91,7 +91,8 @@ class PagedServingConfig:
                  num_heads=4, ffn_size=128, block_size=16, num_blocks=64,
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
                  num_kv_heads=None, dtype="float32", cache_quant=None,
-                 max_queue=None, prefix_cache=False):
+                 max_queue=None, prefix_cache=False,
+                 prefix_snapshot_root=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -118,6 +119,12 @@ class PagedServingConfig:
         # over the page pool, see inference/prefix_cache.py) — a cache
         # hit skips straight past the shared tokens' prefill
         self.prefix_cache = bool(prefix_cache)
+        # prefix_snapshot_root: directory of cache_<seq> snapshot dirs.
+        # An engine built with this set restores the newest complete
+        # snapshot at start (a restarted replica serves warm shared-
+        # prefix hits immediately) and save_prefix_cache() snapshots
+        # there by default.
+        self.prefix_snapshot_root = prefix_snapshot_root
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -566,6 +573,22 @@ class ServingEngine:
         # replica (hook receives the dict from _requeue_info; it must not
         # raise — a failing hook fails the engine step sweeping it)
         self.requeue_hook = None
+        # liveness: a kill@prefill/decode/cache_save chaos fault (or the
+        # fleet supervisor) fells THIS engine in-process — every call
+        # into a dead engine raises EngineDeadError until it is replaced
+        self.dead = False
+        self.name = f"engine{seed}"
+        # rank the chaos injector sees for this engine's fault sites, so
+        # PT_FAULT_PLAN ":rank=R" clauses target one replica of a fleet
+        self.fault_rank = 0
+        from ..distributed.resilience import faults as _faults
+
+        _faults.maybe_arm_from_env()
+        if self._prefix_cache is not None \
+                and getattr(cfg, "prefix_snapshot_root", None):
+            from .prefix_cache import restore_snapshot
+
+            restore_snapshot(self, cfg.prefix_snapshot_root)
 
     @classmethod
     def from_model(cls, model: PagedCausalLM, cfg: PagedServingConfig,
@@ -661,6 +684,7 @@ class ServingEngine:
         Raises EngineOverloadedError when cfg.max_queue live requests
         already exist (load shedding at admission, not deep in the
         queue)."""
+        self._check_alive()
         if len(prompt_tokens) == 0:
             raise ValueError("prompt must contain at least one token "
                              "(an empty row would read another request's "
@@ -740,6 +764,58 @@ class ServingEngine:
     def timed_out_requests(self):
         """rids evicted by the deadline sweep (serving front-end: 504)."""
         return [r.rid for r in self._requests.values() if r.timed_out]
+
+    # -- liveness + chaos sites ------------------------------------------
+    def _check_alive(self):
+        # getattr: argument validation must stay usable on bare engines
+        # built without __init__ (the empty-prompt contract test)
+        if getattr(self, "dead", False):
+            from ..distributed.resilience.errors import EngineDeadError
+
+            raise EngineDeadError(self.name)
+
+    def _fault_event(self, site):
+        """Consult the chaos injector at a serving site.  ``kill`` fells
+        THIS engine (dead flag + EngineDeadError — the in-process analog
+        of the replica process dying); ``delay`` sleeps; frame-level
+        kinds are meaningless here and ignored."""
+        from ..distributed.resilience import faults as _faults
+
+        act = _faults.injector.on_event(site, self.fault_rank)
+        if act is None:
+            return
+        if act.kind == "kill":
+            self.dead = True
+            from ..distributed.resilience.errors import EngineDeadError
+
+            raise EngineDeadError(self.name, site)
+        if act.kind == "delay":
+            time.sleep(act.delay_ms / 1e3)
+
+    # -- prefix-cache persistence ----------------------------------------
+    def save_prefix_cache(self, root=None, keep=None):
+        """Snapshot the prefix cache (trie + owned KV pages) under
+        `root` (default cfg.prefix_snapshot_root) via the atomic
+        manifest pattern; returns the snapshot path or None (empty)."""
+        from .prefix_cache import save_snapshot
+
+        root = root or self.cfg.prefix_snapshot_root
+        if root is None:
+            raise ValueError("no snapshot root: pass root= or set "
+                             "cfg.prefix_snapshot_root")
+        return save_snapshot(self, root, keep=keep)
+
+    def restore_prefix_cache(self, root=None):
+        """Restore the newest complete snapshot under `root` (default
+        cfg.prefix_snapshot_root) into this engine's cache; sweeps torn
+        snapshot dirs first.  Returns blocks restored."""
+        from .prefix_cache import restore_snapshot
+
+        root = root or self.cfg.prefix_snapshot_root
+        if root is None:
+            raise ValueError("no snapshot root: pass root= or set "
+                             "cfg.prefix_snapshot_root")
+        return restore_snapshot(self, root)
 
     def _salt(self, r, n_generated):
         """Sampling salt under the request's ORIGIN identity: a request
@@ -836,6 +912,7 @@ class ServingEngine:
     def _step(self):
         cfg = self.cfg
 
+        self._check_alive()
         self._evict_expired()
         rows = self._schedule()
         preempted = set()
@@ -867,6 +944,14 @@ class ServingEngine:
             rows = self._schedule()
         if not rows:
             return []
+        # chaos sites, consulted BEFORE any page allocation or cache
+        # mutation: a kill here leaves every scheduled request in a
+        # consistent pre-step state (decode rows still at their tip), so
+        # the fleet supervisor can migrate them losslessly
+        if any(r.cached < len(r.prompt) for r, _ in rows):
+            self._fault_event("prefill")
+        if any(r.cached >= len(r.prompt) for r, _ in rows):
+            self._fault_event("decode")
         _m_steps.inc()
 
         B1 = cfg.max_batch + 1
@@ -1019,11 +1104,15 @@ class ServingEngine:
     def _decode_run(self, n_steps):
         cfg = self.cfg
         t_start = time.perf_counter()
+        self._check_alive()
         self._evict_expired()
         rows = [r for r in self.pending()
                 if r.length - r.cached == 1][:cfg.max_batch]
         if not rows:
             return []
+        # same pre-mutation contract as _step: every selected row is at
+        # its decode tip when a kill fires here, i.e. migratable
+        self._fault_event("decode")
         n = min([n_steps] + [r.max_new - len(r.generated) for r in rows])
         # clamp the window to what the free page pool can hold (the whole
         # window's pages are reserved up front so block tables stay

@@ -104,8 +104,9 @@ def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
 
 def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
-    assert gate == ["llama_train", "eager_dispatch", "serving", "fleet"]
-    assert len(bench.WORKLOADS) == 9
+    assert gate == ["llama_train", "eager_dispatch", "serving", "fleet",
+                    "fleet_recovery"]
+    assert len(bench.WORKLOADS) == 10
 
 
 # ---------------------------------------------------------------------------
@@ -198,6 +199,41 @@ def test_benchgate_fails_fleet_hit_rate_drop(tmp_path):
 def test_benchgate_fails_fleet_ttft_rise(tmp_path):
     assert _gate(tmp_path, _fleet_result(ttft=0.020),
                  _fleet_result()) == 1
+
+
+def _recovery_result(completed=8.0, recovery=0.35, **kw):
+    out = _result(**kw)
+    out["extra"]["fleet_recovery"] = {
+        "fleet_recovery": {"n_requests": 8, "max_new": 6,
+                           "requests_completed": completed,
+                           "recovery_s": recovery,
+                           "replica_restarts": 1, "drained": 4,
+                           "bitwise_match": True},
+    }
+    return out
+
+
+def test_benchgate_recovery_rows_pass_within_threshold(tmp_path):
+    assert _gate(tmp_path, _recovery_result(recovery=0.36),
+                 _recovery_result()) == 0
+    # a baseline without the chaos row gates only the shared signals
+    assert _gate(tmp_path, _recovery_result(), _result()) == 0
+
+
+def test_benchgate_fails_any_recovery_completion_drop(tmp_path):
+    """requests_completed is gated with zero slack: losing even one of
+    eight requests (12.5%) fails regardless of the 5% threshold —
+    and so would a smaller fractional drop."""
+    assert _gate(tmp_path, _recovery_result(completed=7.0),
+                 _recovery_result()) == 1
+
+
+def test_benchgate_fails_recovery_time_rise(tmp_path):
+    assert _gate(tmp_path, _recovery_result(recovery=0.50),
+                 _recovery_result()) == 1
+    # within the 5% budget is fine
+    assert _gate(tmp_path, _recovery_result(recovery=0.36),
+                 _recovery_result(recovery=0.35)) == 0
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

@@ -1,0 +1,315 @@
+"""Self-healing fleet serving (ISSUE 9): replica supervision with
+drain-by-migration, requeue fallback, bounded restart, half-open
+re-probation, and prefix-cache persistence with torn-snapshot hygiene.
+
+The load-bearing invariant: a replica killed mid-decode loses ZERO
+in-flight requests and changes ZERO tokens — every stream the fleet
+returns is bitwise-identical to an uninterrupted run, whether the
+request moved by KV migration or by salt-preserving requeue.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.distributed.resilience.errors import EngineDeadError
+from paddle_tpu.inference.fleet_supervisor import (FleetSupervisor,
+                                                   FleetSupervisorConfig)
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import (PagedCausalLM,
+                                          PagedServingConfig,
+                                          SamplingParams, ServingEngine)
+from paddle_tpu.profiler import metrics as _metrics
+
+
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+
+
+def _cval(name):
+    return _metrics.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def _fresh_engine(model, seed=0, **over):
+    cfg = PagedServingConfig(**{**BASE, **over})
+    return ServingEngine.from_model(model, cfg, seed=seed)
+
+
+def _build_fleet(model, sup_cfg=None, restore_after=2, **over):
+    """Two-replica fleet with the supervisor installed. Engine seeds are
+    stable per slot (10+idx) so a restarted engine keeps the replica's
+    sampling identity, and fault_rank tags each slot for PT_FAULT_PLAN's
+    ``rank=`` selector."""
+    def factory(idx):
+        eng = _fresh_engine(model, seed=10 + idx, **over)
+        eng.fault_rank = idx
+        return eng
+
+    router = ReplicaRouter([Replica(factory(i), name=f"r{i}",
+                                    restore_after=restore_after)
+                            for i in range(2)])
+    sup = FleetSupervisor(router, engine_factory=factory,
+                          cfg=sup_cfg or FleetSupervisorConfig(
+                              backoff_base_s=0.0))
+    return router, sup
+
+
+_PROMPT_LENS = (9, 11, 7, 13)
+
+
+def _submit_wave(router, max_new=6):
+    rng = np.random.RandomState(31)
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+    return [router.submit(list(rng.randint(1, 90, n)),
+                          max_new_tokens=max_new, sampling=sp)
+            for n in _PROMPT_LENS]
+
+
+def _reference_run(model):
+    """The uninterrupted fleet: same topology, no faults armed."""
+    faults.disarm()
+    router, _sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    return [out[h] for h in hs]
+
+
+# ---------------------------------------------------------------------------
+# tentpole: kill mid-decode -> drain to a peer, bitwise-identical streams
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_decode_streams_bitwise_identical(model):
+    ref = _reference_run(model)
+
+    fail0, drain0 = _cval("serving/replica_failures"), _cval("serving/drains")
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+
+    assert [out[h] for h in hs] == ref          # token-bitwise identical
+    assert all(len(out[h]) == 6 for h in hs)    # nothing lost or truncated
+    assert sup.restarts == [0, 1]
+    assert sup.drained_handles                  # replica 1 had live work
+    assert _cval("serving/replica_failures") >= fail0 + 1
+    assert _cval("serving/drains") >= drain0 + 1
+    assert router.timed_out() == []
+
+
+def test_kill_at_prefill_drains_by_requeue(model):
+    """A request felled before its prefill finished has no decode tip to
+    migrate — the drain falls back to the salt-preserving requeue and
+    the stream still matches the uninterrupted run."""
+    ref = _reference_run(model)
+
+    rq0 = _cval("serving/drain_requeues")
+    faults.arm("kill@prefill#1:rank=1")
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+
+    assert [out[h] for h in hs] == ref
+    assert sup.restarts[1] == 1
+    assert _cval("serving/drain_requeues") >= rq0 + 1
+
+
+def test_drop_migrate_falls_back_to_requeue(model):
+    """drop@migrate makes every KV hand-off from the dying replica
+    unreachable; the drain requeues instead and identity still holds."""
+    ref = _reference_run(model)
+
+    rq0 = _cval("serving/drain_requeues")
+    faults.arm("kill@decode#2:rank=1,drop@migrate%1.0:rank=1")
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+
+    assert [out[h] for h in hs] == ref
+    assert _cval("serving/drain_requeues") >= rq0 + 1
+
+
+def test_pump_recovers_out_of_band_death(model):
+    """An engine that dies OUTSIDE a router step (no EngineDeadError for
+    step_all to catch) is found by the supervisor's poll pass."""
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    for _ in range(2):
+        router.step_all()                       # prefills land
+    victim = router.replicas[1]
+    had_live = any(not r.done for r in victim.engine._requests.values())
+    victim.engine.dead = True
+
+    assert sup.pump() == [1]
+    assert not victim.engine.dead               # factory-fresh engine
+    assert sup.restarts == [0, 1]
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 6 for h in hs)
+    assert had_live                             # the pump had work to save
+
+
+def test_max_restarts_bounds_crash_looping(model):
+    """A replica over its restart budget stays demoted instead of
+    flapping; the fleet finishes everything on the surviving peer."""
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = _build_fleet(
+        model, sup_cfg=FleetSupervisorConfig(max_restarts=0,
+                                             backoff_base_s=0.0))
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+
+    assert sup.restarts == [0, 0]               # restart refused
+    assert router.replicas[1]._demoted          # left out of rotation
+    assert all(len(out[h]) == 6 for h in hs)    # drain still saved them
+    assert router.timed_out() == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: half-open re-probation on the router's circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_half_open_probation_restores_replica(model):
+    eng = _fresh_engine(model)
+    rep = Replica(eng, name="ho", restore_after=3)
+    rs0 = _cval("serving/replica_restored")
+    rep.mark_unhealthy()
+    assert not rep.healthy()
+
+    assert rep.probe() is True                  # probe passes: streak 1/3
+    assert not rep.healthy()                    # ...but still on probation
+    assert rep.probe() is True                  # streak 2/3
+    eng.dead = True
+    assert rep.probe() is False                 # failing probe...
+    eng.dead = False
+    rep.probe()                                 # ...reset the streak: 1
+    rep.probe()                                 # 2
+    assert not rep.healthy()                    # reset really happened
+    rep.probe()                                 # 3 consecutive -> restored
+    assert rep.healthy()
+    assert _cval("serving/replica_restored") == rs0 + 1
+
+
+def test_step_all_probes_demoted_replicas_back_in(model):
+    """End to end: a restarted replica rejoins rotation through the
+    step loop's own probes — no manual mark_healthy anywhere."""
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = _build_fleet(model, restore_after=2)
+    hs = _submit_wave(router, max_new=8)
+    out = router.run_to_completion()
+    faults.disarm()
+
+    assert all(len(out[h]) == 8 for h in hs)
+    assert sup.restarts[1] == 1
+    # enough post-restart steps ran to clear probation
+    assert not router.replicas[1]._demoted
+    # restored = takes traffic again: the second of two admissions
+    # spills to r1 on load score (the first raised r0's occupancy)
+    h1 = router.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+    h2 = router.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+    assert {router.placement(h1)[0],
+            router.placement(h2)[0]} == {"r0", "r1"}
+    router.run_to_completion()
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache persistence: snapshot, restore, torn-dir hygiene
+# ---------------------------------------------------------------------------
+
+def _persist_engine(model, root, seed=0):
+    return _fresh_engine(model, seed=seed, prefix_cache=True,
+                         prefix_snapshot_root=str(root))
+
+
+def _warm_cache(eng, rng):
+    shared = list(rng.randint(1, 90, 17))
+    for tail in ([5, 6], [7, 8]):
+        eng.add_request(shared + tail, max_new_tokens=3)
+    eng.run_to_completion()
+    return shared
+
+
+def test_snapshot_restore_serves_prefix_hits(model, tmp_path):
+    rng = np.random.RandomState(41)
+    eng = _persist_engine(model, tmp_path)
+    shared = _warm_cache(eng, rng)
+    path = eng.save_prefix_cache()
+    assert path and os.path.exists(os.path.join(path, "MANIFEST.json"))
+
+    hr0 = _cval("serving/prefix_hits_restored")
+    e2 = _persist_engine(model, tmp_path)       # restore at construction
+    assert len(e2._prefix_cache._nodes) > 0
+    rid = e2.add_request(shared + [9, 9], max_new_tokens=3)
+    req = e2._requests[rid]
+    assert req.cached >= 16                     # served from restored pages
+    assert _cval("serving/prefix_hits_restored") > hr0
+    assert _metrics.histogram("serving/cache_restore_ms").count > 0
+
+    # the restored pages hold the REAL KV: generation matches a cold run
+    out = e2.run_to_completion()[rid]
+    cold = _fresh_engine(model, seed=0)
+    rc = cold.add_request(shared + [9, 9], max_new_tokens=3)
+    assert out == cold.run_to_completion()[rc]
+
+
+def test_torn_snapshot_ignored_and_swept(model, tmp_path):
+    rng = np.random.RandomState(42)
+    eng = _persist_engine(model, tmp_path)
+    _warm_cache(eng, rng)
+    good = eng.save_prefix_cache()
+
+    # kill the writer between page data and manifest: a torn dir remains
+    faults.arm("kill@cache_save#1")
+    with pytest.raises(EngineDeadError):
+        eng.save_prefix_cache()
+    faults.disarm()
+    assert eng.dead
+    torn = [d for d in os.listdir(tmp_path)
+            if not os.path.exists(str(tmp_path / d / "MANIFEST.json"))]
+    assert len(torn) == 1
+
+    # restore ignores the torn dir (newest COMPLETE wins) and sweeps it
+    sw0 = _cval("serving/cache_snapshots_swept")
+    e2 = _persist_engine(model, tmp_path)
+    assert len(e2._prefix_cache._nodes) > 0
+    assert sorted(os.listdir(tmp_path)) == [os.path.basename(good)]
+    assert _cval("serving/cache_snapshots_swept") == sw0 + 1
+
+
+def test_supervisor_snapshot_cadence_and_retention(model, tmp_path):
+    """snapshot_caches persists every replica's cache under the keep
+    budget; repeated passes prune the oldest complete snapshots."""
+    rng = np.random.RandomState(43)
+    router, sup = _build_fleet(
+        model, sup_cfg=FleetSupervisorConfig(backoff_base_s=0.0,
+                                             snapshot_keep=2),
+        prefix_cache=True)
+    for rep in router.replicas:
+        _warm_cache(rep.engine, rng)
+
+    root = tmp_path / "snaps"
+    for _ in range(3):
+        done = sup.snapshot_caches(root_override=str(root))
+        assert set(done) == {"r0", "r1"}
+    # retention: only the newest `keep` complete snapshots survive
+    assert len(os.listdir(root)) == 2
+    assert _cval("serving/cache_snapshots_pruned") > 0

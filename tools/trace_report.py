@@ -80,6 +80,14 @@ KNOWN_METRICS = (
     # disaggregated prefill/decode hand-offs (inference/disagg.py)
     "serving/prefix_hit_rate", "serving/prefix_pages_reused",
     "serving/reroutes", "serving/requeues", "serving/migrations",
+    # serving resilience tier (inference/fleet_supervisor.py + router
+    # half-open circuit breaker + prefix-cache persistence)
+    "serving/replica_failures", "serving/replica_restored",
+    "serving/replica_restarts", "serving/drains",
+    "serving/drain_requeues",
+    "serving/prefix_hits_restored", "serving/cache_restore_ms",
+    "serving/cache_snapshots", "serving/cache_snapshots_swept",
+    "serving/cache_snapshots_pruned",
     # int8 double-buffered weight streaming (inference/weight_stream.py)
     "weights/stream_prefetch_ms",
     # Executor-tier auto_fuse fallback (static/__init__.py)
