@@ -773,6 +773,103 @@ def bench_fleet_recovery(on_tpu):
     }}
 
 
+def bench_host_recovery(on_tpu):
+    """Host-loss recovery gate row (ISSUE 10): four replicas on two
+    simulated hosts (h0,h0,h1,h1) behind the router + fleet supervisor;
+    PT_FAULT_PLAN fells host h1 mid-decode, killing BOTH its replicas
+    (the injector's sticky felled-host semantics).  Gate signals:
+    every admitted request completes — drains land off-host first, on
+    the surviving h0 replicas — and how many seconds the drain +
+    backoff restarts take.  Restarted engines come back on h0 (the
+    felled host stays dead), and bitwise parity vs an uninterrupted
+    reference run is recorded alongside."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.inference.fleet_supervisor import (
+        FleetSupervisor, FleetSupervisorConfig)
+    from paddle_tpu.inference.router import Replica, ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+    from paddle_tpu.profiler import metrics as _metrics
+
+    n_req, prompt_len, max_new = 8, 12, 6
+    hosts = ("h0", "h0", "h1", "h1")
+    cfg = PagedServingConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=4, max_blocks_per_seq=6, token_budget=32)
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+               for _ in range(n_req)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    def factory(idx):
+        e = ServingEngine.from_model(model, cfg, seed=20 + idx)
+        e.host_id = "h0"        # restarts land on the surviving host
+        return e
+
+    def build():
+        engines = []
+        for i in range(4):
+            e = ServingEngine.from_model(model, cfg, seed=20 + i)
+            e.fault_rank = i
+            e.host_id = hosts[i]
+            engines.append(e)
+        router = ReplicaRouter(
+            [Replica(e, name=f"r{i}", restore_after=2)
+             for i, e in enumerate(engines)])
+        sup = FleetSupervisor(router, engine_factory=factory,
+                              cfg=FleetSupervisorConfig(
+                                  backoff_base_s=0.005))
+        return router, sup
+
+    def drive(router):
+        hs = [router.submit(p, max_new_tokens=max_new, sampling=sp)
+              for p in prompts]
+        out = router.run_to_completion()
+        return {h: out[h] for h in hs}
+
+    faults.disarm()
+    router, _ = build()
+    ref = drive(router)                      # warm + reference streams
+
+    cross0 = _metrics.counter("serving/cross_host_drains").value
+    faults.arm("kill@host#2:host=h1")
+    router, sup = build()
+    recovery = {}
+    on_failure = sup.on_failure
+
+    def timed_failure(idx):
+        t0 = time.perf_counter()
+        on_failure(idx)
+        recovery["s"] = recovery.get("s", 0.0) \
+            + (time.perf_counter() - t0)
+    router.failure_hook = timed_failure
+    t0 = time.perf_counter()
+    out = drive(router)
+    total_s = time.perf_counter() - t0
+    faults.disarm()
+
+    completed = sum(1 for toks in out.values() if len(toks) == max_new)
+    return {"host_recovery": {
+        "n_requests": n_req, "max_new": max_new,
+        "requests_completed": completed,
+        "recovery_s": round(recovery.get("s", 0.0), 4),
+        "total_s": round(total_s, 4),
+        "replica_restarts": sum(sup.restarts),
+        "drained": len(sup.drained_handles),
+        "cross_host_drains":
+            _metrics.counter("serving/cross_host_drains").value - cross0,
+        "bitwise_match": out == ref,
+    }}
+
+
 def host_dispatch_bench(measure_us):
     """Host-path dispatch cost (tunnel-free), shared by bench.py and
     tools/op_bench.py: the same grad-recorded matmul+add dispatches
@@ -998,6 +1095,7 @@ WORKLOADS = (
     ("serving", bench_serving, True),
     ("fleet", bench_fleet_serving, True),
     ("fleet_recovery", bench_fleet_recovery, True),
+    ("host_recovery", bench_host_recovery, True),
     ("second_order", bench_second_order, False),
 )
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import ast
 
-from .engine import rule
+from .engine import call_name, rule
 
 _BROAD = ("Exception", "BaseException")
 
@@ -140,6 +140,34 @@ def check_constant_sleep_retry(mod):
                    "it through resilience.backoff.delay/sleep_backoff "
                    "(exponential, capped) so a dead peer being "
                    "relaunched isn't hammered at a fixed frequency")
+
+
+@rule("PT504", "warning",
+      "direct TCPStore(...) construction in distributed//inference/ — "
+      "connect to the rendezvous store via store.connect_store so the "
+      "client fails over to the standby replica")
+def check_direct_tcpstore(mod):
+    """A client holding a raw ``TCPStore`` socket dies with the store
+    host: the whole point of the hot-standby replica
+    (``store.StandbyStore`` + ``store.FailoverStore``) is that clients
+    redial the survivor instead.  ``connect_store(...)`` is the one
+    sanctioned constructor — it wraps the same endpoint (plus any
+    ``PT_STORE_STANDBY`` endpoints) in the failover client.  The store
+    module itself is exempt: the wrapper has to construct the thing it
+    wraps."""
+    if not _in_scope(mod):
+        return
+    if mod.relpath.endswith("distributed/store.py"):
+        return
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and call_name(node) == "TCPStore":
+            yield (node.lineno, node.col_offset,
+                   "direct TCPStore(...) pins this client to a single "
+                   "store host — use distributed.store.connect_store "
+                   "(same arguments, plus standby=) so a store-host "
+                   "death fails over to the replica instead of taking "
+                   "the rendezvous plane down with it")
 
 
 @rule("PT502", "warning",

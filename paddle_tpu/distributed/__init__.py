@@ -35,7 +35,7 @@ from . import elastic
 from .watchdog import (comm_task_manager, disable_comm_watchdog,
                        enable_comm_watchdog)
 from . import launch
-from .store import TCPStore
+from .store import FailoverStore, StandbyStore, TCPStore, connect_store
 from . import rpc
 from . import ps
 
@@ -300,11 +300,12 @@ def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
     """reference gloo_* trio: CPU-barrier service for PS heterogenous
     jobs. The TCPStore provides the same rendezvous+barrier contract."""
     global _gloo_store
-    from .store import TCPStore
+    from .store import connect_store
 
     host, port = server_endpoint.split(":")
-    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
-                           world_size=rank_num)
+    _gloo_store = connect_store(host, int(port),
+                                is_master=(rank_id == 0),
+                                world_size=rank_num, rank=rank_id)
     _gloo_store._gloo_rank = rank_id
     _gloo_store._gloo_world = rank_num
 

@@ -25,13 +25,21 @@ rest of the pod then evicts.
 from __future__ import annotations
 
 import os
+import socket
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..profiler import metrics as _metrics
 
-__all__ = ["ElasticManager", "ELASTIC_EXIT_CODE",
+
+def default_host_id() -> str:
+    """The failure-domain label for this process: PT_HOST_ID when the
+    launcher set one (chaos tests and multi-host pods do), else the
+    hostname — ranks sharing it share a fate under host loss."""
+    return os.environ.get("PT_HOST_ID", "") or socket.gethostname()
+
+__all__ = ["ElasticManager", "default_host_id", "ELASTIC_EXIT_CODE",
            "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
 
 # reference manager.py:32-33 exit codes
@@ -47,10 +55,13 @@ class ElasticManager:
     def __init__(self, store, job_id: str, rank: int, min_nodes: int,
                  max_nodes: int, heartbeat_interval: float = 3.0,
                  ttl: float = 15.0,
-                 on_membership_change: Optional[Callable] = None):
+                 on_membership_change: Optional[Callable] = None,
+                 host_id: Optional[str] = None):
         self.store = store
         self.job_id = job_id
         self.rank = rank
+        self.host_id = host_id if host_id is not None else \
+            default_host_id()
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.interval = heartbeat_interval
@@ -67,7 +78,27 @@ class ElasticManager:
     # -- membership --------------------------------------------------------
     def register(self):
         self.store.set(f"{self.job_id}/hb/{self.rank}", str(time.time()))
+        self.store.set(f"{self.job_id}/host/{self.rank}", self.host_id)
         self.store.add(f"{self.job_id}/registered", 1)
+
+    def host_map(self) -> Dict[int, str]:
+        """{rank: host_id} for every registered rank — what quorum
+        sizing and host-aware ring placement key on."""
+        out: Dict[int, str] = {}
+        for r in range(self.max_nodes):
+            try:
+                h = self.store.get_nowait(f"{self.job_id}/host/{r}")
+            except Exception:
+                h = None     # unregistered rank: no failure domain yet
+            if h is not None:
+                out[r] = h.decode()
+        return out
+
+    def alive_hosts(self) -> List[str]:
+        """Distinct host_ids with at least one fresh heartbeat."""
+        hosts = self.host_map()
+        return sorted({hosts[r] for r in self.alive_members()
+                       if r in hosts})
 
     def alive_members(self) -> List[int]:
         now = time.time()

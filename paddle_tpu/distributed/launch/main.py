@@ -55,6 +55,12 @@ def parse_args(argv=None):
     parser.add_argument("--ckpt_dir", default=None,
                         help="checkpoint root for the elastic "
                              "supervisor's disk tier (PT_CKPT_ROOT)")
+    parser.add_argument("--standby", default=None,
+                        help="host:port of the hot-standby rendezvous "
+                             "store replica (PT_STORE_STANDBY); a "
+                             "non-master controller matching the host "
+                             "serves it, every store client fails over "
+                             "to it when the primary's host dies")
     parser.add_argument("--snapshot_every", type=int, default=0,
                         help="in-memory replicated snapshot interval "
                              "in steps for supervised workers "
@@ -88,6 +94,7 @@ class Controller:
         self.max_nodes = int(hi) if hi else self.min_nodes
         self.elastic = bool(hi)
         self.store = None
+        self.standby = None
         self.is_master = False
         self.generation = 0
         self._missing_since = {}      # (gen, rank) -> first-seen-missing
@@ -95,29 +102,60 @@ class Controller:
 
     # -- rendezvous --------------------------------------------------------
     def _connect_store(self):
-        from ..store import TCPStore
+        from ..store import connect_store
 
+        standby = self.args.standby \
+            or os.environ.get("PT_STORE_STANDBY") or None
         if self.args.master is None:
             port = _free_port()
-            self.store = TCPStore("127.0.0.1", port, is_master=True)
+            self.store = connect_store("127.0.0.1", port, is_master=True,
+                                       standby=standby or "")
             self.is_master = True
         else:
             host, _, port = self.args.master.partition(":")
             want_master = self.args.rank in (-1, 0)
             try:
-                self.store = TCPStore(host, int(port), is_master=False,
-                                      timeout=5.0)
+                self.store = connect_store(host, int(port),
+                                           is_master=False, timeout=5.0,
+                                           standby=standby or "")
             except ConnectionError:
                 try:
-                    self.store = TCPStore(host, int(port),
-                                          is_master=True)
+                    self.store = connect_store(host, int(port),
+                                               is_master=True,
+                                               standby=standby or "")
                     self.is_master = True
                 except OSError:
                     # lost the hosting race (EADDRINUSE): a peer
                     # controller bound the port between our probe and
                     # our bind — join it as a client, patiently
-                    self.store = TCPStore(host, int(port),
-                                          is_master=False, timeout=30.0)
+                    self.store = connect_store(host, int(port),
+                                               is_master=False,
+                                               timeout=30.0,
+                                               standby=standby or "")
+        self._maybe_host_standby(standby)
+
+    def _maybe_host_standby(self, standby: Optional[str]):
+        """Serve the hot-standby replica when --standby names an
+        endpoint this controller should bind: a NON-master controller
+        whose host matches (the off-host deployment), or the local
+        single-controller case (dev convenience). EADDRINUSE means a
+        peer already serves it — fine."""
+        if not standby:
+            return
+        host, _, port = standby.partition(":")
+        local = host in ("127.0.0.1", "localhost", self.host)
+        if not local or (self.is_master and self.args.master is not None):
+            return
+        from ..store import StandbyStore
+
+        primary = self.store.endpoints[0]
+        try:
+            self.standby = StandbyStore(primary[0], primary[1],
+                                        host=host, port=int(port),
+                                        timeout=30.0)
+        except (ConnectionError, OSError) as e:
+            print(f"[launch] standby store at {standby} not started: "
+                  f"{e!r}", file=sys.stderr)
 
     def _ns(self):
         return f"{self.args.job_id}/g{self.generation}"
@@ -213,6 +251,13 @@ class Controller:
             env["PT_SNAPSHOT_EVERY"] = str(self.args.snapshot_every)
         if self.generation > 0:
             env["PT_SUPERVISOR_REJOIN"] = "1"
+        # host-level fault domain contract: workers learn the standby
+        # store endpoint (FailoverStore redial target) and their host_id
+        # (membership + ring placement); an explicit PT_HOST_ID from the
+        # environment (chaos tests) wins over the controller's host
+        if self.args.standby:
+            env.setdefault("PT_STORE_STANDBY", self.args.standby)
+        env.setdefault("PT_HOST_ID", self.host)
         return env
 
     def spawn(self, pod: Pod):

@@ -13,7 +13,7 @@ from typing import List, Optional
 __all__ = [
     "TransportError", "TransportClosedError", "TransportTimeoutError",
     "FrameCorruptError", "PeerUnreachableError", "CommTimeoutError",
-    "EngineDeadError",
+    "EngineDeadError", "StoreTimeoutError", "StaleGenerationError",
 ]
 
 
@@ -87,6 +87,43 @@ class EngineDeadError(RuntimeError):
         super().__init__(
             f"serving engine {name} is dead{at}: drain its in-flight "
             f"requests to a healthy replica and restart it")
+
+
+class StoreTimeoutError(TransportError, TimeoutError):
+    """A rendezvous-store read (`get`/`wait`) expired. Names the key,
+    the store endpoint, and the budget so a wedged rendezvous is
+    attributable from one rank's traceback — and subclasses
+    ``TimeoutError`` so pre-taxonomy catch sites keep working."""
+
+    def __init__(self, key: str, endpoint: Optional[str],
+                 timeout_s: Optional[float], op: str = "get"):
+        self.key = key
+        self.endpoint = endpoint
+        self.timeout_s = timeout_s
+        self.op = op
+        super().__init__(
+            f"store {op} on key {key!r} at {endpoint or '<unknown>'} "
+            f"timed out after {timeout_s}s")
+
+
+class StaleGenerationError(RuntimeError):
+    """A fenced store write carried a generation older than the fence:
+    the writer is on the minority side of a partition (or woke from a
+    long stall) and the group has re-formed without it. Deliberately
+    NOT a TransportError — the write must fail fast, never be retried
+    into the re-formed group."""
+
+    def __init__(self, key: str, domain: str, write_gen: int,
+                 fence_gen: int):
+        self.key = key
+        self.domain = domain
+        self.write_gen = write_gen
+        self.fence_gen = fence_gen
+        super().__init__(
+            f"fenced write to {key!r} refused: generation {write_gen} "
+            f"is stale (fence for domain {domain!r} is at generation "
+            f"{fence_gen}) — this rank was partitioned out of the "
+            f"re-formed group and must rejoin through rendezvous")
 
 
 class CommTimeoutError(TransportError):

@@ -26,6 +26,8 @@ Plan DSL (comma/semicolon separated clauses)::
     PT_FAULT_PLAN="kill@send#3:rank=1"
     PT_FAULT_PLAN="kill@step#5:rank=1"          # die at the 5th step
     PT_FAULT_PLAN="kill@save#1"                 # die mid-checkpoint
+    PT_FAULT_PLAN="kill@host#1:host=host1"      # fell a whole host
+    PT_FAULT_PLAN="partition@dial#1:rank=1"     # sever rank 1's dials
     PT_FAULT_PLAN="delay@send#1:ms=250,dup@send#2"
     PT_FAULT_PLAN="seed=7,drop@send%0.05"
 
@@ -45,8 +47,22 @@ Optional filters: ``:rank=R`` (only this global rank injects) and
   mid-step (exercises supervisor re-form + snapshot restore), or
   mid-save (exercises torn-checkpoint discovery)
 
-At the ``step``/``save`` sites only ``kill`` and ``delay`` are
-meaningful; frame-level kinds (drop/dup/corrupt) are ignored there.
+At the ``step``/``save``/``host`` sites only ``kill`` and ``delay``
+are meaningful; frame-level kinds (drop/dup/corrupt) are REJECTED by
+the plan parser there — a plan that could only no-op fails validation
+instead of silently passing CI.
+
+The ``host`` site makes the HOST the failure unit: the supervisor (per
+train step, with its ``host_id``) and the serving router (per engine
+step, with ``engine.host_id``) consult it, and a fired ``kill@host`` is
+STICKY — the felled ``host_id`` is remembered, so every co-hosted rank
+and engine dies at its next consult, not just the one that tripped the
+``#n`` trigger. Target a specific host with ``:host=H``; in subprocess
+chaos runs each rank's injector is per-process, so every rank sharing
+the target ``PT_HOST_ID`` exits at its first host-site consult. The
+``partition`` kind (valid only at ``dial``) makes connect attempts fail
+the way a severed DCN link would — both the transport's peer dials and
+the ``FailoverStore``'s store redials consult it.
 At the serving engine sites (``prefill``/``decode``/``cache_save``)
 ``kill`` fells the ENGINE, not the process: the engine sets its
 ``dead`` flag and raises ``EngineDeadError`` — the in-process replica
@@ -80,9 +96,16 @@ __all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
            "injector", "arm", "disarm", "is_armed", "parse_plan",
            "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
 
-FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill")
+FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition")
 FAULT_SITES = ("send", "dial", "recv", "step", "save",
-               "prefill", "decode", "migrate", "cache_save")
+               "prefill", "decode", "migrate", "cache_save", "host")
+
+# frame-level kinds are meaningless away from the wire: the validator
+# REJECTS them at the process/host sites instead of silently no-oping
+_FRAME_KINDS = ("drop", "dup", "corrupt")
+_PROCESS_SITES = ("step", "save", "host")
+# a partition severs links: it only means something where dials happen
+_PARTITION_SITES = ("dial",)
 
 
 @dataclass(frozen=True)
@@ -102,18 +125,22 @@ class FaultRule:
     prob: float = 0.0              # or: fire with this probability
     rank: Optional[int] = None     # only inject on this global rank
     peer: Optional[int] = None     # only on events involving this peer
+    host: Optional[str] = None     # only on events from this host_id
     delay_ms: float = 100.0
     exit_code: int = 1
     # runtime state
     seen: int = 0
     fired: int = 0
 
-    def matches(self, site: str, rank: int, peer: Optional[int]) -> bool:
+    def matches(self, site: str, rank: int, peer: Optional[int],
+                host: Optional[str] = None) -> bool:
         if site != self.site:
             return False
         if self.rank is not None and rank != self.rank:
             return False
         if self.peer is not None and peer != self.peer:
+            return False
+        if self.host is not None and host != self.host:
             return False
         return True
 
@@ -130,6 +157,8 @@ class FaultPlan:
             tok += f"#{r.nth}" if r.nth is not None else f"%{r.prob}"
             if r.rank is not None:
                 tok += f":rank={r.rank}"
+            if r.host is not None:
+                tok += f":host={r.host}"
             out.append(tok)
         return ",".join(out) or "<empty>"
 
@@ -166,12 +195,24 @@ def parse_plan(spec: str) -> FaultPlan:
             raise ValueError(f"unknown fault site {site!r} in {clause!r} "
                              f"(known: {', '.join(FAULT_SITES)})")
         rule.site = site
+        if kind in _FRAME_KINDS and site in _PROCESS_SITES:
+            raise ValueError(
+                f"frame-level kind {kind!r} is meaningless at the "
+                f"{site!r} site in {clause!r} (only kill/delay fire at "
+                f"{'/'.join(_PROCESS_SITES)})")
+        if kind == "partition" and site not in _PARTITION_SITES:
+            raise ValueError(
+                f"kind 'partition' only applies at the "
+                f"{'/'.join(_PARTITION_SITES)} site(s), not {site!r} in "
+                f"{clause!r}")
         for opt in opts:
             k, _, v = opt.partition("=")
             if k == "rank":
                 rule.rank = int(v)
             elif k == "peer":
                 rule.peer = int(v)
+            elif k == "host":
+                rule.host = v
             elif k == "ms":
                 rule.delay_ms = float(v)
             elif k == "code":
@@ -191,6 +232,11 @@ class FaultInjector:
         self._lock = threading.Lock()
         self._plan: Optional[FaultPlan] = None
         self._rng: Optional[random.Random] = None
+        # hosts a kill@host already felled: STICKY — every later event
+        # from a felled host keeps firing kill, so an in-process fleet
+        # loses all its co-hosted engines, not just the one whose event
+        # happened to trip the ``#n`` trigger
+        self._felled_hosts: set = set()
 
     # -- arming ----------------------------------------------------------
     def arm(self, plan) -> FaultPlan:
@@ -199,12 +245,18 @@ class FaultInjector:
         with self._lock:
             self._plan = plan
             self._rng = random.Random(plan.seed)
+            self._felled_hosts = set()
         return plan
 
     def disarm(self):
         with self._lock:
             self._plan = None
             self._rng = None
+            self._felled_hosts = set()
+
+    def felled_hosts(self) -> set:
+        with self._lock:
+            return set(self._felled_hosts)
 
     def is_armed(self) -> bool:
         return self._plan is not None
@@ -215,7 +267,8 @@ class FaultInjector:
 
     # -- the hook the transport calls ------------------------------------
     def on_event(self, site: str, rank: int,
-                 peer: Optional[int] = None) -> Optional[FaultAction]:
+                 peer: Optional[int] = None,
+                 host: Optional[str] = None) -> Optional[FaultAction]:
         """Record one event at `site`; return the action to inject, or
         None. At most one rule fires per event (first match wins)."""
         plan = self._plan
@@ -223,11 +276,17 @@ class FaultInjector:
             return None
         action = None
         with self._lock:
+            if site == "host" and host is not None \
+                    and host in self._felled_hosts:
+                # the host is already down: everything on it stays dead
+                _metrics.inc("faults/injected")
+                _metrics.inc("faults/kill")
+                return FaultAction("kill")
             # every matching rule observes every event (so '#n' counts
             # site events, not rule evaluations); the first rule whose
             # trigger matches wins the event
             for rule in plan.rules:
-                if not rule.matches(site, rank, peer):
+                if not rule.matches(site, rank, peer, host):
                     continue
                 rule.seen += 1
                 if action is not None:
@@ -244,6 +303,9 @@ class FaultInjector:
                 _metrics.inc(f"faults/{rule.kind}")
                 action = FaultAction(rule.kind, delay_ms=rule.delay_ms,
                                      exit_code=rule.exit_code)
+                if site == "host" and rule.kind == "kill" \
+                        and host is not None:
+                    self._felled_hosts.add(host)
         return action
 
     def counts(self) -> dict:

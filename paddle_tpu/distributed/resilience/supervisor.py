@@ -51,13 +51,14 @@ import numpy as np
 
 from ...profiler import RecordEvent
 from ...profiler import metrics as _metrics
+from ..elastic import default_host_id
 from . import backoff as _backoff
 from . import faults as _faults
 from .errors import TransportError
 from .guards import OK, ROLLBACK, SKIP, GuardConfig, StepGuard
 
 __all__ = ["SupervisorConfig", "StepContext", "Supervisor",
-           "run_elastic", "RECOVERABLE_ERRORS"]
+           "run_elastic", "host_aware_ring", "RECOVERABLE_ERRORS"]
 
 # what the supervisor treats as "the group broke, re-form and resume"
 # (everything else — including guard FloatingPointErrors handled
@@ -71,6 +72,10 @@ _m_snapshots = _metrics.counter("train/snapshots")
 _m_snap_bytes = _metrics.counter("train/snapshot_bytes")
 _m_repl_errors = _metrics.counter("train/replication_errors")
 _m_reform_ms = _metrics.histogram("train/reform_ms")
+_m_quorum_checks = _metrics.counter("elastic/quorum_checks")
+_m_quorum_ok = _metrics.counter("elastic/quorum_ok")
+_m_quorum_lost = _metrics.counter("elastic/quorum_lost")
+_m_stale_snaps = _metrics.counter("elastic/stale_snapshots_dropped")
 
 
 @dataclass
@@ -97,6 +102,8 @@ class SupervisorConfig:
     heartbeat_ttl_s: float = 5.0
     rejoin: bool = False             # this process replaces a dead rank
     group_id: int = 0                # gid for collectives + unhealthy key
+    host_id: str = field(default_factory=default_host_id)
+    require_quorum: bool = True      # gate re-form on a host majority
     guard: GuardConfig = field(default_factory=GuardConfig)
 
     @classmethod
@@ -170,11 +177,14 @@ def _copy_state(state: Dict) -> Dict[str, np.ndarray]:
 
 
 def _send_state(tp, dst: int, step: int, state: Dict,
-                channel: str) -> int:
+                channel: str, gen: int = 0) -> int:
     """Ship a state dict to `dst`: a JSON manifest frame (step + key
-    order) then one CRC-protected frame per array. Returns bytes."""
+    order + the writer's generation, so a receiver can fence out a
+    snapshot from before a re-form) then one CRC-protected frame per
+    array. Returns bytes."""
     keys = sorted(state)
-    manifest = json.dumps({"step": step, "keys": keys}).encode()
+    manifest = json.dumps({"step": step, "keys": keys,
+                           "gen": gen}).encode()
     tp.send(np.frombuffer(manifest, dtype=np.uint8), dst, channel)
     nbytes = len(manifest)
     for k in keys:
@@ -184,10 +194,32 @@ def _send_state(tp, dst: int, step: int, state: Dict,
     return nbytes
 
 
-def _recv_state(tp, src: int, channel: str) -> Tuple[int, Dict]:
+def _recv_state(tp, src: int, channel: str) -> Tuple[int, Dict, int]:
     manifest = json.loads(bytes(tp.recv(src, channel)).decode())
     state = {k: tp.recv(src, channel) for k in manifest["keys"]}
-    return int(manifest["step"]), state
+    return int(manifest["step"]), state, int(manifest.get("gen", 0))
+
+
+def host_aware_ring(host_map: Dict[int, str]) -> List[int]:
+    """Ring order that interleaves ranks across hosts (round-robin over
+    the sorted host buckets), so every rank's ring neighbor — the peer
+    holding its in-memory snapshot replica — is on a DIFFERENT host
+    whenever the per-host rank counts allow it. With hosts balanced,
+    2 hosts x 2 ranks {0: A, 1: A, 2: B, 3: B} orders [0, 2, 1, 3]:
+    every neighbor pair crosses hosts, and a whole-host loss never
+    takes a snapshot AND its replica together. Pure function of the
+    shared host map — every rank computes the same ring."""
+    buckets: Dict[str, List[int]] = {}
+    for r in sorted(host_map):
+        buckets.setdefault(host_map[r], []).append(r)
+    cols = [buckets[h] for h in sorted(buckets)]
+    order: List[int] = []
+    depth = max((len(c) for c in cols), default=0)
+    for i in range(depth):
+        for c in cols:
+            if i < len(c):
+                order.append(c[i])
+    return order
 
 
 class Supervisor:
@@ -214,6 +246,8 @@ class Supervisor:
         self.skipped = 0
         self._step = 0
         self.recovery_sources: List[Tuple[int, str]] = []
+        self._host_map: Dict[int, str] = {}
+        self._standby = None
         if self.world > 1 and self.store is None:
             self.store = self._connect_store()
         if self.store is not None and self.world > 1:
@@ -223,7 +257,8 @@ class Supervisor:
                 self.store, f"sup/{config.job_id}/hb", self.rank,
                 min_nodes=self.world, max_nodes=self.world,
                 heartbeat_interval=min(1.0, config.heartbeat_ttl_s / 3),
-                ttl=config.heartbeat_ttl_s).start()
+                ttl=config.heartbeat_ttl_s,
+                host_id=config.host_id).start()
         if config.watchdog_timeout_s:
             from ..watchdog import enable_comm_watchdog
 
@@ -231,22 +266,61 @@ class Supervisor:
 
     # -- wiring ------------------------------------------------------------
     def _connect_store(self):
+        from ..store import connect_store
         from ..transport import _master_endpoint
-        from ..store import TCPStore
 
         host, port = _master_endpoint()
         timeout = self.config.transport_timeout_s * 2
         if self.rank == 0 and not self.config.rejoin:
             try:
-                return TCPStore(host, port, is_master=True,
-                                world_size=self.world, timeout=timeout)
+                store = connect_store(host, port, is_master=True,
+                                      world_size=self.world,
+                                      timeout=timeout, rank=self.rank)
+                self._maybe_host_standby(host, port)
+                return store
             except OSError:
                 pass
-        return TCPStore(host, port, is_master=False,
-                        world_size=self.world, timeout=timeout)
+        self._maybe_host_standby(host, port)
+        return connect_store(host, port, is_master=False,
+                             world_size=self.world, timeout=timeout,
+                             rank=self.rank)
+
+    def _maybe_host_standby(self, primary_host: str, primary_port: int):
+        """Host the hot-standby store replica when this rank is the
+        designated standby host (PT_STORE_STANDBY_RANK), binding the
+        endpoint PT_STORE_STANDBY advertises. Best-effort: a standby
+        that cannot come up degrades availability, not the run."""
+        spec = os.environ.get("PT_STORE_STANDBY", "")
+        sb_rank = os.environ.get("PT_STORE_STANDBY_RANK", "")
+        if not spec or not sb_rank or int(sb_rank) != self.rank:
+            return
+        from ..store import StandbyStore, _parse_endpoints
+
+        sb_host, sb_port = _parse_endpoints(spec)[0]
+        try:
+            self._standby = StandbyStore(
+                primary_host, primary_port, host=sb_host, port=sb_port,
+                timeout=self.config.transport_timeout_s)
+        except (ConnectionError, OSError) as e:
+            print(f"[supervisor] rank {self.rank} could not host the "
+                  f"standby store at {spec}: {e!r}",
+                  file=sys.stderr, flush=True)
 
     def _k(self, suffix: str) -> str:
         return f"sup/{self.config.job_id}/{suffix}"
+
+    @property
+    def _fence_domain(self) -> str:
+        return f"sup/{self.config.job_id}"
+
+    def _fenced_set(self, key: str, value, gen: int):
+        """Write through the generation fence when the store supports it
+        (both TCPStore and FailoverStore do; bare fakes fall back)."""
+        fenced = getattr(self.store, "fenced_set", None)
+        if fenced is None:
+            self.store.set(key, value)
+        else:
+            fenced(key, value, self._fence_domain, gen)
 
     def _teardown_transport(self):
         from .. import transport as tr
@@ -292,8 +366,16 @@ class Supervisor:
             cur = store.add(self._k("gen"), 0)
             if cur != registered_gen:
                 gen = cur
-                store.set(self._k(f"g{gen}/reg/{self.rank}"),
-                          str(time.time()))
+                # host before reg: once every rank's registration is
+                # visible, so is its host_id (placement + quorum input).
+                # Registration is FENCED on the generation — a rank
+                # returning from the minority side of a partition with a
+                # stale gen is refused (StaleGenerationError) instead of
+                # writing itself into the re-formed group.
+                store.set(self._k(f"g{gen}/host/{self.rank}"),
+                          self.config.host_id)
+                self._fenced_set(self._k(f"g{gen}/reg/{self.rank}"),
+                                 str(time.time()), gen)
                 registered_gen = gen
             present = self._registered_count(gen)
             if present >= self.world:
@@ -304,18 +386,58 @@ class Supervisor:
                     f"{self.world} ranks at generation {gen}")
             time.sleep(0.2)
 
+    def _check_quorum(self):
+        """Partition fence, host edition: before re-forming, require a
+        strict majority of the REGISTERED hosts to be heartbeat-alive.
+        A rank on the minority side of a partition waits here until the
+        re-form budget expires instead of forming a splinter group; the
+        majority side passes once relaunched ranks rejoin."""
+        if self.elastic is None or not self.config.require_quorum:
+            return
+        _m_quorum_checks.inc()
+        deadline = time.time() + self.config.reform_timeout_s
+        while True:
+            hosts = self.elastic.host_map()
+            total = set(hosts.values()) | {self.config.host_id}
+            alive = {hosts[r] for r in self.elastic.alive_members()
+                     if r in hosts}
+            alive.add(self.config.host_id)
+            if len(alive) * 2 > len(total):
+                _m_quorum_ok.inc()
+                return
+            if time.time() > deadline:
+                _m_quorum_lost.inc()
+                raise TimeoutError(
+                    f"host quorum lost: only {sorted(alive)} of "
+                    f"{sorted(total)} registered hosts alive after "
+                    f"{self.config.reform_timeout_s}s — this rank is on "
+                    f"the minority side of a partition")
+            time.sleep(0.2)
+
+    def _read_host_map(self, gen: int) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        for r in range(self.world):
+            try:
+                out[r] = self.store.get_nowait(
+                    self._k(f"g{gen}/host/{r}")).decode()
+            except KeyError:
+                pass
+        return out
+
     def _form_group(self, bump: bool) -> int:
-        """Re-form: heartbeat gate -> rendezvous -> fresh transport
-        (per-generation namespace) -> barrier -> clear stale unhealthy
-        mark. Returns the new generation."""
+        """Re-form: quorum + heartbeat gate -> rendezvous -> fresh
+        transport (per-generation namespace) -> barrier -> clear stale
+        unhealthy mark. Returns the new generation."""
         from .. import transport as tr
         from ..watchdog import clear_unhealthy
         self._teardown_transport()
+        self._check_quorum()
         if self.elastic is not None:
             self.elastic.wait_for_members(
                 self.world, timeout=self.config.reform_timeout_s)
         gen = self._rendezvous(bump)
         self.generation = gen
+        self._host_map = self._read_host_map(gen)
         self.transport = tr.TensorTransport(
             self.rank, self.world, self.store,
             timeout=self.config.transport_timeout_s,
@@ -347,9 +469,9 @@ class Supervisor:
             replicas.setdefault(str(src), []).append(step)
         avail = {"rank": self.rank, "own": sorted(self._own_snaps),
                  "replicas": {k: sorted(v) for k, v in replicas.items()},
-                 "disk": self._disk_step()}
-        self.store.set(self._k(f"g{gen}/avail/{self.rank}"),
-                       json.dumps(avail))
+                 "disk": self._disk_step(), "gen": gen}
+        self._fenced_set(self._k(f"g{gen}/avail/{self.rank}"),
+                         json.dumps(avail), gen)
 
     def _read_avails(self, gen: int) -> List[dict]:
         out = []
@@ -436,9 +558,10 @@ class Supervisor:
                         continue
                     if self.rank == q:
                         _send_state(self.transport, r, rstep,
-                                    self._replicas[(r, rstep)], "restore")
+                                    self._replicas[(r, rstep)], "restore",
+                                    gen=gen)
                     elif self.rank == r:
-                        rstep, state = _recv_state(
+                        rstep, state, _ = _recv_state(
                             self.transport, q, "restore")
                 step = rstep
             elif source == "disk":
@@ -473,13 +596,31 @@ class Supervisor:
             return False
         return True
 
+    def _ring_neighbors(self) -> Tuple[int, int]:
+        """(send_to, recv_from) on the host-aware ring: off-host
+        neighbors whenever the host map allows, so a whole-host loss
+        cannot take a snapshot and its replica together. Falls back to
+        rank order when the map is incomplete."""
+        if len(self._host_map) == self.world and self.world > 1:
+            ring = host_aware_ring(self._host_map)
+            pos = ring.index(self.rank)
+            return ring[(pos + 1) % self.world], \
+                ring[(pos - 1) % self.world]
+        return (self.rank + 1) % self.world, \
+            (self.rank - 1) % self.world
+
     def _replicate(self, next_step: int, snap: Dict):
         tp = self.transport
         try:
-            send_to = (self.rank + 1) % self.world
-            recv_from = (self.rank - 1) % self.world
-            nbytes = _send_state(tp, send_to, next_step, snap, "snap")
-            rstep, rstate = _recv_state(tp, recv_from, "snap")
+            send_to, recv_from = self._ring_neighbors()
+            nbytes = _send_state(tp, send_to, next_step, snap, "snap",
+                                 gen=self.generation)
+            rstep, rstate, rgen = _recv_state(tp, recv_from, "snap")
+            if rgen < self.generation:
+                # a snapshot from before the re-form: the sender is
+                # stale (minority-side straggler) — fence it out
+                _m_stale_snaps.inc()
+                return
             self._replicas[(recv_from, rstep)] = rstate
             keep = sorted(
                 s for (src, s) in self._replicas if src == recv_from)
@@ -529,12 +670,16 @@ class Supervisor:
 
     # -- the loop ----------------------------------------------------------
     def _fault_step_site(self):
-        act = _faults.injector.on_event("step", self.rank)
-        if act is not None:
-            if act.kind == "kill":
-                os._exit(act.exit_code)
-            elif act.kind == "delay":
-                time.sleep(act.delay_ms / 1e3)
+        # host first: a kill@host fells every rank sharing the host_id
+        # (sticky in-process; per-process injectors each fire once)
+        for site, host in (("host", self.config.host_id),
+                           ("step", None)):
+            act = _faults.injector.on_event(site, self.rank, host=host)
+            if act is not None:
+                if act.kind == "kill":
+                    os._exit(act.exit_code)
+                elif act.kind == "delay":
+                    time.sleep(act.delay_ms / 1e3)
 
     def run(self, train_step_fn: Callable, state: Dict, num_steps: int,
             on_restore: Optional[Callable] = None,

@@ -16,7 +16,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
-from ..store import TCPStore, _recv_msg, _send_msg
+from ..store import _recv_msg, _send_msg, connect_store
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
            "get_all_worker_infos", "WorkerInfo"]
@@ -87,8 +87,8 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
     if master_endpoint is None:
         master_endpoint = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
     host, _, port = master_endpoint.partition(":")
-    store = TCPStore(host, int(port), is_master=(rank == 0),
-                     world_size=world_size)
+    store = connect_store(host, int(port), is_master=(rank == 0),
+                          world_size=world_size, rank=rank)
     store.set(f"rpc/{rank}", f"{name},{ip},{server.port}")
     workers = {}
     for r in range(world_size):

@@ -63,7 +63,7 @@ from .resilience.backoff import delay as _backoff_delay
 from .resilience.errors import (FrameCorruptError, PeerUnreachableError,
                                 TransportClosedError, TransportError,
                                 TransportTimeoutError)
-from .store import TCPStore, _recv_exact
+from .store import TCPStore, _recv_exact, connect_store
 
 __all__ = ["TensorTransport", "init_transport", "get_transport",
            "install_transport", "shutdown_transport"]
@@ -315,8 +315,11 @@ class TensorTransport:
                         time.sleep(act.delay_ms / 1e3)
                     elif act.kind == "kill":
                         os._exit(act.exit_code)
-                    elif act.kind == "drop":
-                        raise OSError("fault injection: dial drop")
+                    elif act.kind in ("drop", "partition"):
+                        # partition: the link is severed, not the peer —
+                        # indistinguishable at the dialer, by design
+                        raise OSError(
+                            f"fault injection: dial {act.kind}")
                 sock = socket.create_connection((host, int(port)),
                                                 timeout=self.timeout)
                 break
@@ -667,14 +670,17 @@ def init_transport(rank: Optional[int] = None,
         # bind fails instantly (EADDRINUSE) in that case, so try hosting
         # first and join as a client on failure.
         try:
-            store = TCPStore(host, port, is_master=True,
-                             world_size=world_size, timeout=timeout)
+            store = connect_store(host, port, is_master=True,
+                                  world_size=world_size, timeout=timeout,
+                                  rank=rank)
         except OSError:
-            store = TCPStore(host, port, is_master=False,
-                             world_size=world_size, timeout=timeout)
+            store = connect_store(host, port, is_master=False,
+                                  world_size=world_size, timeout=timeout,
+                                  rank=rank)
     else:
-        store = TCPStore(host, port, is_master=False,
-                         world_size=world_size, timeout=timeout)
+        store = connect_store(host, port, is_master=False,
+                              world_size=world_size, timeout=timeout,
+                              rank=rank)
     _transport = TensorTransport(rank, world_size, store, timeout=timeout)
     return _transport
 

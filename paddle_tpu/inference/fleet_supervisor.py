@@ -50,7 +50,7 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -69,6 +69,8 @@ __all__ = ["FleetSupervisor", "FleetSupervisorConfig",
 _m_restarts = _metrics.counter("serving/replica_restarts")
 _m_drains = _metrics.counter("serving/drains")
 _m_drain_requeues = _metrics.counter("serving/drain_requeues")
+_m_cross_drains = _metrics.counter("serving/cross_host_drains")
+_m_cross_migrations = _metrics.counter("serving/cross_host_migrations")
 
 
 class LoopbackTransport:
@@ -119,10 +121,18 @@ class FleetSupervisor:
 
     def __init__(self, router: ReplicaRouter,
                  engine_factory: Callable[[int], ServingEngine],
-                 cfg: Optional[FleetSupervisorConfig] = None):
+                 cfg: Optional[FleetSupervisorConfig] = None,
+                 handoff_factory: Optional[
+                     Callable[[int, int],
+                              Tuple[object, object, int, int]]] = None):
         self.router = router
         self.engine_factory = engine_factory
         self.cfg = cfg or FleetSupervisorConfig()
+        # cross-host KV hand-off: called with (src_idx, dst_idx), returns
+        # (send_tp, recv_tp, dst_rank, src_rank) — a real TensorTransport
+        # pair for fleets spanning hosts.  None keeps the in-process
+        # LoopbackTransport default for co-hosted engines.
+        self.handoff_factory = handoff_factory
         self.restarts: List[int] = [0] * len(router.replicas)
         # handles drained (migrated or requeued) across this
         # supervisor's lifetime — the observable idempotency record
@@ -166,6 +176,11 @@ class FleetSupervisor:
         self.router._by_engine[(dst_idx, dst_rid)] = handle
         self.drained_handles.add(handle)
 
+    def _off_host(self, src_idx: int, dst_idx: int) -> bool:
+        src_h = self.router.replicas[src_idx].host_id
+        dst_h = self.router.replicas[dst_idx].host_id
+        return src_h is not None and dst_h is not None and src_h != dst_h
+
     def _migrate_one(self, src_idx: int, rid: int,
                      targets: List[int]) -> bool:
         """Ship one decode-tip request's KV pages to the least-loaded
@@ -178,17 +193,25 @@ class FleetSupervisor:
             dst = self.router.replicas[dst_idx].engine
             if self._capacity(dst) < len(r.pages):
                 continue
-            tp = LoopbackTransport()
+            if self.handoff_factory is not None:
+                send_tp, recv_tp, dst_rank, src_rank = \
+                    self.handoff_factory(src_idx, dst_idx)
+            else:
+                tp = LoopbackTransport()
+                send_tp, recv_tp, dst_rank, src_rank = tp, tp, 1, 0
             try:
-                disagg.migrate_request(src, rid, tp, dst=1)
+                disagg.migrate_request(src, rid, send_tp, dst=dst_rank)
             except (PeerUnreachableError, EngineDeadError):
                 # the dying engine cannot ship its pages at all (the
                 # drop@migrate failure mode): no peer will do better
                 return False
-            new_rid = disagg.receive_request(dst, tp, src=0)
+            new_rid = disagg.receive_request(dst, recv_tp, src=src_rank)
             h = self.router._by_engine.get((src_idx, rid))
             self._remap(h, src_idx, rid, dst_idx, new_rid)
             _m_drains.inc()
+            if self._off_host(src_idx, dst_idx):
+                _m_cross_drains.inc()
+                _m_cross_migrations.inc()
             return True
         return False
 
@@ -220,6 +243,8 @@ class FleetSupervisor:
             r.done = True
             src._release(r)
             _m_drain_requeues.inc()
+            if self._off_host(src_idx, dst_idx):
+                _m_cross_drains.inc()
             return True
         return False
 
@@ -229,7 +254,9 @@ class FleetSupervisor:
         for hand-offs the dying engine fails to ship).  Returns how
         many requests found a new home."""
         src = self.router.replicas[idx].engine
-        targets = self.router._ordered(exclude=idx)
+        targets = self.router._ordered(
+            exclude=idx,
+            prefer_off_host=self.router.replicas[idx].host_id)
         moved = 0
         for rid, r in list(src._requests.items()):
             if r.done or r.timed_out:
@@ -269,6 +296,11 @@ class FleetSupervisor:
         new = self.engine_factory(idx)
         new.name = getattr(old, "name", new.name)
         new.fault_rank = getattr(old, "fault_rank", 0)
+        # a factory may rebuild the replica on a DIFFERENT host (the
+        # old one is gone): adopt the new engine's failure domain
+        new_host = getattr(new, "host_id", None)
+        if new_host is not None:
+            rep.host_id = new_host
         # rid continuity: finished requests keep answering results(),
         # and fresh rids never collide with handles minted pre-death
         new._next_rid = max(new._next_rid, old._next_rid)

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..distributed.resilience import faults as _faults
 from ..profiler import metrics as _metrics
 from .serving import EngineOverloadedError, ServingEngine
 
@@ -76,9 +77,13 @@ class Replica:
 
     def __init__(self, engine: ServingEngine, name: Optional[str] = None,
                  health_fn: Optional[Callable[[], bool]] = None,
-                 restore_after: int = 3):
+                 restore_after: int = 3, host_id: Optional[str] = None):
         self.engine = engine
         self.name = name or f"replica{id(engine) & 0xffff:04x}"
+        # failure-domain label: replicas sharing it die together under
+        # host loss, and the fleet supervisor drains AWAY from it first
+        self.host_id = host_id if host_id is not None \
+            else getattr(engine, "host_id", None)
         self.health_fn = health_fn
         self.restore_after = max(int(restore_after), 1)
         self._demoted = False
@@ -160,9 +165,17 @@ class ReplicaRouter:
             rep.engine.requeue_hook = self._make_requeue_hook(idx)
 
     # -- admission ---------------------------------------------------------
-    def _ordered(self, exclude: Optional[int] = None) -> List[int]:
+    def _ordered(self, exclude: Optional[int] = None,
+                 prefer_off_host: Optional[str] = None) -> List[int]:
         healthy = [i for i, r in enumerate(self.replicas)
                    if i != exclude and r.healthy()]
+        if prefer_off_host is not None:
+            # drain ordering under host loss: peers OFF the failing host
+            # first (they do not share its fate), load-sorted within
+            # each group
+            return sorted(healthy, key=lambda i: (
+                self.replicas[i].host_id == prefer_off_host,
+                self.replicas[i].load_score()))
         return sorted(healthy,
                       key=lambda i: self.replicas[i].load_score())
 
@@ -237,6 +250,21 @@ class ReplicaRouter:
                 rep.probe()
                 if rep._demoted:
                     continue
+            act = _faults.injector.on_event(
+                "host", getattr(rep.engine, "fault_rank", idx),
+                host=rep.host_id)
+            if act is not None and act.kind == "kill" \
+                    and not getattr(rep.engine, "dead", False):
+                # chaos host loss: every replica sharing the felled
+                # host_id dies (sticky — the injector keeps answering
+                # kill for this host), through the same demote +
+                # failure_hook path a mid-step EngineDeadError takes
+                rep.engine.dead = True
+                rep.mark_unhealthy()
+                _m_failures.inc()
+                if self.failure_hook is not None:
+                    self.failure_hook(idx)
+                continue
             if getattr(rep.engine, "dead", False) \
                     or not rep.engine.pending():
                 continue

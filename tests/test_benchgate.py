@@ -105,8 +105,8 @@ def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
 def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
     assert gate == ["llama_train", "eager_dispatch", "serving", "fleet",
-                    "fleet_recovery"]
-    assert len(bench.WORKLOADS) == 10
+                    "fleet_recovery", "host_recovery"]
+    assert len(bench.WORKLOADS) == 11
 
 
 # ---------------------------------------------------------------------------
@@ -234,6 +234,33 @@ def test_benchgate_fails_recovery_time_rise(tmp_path):
     # within the 5% budget is fine
     assert _gate(tmp_path, _recovery_result(recovery=0.36),
                  _recovery_result(recovery=0.35)) == 0
+
+
+def _host_recovery_result(completed=8.0, recovery=0.45, **kw):
+    out = _result(**kw)
+    out["extra"]["host_recovery"] = {
+        "host_recovery": {"n_requests": 8, "max_new": 6,
+                          "requests_completed": completed,
+                          "recovery_s": recovery,
+                          "replica_restarts": 2, "drained": 4,
+                          "cross_host_drains": 4,
+                          "bitwise_match": True},
+    }
+    return out
+
+
+def test_benchgate_host_recovery_row_gated_like_fleet(tmp_path):
+    """host_recovery (whole host felled) shares the recovery gate
+    shape: zero-slack on requests_completed, threshold on
+    recovery_s."""
+    assert _gate(tmp_path, _host_recovery_result(recovery=0.46),
+                 _host_recovery_result()) == 0
+    assert _gate(tmp_path, _host_recovery_result(completed=7.0),
+                 _host_recovery_result()) == 1
+    assert _gate(tmp_path, _host_recovery_result(recovery=0.60),
+                 _host_recovery_result()) == 1
+    # a baseline predating the host_recovery row gates only the rest
+    assert _gate(tmp_path, _host_recovery_result(), _result()) == 0
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

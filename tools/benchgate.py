@@ -13,10 +13,11 @@ signal regressed:
 - fleet serving ``requests_per_sec`` or ``prefix_hit_rate`` dropping
   more than the threshold, or ``ttft_mean_s`` rising more than it
   (the shared-prefix wave of bench.py's ``fleet`` gate row),
-- fleet recovery (bench.py's ``fleet_recovery`` chaos row — one
-  replica killed mid-decode): ``requests_completed`` dropping AT ALL
-  (every admitted request must survive the kill; no threshold slack),
-  or ``recovery_s`` rising more than the threshold,
+- chaos recovery (bench.py's ``fleet_recovery`` row — one replica
+  killed mid-decode — and ``host_recovery`` — a whole host's replicas
+  felled at once): ``requests_completed`` dropping AT ALL (every
+  admitted request must survive the kill; no threshold slack), or
+  ``recovery_s`` rising more than the threshold,
 - the candidate missing the flagship metric entirely (a timed-out
   flagship row must fail the gate, not silently pass it — the r05
   failure mode).
@@ -136,17 +137,19 @@ def _fleet_metrics(result):
             if isinstance(fleet.get(m), (int, float))}
 
 
-# fleet-recovery chaos row: one replica is killed mid-decode and the
-# supervisor must drain + restart it. requests_completed is gated with
-# ZERO slack (any drop means an admitted request was lost under the
-# kill); recovery_s gets the normal relative threshold.
+# chaos recovery rows: a replica (fleet_recovery) or a whole host's
+# replicas (host_recovery) are killed mid-decode and the supervisor
+# must drain + restart. requests_completed is gated with ZERO slack
+# (any drop means an admitted request was lost under the kill);
+# recovery_s gets the normal relative threshold. Both rows share the
+# gate shape; they differ only in which bench row they read.
 _RECOVERY_GATES = {"requests_completed": True, "recovery_s": False}
+_RECOVERY_ROWS = ("fleet_recovery", "host_recovery")
 
 
-def _recovery_metrics(result):
-    """{metric: value} for the gated fleet-recovery signals."""
-    rec = ((result.get("extra") or {}).get("fleet_recovery") or {}) \
-        .get("fleet_recovery") or {}
+def _recovery_metrics(result, row):
+    """{metric: value} for one gated chaos-recovery row."""
+    rec = ((result.get("extra") or {}).get(row) or {}).get(row) or {}
     return {m: float(rec[m]) for m in _RECOVERY_GATES
             if isinstance(rec.get(m), (int, float))}
 
@@ -208,31 +211,32 @@ def compare(candidate, baseline, threshold=0.05):
                 f"fleet.{m} {word} {delta * 100:.1f}% "
                 f"(> {threshold * 100:.0f}%)")
 
-    cand_rc = _recovery_metrics(candidate)
-    base_rc = _recovery_metrics(baseline)
-    for m in sorted(set(cand_rc) & set(base_rc)):
-        b, c = base_rc[m], cand_rc[m]
-        if b <= 0:
-            continue
-        if _RECOVERY_GATES[m]:
-            # completed-request count: ANY drop under the injected kill
-            # means a request was lost — no threshold slack.
-            delta = (b - c) / b
-            word = "dropped"
-            budget = 0.0
-        else:
-            delta = (c - b) / b
-            word = "rose"
-            budget = threshold
-        verdict = "FAIL" if delta > budget else "ok"
-        lines.append(
-            f"fleet_recovery.{m}: {b:g} -> {c:g}  "
-            f"({-delta * 100 if _RECOVERY_GATES[m] else delta * 100:+.1f}%) "
-            f"[{verdict}]")
-        if delta > budget:
-            failures.append(
-                f"fleet_recovery.{m} {word} {delta * 100:.1f}% "
-                f"(> {budget * 100:.0f}%)")
+    for row in _RECOVERY_ROWS:
+        cand_rc = _recovery_metrics(candidate, row)
+        base_rc = _recovery_metrics(baseline, row)
+        for m in sorted(set(cand_rc) & set(base_rc)):
+            b, c = base_rc[m], cand_rc[m]
+            if b <= 0:
+                continue
+            if _RECOVERY_GATES[m]:
+                # completed-request count: ANY drop under the injected
+                # kill means a request was lost — no threshold slack.
+                delta = (b - c) / b
+                word = "dropped"
+                budget = 0.0
+            else:
+                delta = (c - b) / b
+                word = "rose"
+                budget = threshold
+            verdict = "FAIL" if delta > budget else "ok"
+            lines.append(
+                f"{row}.{m}: {b:g} -> {c:g}  "
+                f"({-delta * 100 if _RECOVERY_GATES[m] else delta * 100:+.1f}%) "
+                f"[{verdict}]")
+            if delta > budget:
+                failures.append(
+                    f"{row}.{m} {word} {delta * 100:.1f}% "
+                    f"(> {budget * 100:.0f}%)")
     return failures, lines
 
 

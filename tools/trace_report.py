@@ -58,6 +58,15 @@ KNOWN_METRICS = (
     # elastic manager (distributed/elastic.py) + supervisor re-form
     "elastic/heartbeat_errors", "elastic/last_beat_ts",
     "elastic/membership_changes", "elastic/unhealthy_cleared",
+    # host-level fault domains: quorum gate + generation fencing
+    # (distributed/resilience/supervisor.py)
+    "elastic/quorum_checks", "elastic/quorum_ok", "elastic/quorum_lost",
+    "elastic/fenced_writes", "elastic/stale_snapshots_dropped",
+    # replicated rendezvous store: hot standby + client failover
+    # (distributed/store.py)
+    "store/failovers", "store/redials", "store/tailer_drops",
+    "store/replicated_records", "store/replication_naks",
+    "store/standby_takeovers",
     # chaos injector (distributed/resilience/faults.py)
     "faults/injected", "faults/*",
     # self-healing training loop (distributed/resilience/supervisor.py
@@ -85,6 +94,9 @@ KNOWN_METRICS = (
     "serving/replica_failures", "serving/replica_restored",
     "serving/replica_restarts", "serving/drains",
     "serving/drain_requeues",
+    # cross-host serving failover: off-host drain targets + real
+    # TensorTransport KV hand-offs (inference/fleet_supervisor.py)
+    "serving/cross_host_drains", "serving/cross_host_migrations",
     "serving/prefix_hits_restored", "serving/cache_restore_ms",
     "serving/cache_snapshots", "serving/cache_snapshots_swept",
     "serving/cache_snapshots_pruned",
