@@ -557,12 +557,19 @@ def bench_fleet_serving(on_tpu):
     the prefix cache (requests/s, mean TTFT, hit rate: the benchgate
     fleet signals), and (b) the int8 double-buffered weight-streaming
     decode step vs the bf16 non-prefetched baseline (honest min/max
-    spread — decode here is weight-streaming-bound, PR 2)."""
+    spread — decode here is weight-streaming-bound, PR 2).  Tail
+    latencies (ttft p50/p95/p99, tpot percentiles) come from the
+    per-wave child-registry t-digests (PR 10) — honest quantiles, not
+    means — and the wave's request spans land in a chrome-trace
+    artifact next to the bench results."""
     import paddle_tpu as paddle
     from paddle_tpu.inference.serving import (PagedCausalLM,
                                               PagedServingConfig,
                                               ServingEngine)
     from paddle_tpu.inference.weight_stream import measure_stream_win
+    from paddle_tpu.profiler import tracing as _tracing
+
+    _tracing.clear_ring()
 
     if on_tpu:
         n_req, prefix_len, unique_len, max_new = 16, 512, 32, 32
@@ -606,6 +613,10 @@ def bench_fleet_serving(on_tpu):
         eng._requests.clear()
         from paddle_tpu.profiler import metrics as _m
 
+        # per-wave child registry AFTER warm-up: the digest sees only
+        # the timed requests, never the compile-heavy warm request
+        ns = f"wave-{'pc' if prefix_cache else 'nc'}"
+        eng.set_metrics_namespace(ns)
         reused0 = _m.counter("serving/prefix_pages_reused").value
         t0 = time.perf_counter()
         rids = [eng.add_request(p, max_new_tokens=max_new)
@@ -622,11 +633,14 @@ def bench_fleet_serving(on_tpu):
         hit_rate = eng._prefix_cache.hit_rate() \
             if eng._prefix_cache is not None else 0.0
         reused = _m.counter("serving/prefix_pages_reused").value - reused0
+        ttft_h = _m.child(ns).histogram("serving/ttft_ms")
+        qs = {q: ttft_h.quantile(q) for q in (0.5, 0.95, 0.99)}
         return (n_req / dt, float(np.mean(list(ttft.values()))),
-                hit_rate, reused)
+                hit_rate, reused, qs)
 
-    rps_nc, ttft_nc, _, _ = serve_wave(False, seed=1)
-    rps_pc, ttft_pc, hit_rate, pages_reused = serve_wave(True, seed=1)
+    rps_nc, ttft_nc, _, _, _ = serve_wave(False, seed=1)
+    rps_pc, ttft_pc, hit_rate, pages_reused, ttft_qs = \
+        serve_wave(True, seed=1)
 
     # -- int8 double-buffered weight streaming micro-bench ---------------
     def decode_setup(weight_stream):
@@ -642,6 +656,9 @@ def bench_fleet_serving(on_tpu):
         while any(r.length - r.cached > 1 for r in eng.pending()):
             eng.step()
         eng.decode_run(stream_win)          # warm the window executable
+        # child registry AFTER the warm window: the tpot digest sees
+        # only the timed steady-state windows
+        eng.set_metrics_namespace(f"stream-{weight_stream or 'bf16'}")
         return eng
 
     def time_windows(eng, n=3):
@@ -662,6 +679,21 @@ def bench_fleet_serving(on_tpu):
         lambda: eng_stream.decode_run(1) or eng_stream._kc,
         lambda: eng_base.decode_run(1) or eng_base._kc)
 
+    from paddle_tpu.profiler import metrics as _m
+
+    def tpot_qs(ns):
+        h = _m.child(ns).histogram("serving/tpot_ms")
+        return {f"tpot_ms_p{int(q * 100)}": round(h.quantile(q), 3)
+                for q in (0.5, 0.95, 0.99) if h.quantile(q) is not None}
+
+    trace_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_fleet_trace.json")
+    _tracing.export_chrome(trace_path)
+
+    def ms_to_s(v):
+        return round(v / 1e3, 4) if v is not None else None
+
     return {
         "fleet": {
             "n_requests": n_req, "prefix_len": prefix_len,
@@ -671,8 +703,14 @@ def bench_fleet_serving(on_tpu):
             "speedup_vs_nocache": round(rps_pc / rps_nc, 3),
             "ttft_mean_s": round(ttft_pc, 4),
             "ttft_mean_s_nocache": round(ttft_nc, 4),
+            # digest tail latency (engine-side submit->first-token) —
+            # benchgate gates ttft_p95_s with the standard threshold
+            "ttft_p50_s": ms_to_s(ttft_qs.get(0.5)),
+            "ttft_p95_s": ms_to_s(ttft_qs.get(0.95)),
+            "ttft_p99_s": ms_to_s(ttft_qs.get(0.99)),
             "prefix_hit_rate": round(hit_rate, 4),
             "prefix_pages_reused": pages_reused,
+            "trace_artifact": os.path.basename(trace_path),
         },
         "weight_stream": {
             "decode_batch": stream_batch, "window": stream_win,
@@ -685,6 +723,8 @@ def bench_fleet_serving(on_tpu):
             "stream_speedup": round(base_ms[0] / stream_ms[0], 3)
                 if base_ms and stream_ms else None,
             "prefetch_win_ms": round(win_ms, 3),
+            "bf16": tpot_qs("stream-bf16"),
+            "int8_stream": tpot_qs("stream-int8"),
         },
     }
 
@@ -695,7 +735,14 @@ def bench_fleet_recovery(on_tpu):
     Gate signals: every admitted request completes (drain migrates
     decode-tip requests to the peer, requeues the rest) and how many
     seconds the drain + backoff restart takes.  Bitwise parity vs an
-    uninterrupted reference run is recorded alongside."""
+    uninterrupted reference run is recorded alongside.
+
+    PR 10 observability riders: the chaos run's spans export as a
+    merged chrome trace (the drained request's pre- and post-migration
+    spans share one trace id — asserted in ``trace_connected``), the
+    killed engine's flight recorder lands next to the bench results,
+    and an in-process FleetAggregator reports per-replica digest p95
+    TTFT from the replicas' child registries."""
     import paddle_tpu as paddle
     from paddle_tpu.distributed.resilience import faults
     from paddle_tpu.inference.fleet_supervisor import (
@@ -705,6 +752,13 @@ def bench_fleet_recovery(on_tpu):
                                               PagedServingConfig,
                                               SamplingParams,
                                               ServingEngine)
+    from paddle_tpu.profiler import aggregate as _aggregate
+    from paddle_tpu.profiler import metrics as _pmetrics
+    from paddle_tpu.profiler import tracing as _tracing
+
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    flight_dir = os.path.join(bench_dir, "BENCH_flight")
+    _tracing.set_flight_dir(flight_dir)
 
     n_req, prompt_len, max_new = 8, 12, 6
     cfg = PagedServingConfig(
@@ -747,6 +801,9 @@ def bench_fleet_recovery(on_tpu):
 
     faults.arm("kill@decode#2:rank=1")
     router, sup = build()
+    _tracing.clear_ring()                    # chaos-run spans only
+    flight_before = set(os.listdir(flight_dir)) \
+        if os.path.isdir(flight_dir) else set()
     recovery = {}
     on_failure = sup.on_failure
 
@@ -762,6 +819,43 @@ def bench_fleet_recovery(on_tpu):
     faults.disarm()
 
     completed = sum(1 for toks in out.values() if len(toks) == max_new)
+
+    # merged chrome trace + connectivity check: some trace id must hold
+    # BOTH a hand-off-out span (migrate/requeue, recorded on the dying
+    # engine) and its continuation on the surviving peer
+    trace_path = os.path.join(bench_dir, "BENCH_recovery_trace.json")
+    spans = _tracing.ring_spans()
+    _tracing.export_chrome(trace_path, spans=spans)
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+    trace_connected = any(
+        ("serving::migrate" in names and "serving::migrate_in" in names)
+        or "serving::requeue" in names for names in by_trace.values())
+
+    flight_files = sorted(
+        set(os.listdir(flight_dir)) - flight_before) \
+        if os.path.isdir(flight_dir) else []
+    _tracing.set_flight_dir(None)
+
+    # fleet snapshot from the replicas' child registries: per-replica
+    # digest p95 TTFT, the number a FleetGateway would route on
+    agg = _aggregate.FleetAggregator()
+    for rep in router.replicas:
+        ns = getattr(rep.engine, "metrics_namespace", None)
+        if ns is None:
+            continue
+        snap = _pmetrics.child(ns).snapshot()
+        snap["host_id"] = rep.host_id or "local"
+        snap["replica"] = rep.name
+        agg.ingest(snap)
+    ttft_p95 = {
+        f"{host}/{rep}": round(v, 3)
+        for (host, rep) in agg.keys()
+        for v in [agg.percentile("serving/ttft_ms", 0.95,
+                                 host_id=host, replica=rep)]
+        if v is not None}
+
     return {"fleet_recovery": {
         "n_requests": n_req, "max_new": max_new,
         "requests_completed": completed,
@@ -770,6 +864,10 @@ def bench_fleet_recovery(on_tpu):
         "replica_restarts": sum(sup.restarts),
         "drained": len(sup.drained_handles),
         "bitwise_match": out == ref,
+        "trace_artifact": os.path.basename(trace_path),
+        "trace_connected": trace_connected,
+        "flight_dumps": flight_files,
+        "ttft_p95_ms_per_replica": ttft_p95,
     }}
 
 

@@ -16,6 +16,19 @@ is invisible to the tooling — exactly the drift the README's
 one-source-of-truth policy exists to prevent.  Dynamic names (f-strings,
 concatenation) are out of static reach and are covered by the ``*``
 patterns in the known set.
+
+PT404 extends the same policy to trace spans: the names passed to the
+tracing helpers (``tracing.span`` / ``tracing.record_span`` /
+``RecordEvent``) must be literal strings.  Span names are the join key
+for everything downstream — the flight recorder's counter deltas, the
+chrome-trace merge in ``tools/trace_report.py``, and the span summary
+table all aggregate BY NAME — so a name built at runtime (f-string per
+request, concatenated ids) explodes the cardinality of every one of
+those views and makes cross-host merges meaningless.  Variable data
+belongs in the span's ``args``, not its name.  A literal family prefix
+(``RecordEvent("op::" + name)``) is allowed — the prefix keeps the
+family aggregatable, the same escape hatch the ``*`` patterns give
+KNOWN_METRICS.
 """
 from __future__ import annotations
 
@@ -285,3 +298,52 @@ def check_metric_names(mod):
                    f"tools/trace_report.py KNOWN_METRICS — the triage "
                    f"report and README metric inventory won't know it; "
                    f"add it there (or fix the name)")
+
+
+# ---------------------------------------------------------------------------
+# PT404 — span names passed to tracing helpers must be literal strings
+# ---------------------------------------------------------------------------
+
+_SPAN_HELPERS = {"span", "record_span"}
+
+
+def _is_tracing_receiver(node) -> bool:
+    """`tracing.span`, `_tracing.record_span`, `profiler.tracing.span`"""
+    if isinstance(node, ast.Name):
+        return node.id in ("tracing", "_tracing")
+    if isinstance(node, ast.Attribute):
+        return node.attr in ("tracing", "_tracing")
+    return False
+
+
+@rule("PT404", "warning",
+      "span name built at runtime: tracing helpers aggregate by name, "
+      "so non-literal names explode trace cardinality")
+def check_span_name_literals(mod):
+    if mod.relpath.endswith("profiler/tracing.py"):
+        return      # the definition site forwards caller-supplied names
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        is_helper = (isinstance(f, ast.Attribute)
+                     and f.attr in _SPAN_HELPERS
+                     and _is_tracing_receiver(f.value)) \
+            or (isinstance(f, ast.Name) and f.id == "RecordEvent") \
+            or (isinstance(f, ast.Attribute) and f.attr == "RecordEvent")
+        if not is_helper:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            continue
+        # literal family prefix: "op::" + name stays aggregatable
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                and isinstance(arg.left, ast.Constant) \
+                and isinstance(arg.left.value, str) and arg.left.value:
+            continue
+        helper = f.attr if isinstance(f, ast.Attribute) else f.id
+        yield (node.lineno, node.col_offset,
+               f"span name passed to {helper}() is not a string "
+               f"literal — span names are the aggregation key for the "
+               f"flight recorder, trace merge, and span summary; put "
+               f"variable data in the span's args instead")

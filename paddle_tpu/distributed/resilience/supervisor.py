@@ -51,6 +51,7 @@ import numpy as np
 
 from ...profiler import RecordEvent
 from ...profiler import metrics as _metrics
+from ...profiler import tracing as _tracing
 from ..elastic import default_host_id
 from . import backoff as _backoff
 from . import faults as _faults
@@ -72,6 +73,7 @@ _m_snapshots = _metrics.counter("train/snapshots")
 _m_snap_bytes = _metrics.counter("train/snapshot_bytes")
 _m_repl_errors = _metrics.counter("train/replication_errors")
 _m_reform_ms = _metrics.histogram("train/reform_ms")
+_m_step_ms = _metrics.histogram("train/step_ms")
 _m_quorum_checks = _metrics.counter("elastic/quorum_checks")
 _m_quorum_ok = _metrics.counter("elastic/quorum_ok")
 _m_quorum_lost = _metrics.counter("elastic/quorum_lost")
@@ -407,6 +409,10 @@ class Supervisor:
                 return
             if time.time() > deadline:
                 _m_quorum_lost.inc()
+                _tracing.flight_dump(
+                    "quorum_lost", host=self.config.host_id,
+                    alive=sorted(alive), registered=sorted(total),
+                    timeout_s=self.config.reform_timeout_s)
                 raise TimeoutError(
                     f"host quorum lost: only {sorted(alive)} of "
                     f"{sorted(total)} registered hosts alive after "
@@ -700,7 +706,8 @@ class Supervisor:
                     if self.store is not None and self.world > 1:
                         if self.transport is None:
                             t0 = time.perf_counter()
-                            with RecordEvent("train/reform"):
+                            with _tracing.span("train/reform",
+                                               rank=self.rank):
                                 gen = self._form_group(
                                     bump=(not first) or cfg.rejoin)
                                 step, state, _ = self._recover_state(
@@ -773,8 +780,10 @@ class Supervisor:
                 group_ranks=list(range(self.world)), gid=cfg.group_id,
                 guard=self.guard)
             try:
+                t_step0 = time.perf_counter()
                 with RecordEvent("train/step"):
                     new_state, loss = train_step_fn(state, step, ctx)
+                _m_step_ms.observe((time.perf_counter() - t_step0) * 1e3)
                 verdict = self.guard.observe(loss)
             except FloatingPointError:
                 # amp.debugging tensor checker (check_numerics=True)

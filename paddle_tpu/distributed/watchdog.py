@@ -302,6 +302,11 @@ class CommTaskManager:
         the local transport so the blocked rank raises a structured
         CommTimeoutError instead of hanging."""
         _m_escalations.inc()
+        from ..profiler import tracing as _tracing
+
+        _tracing.flight_dump("watchdog_escalation",
+                             stalled=task.to_dict(),
+                             timeout_s=self._timeout_s)
         err = CommTimeoutError(task.op_name, task.group_id, task.seq,
                                task.rank, self._timeout_s)
         try:

@@ -28,6 +28,7 @@ in tests/test_fleet_serving.py.
 from __future__ import annotations
 
 import json
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -38,6 +39,7 @@ from ..distributed.resilience import faults as _faults
 from ..distributed.resilience.errors import (EngineDeadError,
                                              PeerUnreachableError)
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from .serving import SamplingParams, ServingEngine, _Request
 
 __all__ = ["migrate_request", "receive_request", "PrefillWorker",
@@ -83,6 +85,11 @@ def migrate_request(engine: ServingEngine, rid: int, transport,
             _time.sleep(act.delay_ms / 1e3)
     pages = np.asarray(r.pages, np.int32)
     sp = r.sampling
+    # the migrate span's context ships in the meta frame: the receiver
+    # parents its migrate_in span (and everything after) to it, so the
+    # request's pre- and post-migration spans share one trace id
+    t_mig0 = time.perf_counter()
+    mig_ctx = _tracing.child_of(r.trace) if r.trace is not None else None
     meta = {
         "prompt": list(r.prompt),
         "generated": list(r.generated),
@@ -96,6 +103,8 @@ def migrate_request(engine: ServingEngine, rid: int, transport,
         "quant": engine._ks is not None,
         "n_pages": int(pages.size),
     }
+    if mig_ctx is not None:
+        _tracing.inject(meta, mig_ctx)
     transport.send(np.frombuffer(json.dumps(meta).encode(), np.uint8),
                    dst, channel)
     # raw page gather: [L, n_pages, HKV, block_size, D] in the cache
@@ -105,6 +114,11 @@ def migrate_request(engine: ServingEngine, rid: int, transport,
     if meta["quant"]:
         transport.send(np.asarray(engine._ks[:, pages]), dst, channel)
         transport.send(np.asarray(engine._vs[:, pages]), dst, channel)
+    if mig_ctx is not None:
+        _tracing.record_span(
+            "serving::migrate", t_mig0, time.perf_counter(), ctx=mig_ctx,
+            args={"rid": rid, "engine": getattr(engine, "name", "?"),
+                  "dst": dst})
     _m_migrations.inc()
     r.done = True
     engine._release(r)
@@ -116,6 +130,7 @@ def receive_request(engine: ServingEngine, transport, src: int,
     scatter the shipped KV into this engine's pool, and admit the
     request at its decode tip under its ORIGIN salt identity.  Returns
     the local rid."""
+    t_rx0 = time.perf_counter()
     meta = json.loads(bytes(transport.recv(src, channel)).decode())
     kc = transport.recv(src, channel)
     vc = transport.recv(src, channel)
@@ -150,6 +165,16 @@ def receive_request(engine: ServingEngine, transport, src: int,
     # TTFT was observed on the prefill worker (the first token samples
     # there); suppress a second observation on this engine
     req.first_tok_t = req.submit_t
+    # adopt the shipped trace identity: the migrate_in span parents to
+    # the sender's migrate span, and the request's later decode spans
+    # parent to migrate_in — one connected tree across both engines
+    mig_ctx = _tracing.extract(meta)
+    if mig_ctx is not None:
+        req.trace = _tracing.record_span(
+            "serving::migrate_in", t_rx0, time.perf_counter(),
+            parent=mig_ctx,
+            args={"rid": rid, "engine": getattr(engine, "name", "?"),
+                  "src": src})
     engine._requests[rid] = req
     _m_migrations.inc()
     return rid

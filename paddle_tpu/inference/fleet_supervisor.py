@@ -60,6 +60,7 @@ from ..distributed.resilience.errors import (EngineDeadError,
                                              TransportClosedError,
                                              TransportError)
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from .router import ReplicaRouter
 from .serving import EngineOverloadedError, ServingEngine
 
@@ -141,7 +142,14 @@ class FleetSupervisor:
 
     # -- failure entry points --------------------------------------------
     def on_failure(self, idx: int) -> None:
-        """Full recovery for replica ``idx``: drain, then restart."""
+        """Full recovery for replica ``idx``: dump the flight recorder
+        (the killed engine's black box: recent spans, notes, counter
+        deltas, full metrics snapshot), drain, then restart."""
+        rep = self.router.replicas[idx]
+        _tracing.flight_dump(
+            "engine_dead", replica=rep.name,
+            engine=getattr(rep.engine, "name", "?"),
+            host=rep.host_id, replica_idx=idx)
         self.drain(idx)
         if self.cfg.restart:
             self.restart(idx)
@@ -236,6 +244,14 @@ class FleetSupervisor:
             req = dst._requests[new_rid]
             req.salt_rid = r.salt_rid
             req.salt_seed = int(origin_seed)
+            if r.trace is not None:
+                # the drained request keeps its trace: a requeue span
+                # bridges the dead engine's spans to the peer's
+                now = time.perf_counter()
+                req.trace = _tracing.record_span(
+                    "serving::requeue", now, now, parent=r.trace,
+                    args={"rid": new_rid, "engine": dst.name,
+                          "from": getattr(src, "name", "?")})
             h = self.router._by_engine.get((src_idx, rid))
             self._remap(h, src_idx, rid, dst_idx, new_rid)
             # single ownership: the source copy finishes NOW, before the
@@ -308,8 +324,15 @@ class FleetSupervisor:
             if r.done and rid not in new._requests:
                 new._requests[rid] = r
         new.requeue_hook = self.router._make_requeue_hook(idx)
+        # the replacement engine keeps writing the replica's per-replica
+        # metric series, not a fresh (or the global) one
+        if hasattr(new, "set_metrics_namespace"):
+            new.set_metrics_namespace(
+                getattr(old, "metrics_namespace", None) or rep.name)
         rep.engine = new
         _m_restarts.inc()
+        _tracing.flight_note("replica_restart", replica=rep.name,
+                             attempt=self.restarts[idx])
         return True
 
     # -- cache persistence cadence ----------------------------------------

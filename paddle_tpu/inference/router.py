@@ -29,10 +29,12 @@ exact values the ``serving/*`` gauges export.
 """
 from __future__ import annotations
 
+import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..distributed.resilience import faults as _faults
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 from .serving import EngineOverloadedError, ServingEngine
 
 __all__ = ["Replica", "ReplicaRouter", "transport_healthy",
@@ -88,6 +90,13 @@ class Replica:
         self.restore_after = max(int(restore_after), 1)
         self._demoted = False
         self._streak = 0       # consecutive passing half-open probes
+        # bind the engine's serving/* writes to this replica's child
+        # registry (rolls up to the global one) so co-hosted replicas
+        # stop conflating their series; restarted engines re-bind to
+        # the SAME namespace in FleetSupervisor.restart
+        if hasattr(engine, "set_metrics_namespace") \
+                and getattr(engine, "metrics_namespace", None) is None:
+            engine.set_metrics_namespace(self.name)
 
     def _probe_raw(self) -> bool:
         if getattr(self.engine, "dead", False):
@@ -225,6 +234,19 @@ class ReplicaRouter:
                 except EngineOverloadedError:
                     _m_reroutes.inc()
                     continue
+                # the retry joins the original request's trace: a
+                # requeue span bridges the evicted request to its new
+                # replica, and the new request's lifecycle spans parent
+                # under it instead of opening a disconnected trace
+                src_trace = info.get("trace")
+                if src_trace is not None:
+                    now = _time.perf_counter()
+                    new_req = self.replicas[idx].engine._requests[rid]
+                    new_req.trace = _tracing.record_span(
+                        "serving::requeue", now, now, parent=src_trace,
+                        args={"rid": rid,
+                              "engine": self.replicas[idx].engine.name,
+                              "from": self.replicas[src_idx].name})
                 if handle is not None:
                     self._handles[handle] = (idx, rid)
                     self._by_engine[(idx, rid)] = handle

@@ -42,22 +42,35 @@ from ..nn.layer.layers import Layer
 from ..core.dispatch import apply
 from ..profiler import RecordEvent, host_tracing_active
 from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
 
-# always-on serving metrics (profiler/metrics.py): TTFT from request
-# submit to its first sampled token, TPOT from decode_run windows
-# (window wall / steps), plus scheduler gauges the capacity story needs
-_m_ttft = _metrics.histogram("serving/ttft_ms")
-_m_tpot = _metrics.histogram("serving/tpot_ms")
-_m_steps = _metrics.counter("serving/steps")
-_m_tokens = _metrics.counter("serving/tokens_generated")
-_m_requests = _metrics.counter("serving/requests")
-_m_preempt = _metrics.counter("serving/preemptions")
-_m_occupancy = _metrics.gauge("serving/batch_occupancy")
-_m_kv_util = _metrics.gauge("serving/kv_cache_utilization")
-_m_deadline = _metrics.counter("serving/deadline_evictions")
-_m_shed = _metrics.counter("serving/load_shed")
-_m_prefix_rate = _metrics.gauge("serving/prefix_hit_rate")
-_m_prefix_pages = _metrics.counter("serving/prefix_pages_reused")
+
+class _EngineMetrics:
+    """Handle bundle for the serving/* series one engine writes: TTFT
+    from request submit to its first sampled token, TPOT from
+    decode_run windows (window wall / steps), plus scheduler gauges the
+    capacity story needs. Built from a registry so a fleet replica can
+    bind its engine to a per-replica child registry (writes roll up to
+    the global one) instead of conflating co-hosted replicas in the
+    process-wide series — see ServingEngine.set_metrics_namespace."""
+
+    __slots__ = ("ttft", "tpot", "steps", "tokens", "requests",
+                 "preempt", "occupancy", "kv_util", "deadline", "shed",
+                 "prefix_rate", "prefix_pages")
+
+    def __init__(self, reg):
+        self.ttft = reg.histogram("serving/ttft_ms")
+        self.tpot = reg.histogram("serving/tpot_ms")
+        self.steps = reg.counter("serving/steps")
+        self.tokens = reg.counter("serving/tokens_generated")
+        self.requests = reg.counter("serving/requests")
+        self.preempt = reg.counter("serving/preemptions")
+        self.occupancy = reg.gauge("serving/batch_occupancy")
+        self.kv_util = reg.gauge("serving/kv_cache_utilization")
+        self.deadline = reg.counter("serving/deadline_evictions")
+        self.shed = reg.counter("serving/load_shed")
+        self.prefix_rate = reg.gauge("serving/prefix_hit_rate")
+        self.prefix_pages = reg.counter("serving/prefix_pages_reused")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
@@ -471,7 +484,7 @@ class _Request:
                  "cached", "done", "sampling", "eos_token_id",
                  "submit_t", "first_tok_t", "deadline_t", "timed_out",
                  "shared_keys", "prefix_registered", "salt_rid",
-                 "salt_seed")
+                 "salt_seed", "trace", "sched_t0")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
                  deadline_s=None):
@@ -499,6 +512,12 @@ class _Request:
         # its token stream is bitwise-identical to the single-engine path
         self.salt_rid = rid
         self.salt_seed = None      # None = use the engine's seed
+        # distributed-tracing identity: the admission span's context —
+        # every later lifecycle span (queue/prefill/migrate/decode)
+        # parents to it, and it travels in disagg/requeue hand-off
+        # payloads so a migrated request's spans share one trace id
+        self.trace = None
+        self.sched_t0 = None       # first time a step scheduled this row
 
     @property
     def length(self):
@@ -578,6 +597,10 @@ class ServingEngine:
         # into a dead engine raises EngineDeadError until it is replaced
         self.dead = False
         self.name = f"engine{seed}"
+        # serving/* metric handles; set_metrics_namespace rebinds them to
+        # a per-replica child registry (Replica does this at wrap time)
+        self.metrics_namespace = None
+        self._m = _EngineMetrics(_metrics.registry())
         # rank the chaos injector sees for this engine's fault sites, so
         # PT_FAULT_PLAN ":rank=R" clauses target one replica of a fleet
         self.fault_rank = 0
@@ -693,7 +716,7 @@ class ServingEngine:
             raise ValueError("prompt + max_new_tokens exceeds max_seq")
         if self.cfg.max_queue is not None \
                 and len(self.pending()) >= self.cfg.max_queue:
-            _m_shed.inc()
+            self._m.shed.inc()
             raise EngineOverloadedError(
                 f"engine saturated: {len(self.pending())} live requests "
                 f">= max_queue={self.cfg.max_queue}; shed this request "
@@ -704,8 +727,23 @@ class ServingEngine:
                        sampling, eos_token_id, deadline_s=deadline_s)
         self._requests[rid] = req
         self._try_prefix_match(req)
-        _m_requests.inc()
+        # root (or ambient-parented) span of this request's trace; the
+        # request adopts its context so every later lifecycle span links
+        req.trace = _tracing.record_span(
+            "serving::admit", req.submit_t, time.perf_counter(),
+            args={"rid": rid, "engine": self.name})
+        self._m.requests.inc()
         return rid
+
+    def set_metrics_namespace(self, namespace):
+        """Bind this engine's serving/* writes to the named child
+        registry of the global one (per-replica series that roll up),
+        or back to the global registry when `namespace` is None."""
+        self.metrics_namespace = namespace
+        reg = _metrics.registry() if namespace is None \
+            else _metrics.child(namespace)
+        self._m = _EngineMetrics(reg)
+        return self._m
 
     def _try_prefix_match(self, req):
         """Map the request's leading full prompt blocks onto cached pages
@@ -719,8 +757,8 @@ class ServingEngine:
             req.pages = list(pages)
             req.shared_keys = keys
             req.cached = n_tok
-            _m_prefix_pages.inc(len(pages))
-        _m_prefix_rate.set(cache.hit_rate())
+            self._m.prefix_pages.inc(len(pages))
+        self._m.prefix_rate.set(cache.hit_rate())
 
     def _maybe_register_prefix(self, req):
         """After a request's prompt is fully prefilled, publish its full
@@ -746,7 +784,7 @@ class ServingEngine:
                 r.timed_out = True
                 r.done = True
                 self._release(r)
-                _m_deadline.inc()
+                self._m.deadline.inc()
                 if self.requeue_hook is not None:
                     self.requeue_hook(self._requeue_info(r))
 
@@ -759,7 +797,9 @@ class ServingEngine:
         return {"rid": r.rid, "prompt": list(r.prompt),
                 "generated": list(r.generated), "max_new": r.max_new,
                 "sampling": r.sampling, "eos_token_id": r.eos_token_id,
-                "timed_out": True}
+                "timed_out": True,
+                "trace": r.trace.to_dict() if r.trace is not None
+                else None}
 
     def timed_out_requests(self):
         """rids evicted by the deadline sweep (serving front-end: 504)."""
@@ -827,13 +867,30 @@ class ServingEngine:
     def _note_first_token(self, req, now):
         if req.first_tok_t is None:
             req.first_tok_t = now
-            _m_ttft.observe((now - req.submit_t) * 1e3)
+            self._m.ttft.observe((now - req.submit_t) * 1e3)
+            if req.trace is not None:
+                begin = req.sched_t0 if req.sched_t0 is not None \
+                    else req.submit_t
+                _tracing.record_span(
+                    "serving::prefill", begin, now, parent=req.trace,
+                    args={"rid": req.rid, "engine": self.name})
+
+    def _trace_done(self, req, now):
+        """Close the request's decode span (first token -> completion)."""
+        if req.trace is None:
+            return
+        begin = req.first_tok_t if req.first_tok_t is not None \
+            else req.submit_t
+        _tracing.record_span(
+            "serving::decode", begin, now, parent=req.trace,
+            args={"rid": req.rid, "engine": self.name,
+                  "tokens": len(req.generated)})
 
     def _update_pool_gauges(self, n_rows):
         cfg = self.cfg
-        _m_occupancy.set(n_rows / max(cfg.max_batch, 1))
+        self._m.occupancy.set(n_rows / max(cfg.max_batch, 1))
         live = cfg.num_blocks - 1 - len(self._free_pages)  # page 0 = trash
-        _m_kv_util.set(live / max(cfg.num_blocks - 1, 1))
+        self._m.kv_util.set(live / max(cfg.num_blocks - 1, 1))
 
     def _take_free_page(self):
         """Pop one free page, reclaiming zero-ref prefix-cache pages
@@ -940,7 +997,7 @@ class ServingEngine:
                 # every pass would spin this loop forever)
                 self._try_prefix_match(victim)
             preempted.add(victim.rid)
-            _m_preempt.inc()
+            self._m.preempt.inc()
             rows = self._schedule()
         if not rows:
             return []
@@ -952,7 +1009,17 @@ class ServingEngine:
             self._fault_event("prefill")
         if any(r.cached >= len(r.prompt) for r, _ in rows):
             self._fault_event("decode")
-        _m_steps.inc()
+        self._m.steps.inc()
+        # first scheduling of a request ends its queue span
+        now_sched = time.perf_counter()
+        for r, _chunk in rows:
+            if r.sched_t0 is None:
+                r.sched_t0 = now_sched
+                if r.trace is not None:
+                    _tracing.record_span(
+                        "serving::queue", r.submit_t, now_sched,
+                        parent=r.trace,
+                        args={"rid": r.rid, "engine": self.name})
 
         B1 = cfg.max_batch + 1
         enc = np.zeros(B1, np.int32)
@@ -1039,7 +1106,8 @@ class ServingEngine:
                         and nxt == r.eos_token_id):
                 r.done = True
                 self._release(r)
-        _m_tokens.inc(len(produced))
+                self._trace_done(r, now)
+        self._m.tokens.inc(len(produced))
         return produced
 
     # -- multi-step decode (one device program per window) ---------------
@@ -1137,7 +1205,7 @@ class ServingEngine:
             self._ensure_pages(r, r.cached + n)
             self._maybe_register_prefix(r)
         self._update_pool_gauges(B)
-        _m_steps.inc(n)
+        self._m.steps.inc(n)
 
         enc = np.zeros(B1, np.int32)
         this = np.zeros(B1, np.int32)
@@ -1190,7 +1258,7 @@ class ServingEngine:
             self._ks, self._vs = scales
         fetched = np.asarray(samples)                    # [n, B1] — sync
         now = time.perf_counter()
-        _m_tpot.observe((now - t_start) / n * 1e3)
+        self._m.tpot.observe((now - t_start) / n * 1e3)
         produced = []
         for j in range(n):
             for i, r in enumerate(rows):
@@ -1206,7 +1274,8 @@ class ServingEngine:
                             and nxt == r.eos_token_id):
                     r.done = True
                     self._release(r)
-        _m_tokens.inc(len(produced))
+                    self._trace_done(r, now)
+        self._m.tokens.inc(len(produced))
         return produced
 
     def run_to_completion(self, max_steps=1000):

@@ -22,7 +22,8 @@ from . import metrics
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "SummaryView", "metrics",
-           "host_tracing_active"]
+           "host_tracing_active", "tracing", "digest", "aggregate",
+           "TraceContext"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -257,3 +258,12 @@ class Profiler:
         if ips is not None:
             msg += f", ips: {ips.mean():.1f} {unit}/s"
         return msg
+
+
+# fleet observability plane — imported last: tracing layers TraceContext
+# propagation on RecordEvent (above), aggregate ships registry snapshots
+# across processes, digest is the mergeable quantile sketch both use.
+from . import digest           # noqa: E402
+from . import tracing          # noqa: E402
+from . import aggregate        # noqa: E402
+from .tracing import TraceContext  # noqa: E402

@@ -25,9 +25,11 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from .digest import QuantileDigest
+
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "registry", "counter", "gauge", "histogram",
+    "registry", "counter", "gauge", "histogram", "child",
     "inc", "set_gauge", "observe", "timed",
     "snapshot", "to_json", "to_prometheus_text", "snapshot_to_file",
     "enable_periodic_flush", "disable_periodic_flush", "reset",
@@ -99,15 +101,19 @@ class Gauge:
 
 
 class Histogram:
-    """Fixed-bucket histogram tracking count/sum/min/max.
+    """Fixed-bucket histogram tracking count/sum/min/max, plus a
+    mergeable t-digest for honest tail quantiles.
 
     Buckets are upper bounds (le); `observe` finds the first bound >= v
     with a linear scan (bucket lists are short and observation cost must
-    stay O(ns), not O(log n) with allocation).
+    stay O(ns), not O(log n) with allocation). The digest rides along so
+    `quantile(0.99)` answers from the actual value stream instead of a
+    bucket upper bound, and so per-replica histograms merge into fleet
+    percentiles in `profiler.aggregate`.
     """
 
     __slots__ = ("name", "buckets", "_counts", "_count", "_sum",
-                 "_min", "_max", "_lock")
+                 "_min", "_max", "_digest", "_lock")
     kind = "histogram"
 
     def __init__(self, name: str, buckets: Tuple[float, ...] = None):
@@ -118,6 +124,7 @@ class Histogram:
         self._sum = 0.0
         self._min = None
         self._max = None
+        self._digest = QuantileDigest()
         self._lock = threading.Lock()
 
     def observe(self, v):
@@ -135,6 +142,7 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            self._digest.observe(v)
 
     @property
     def count(self):
@@ -144,6 +152,12 @@ class Histogram:
     def sum(self):
         return self._sum
 
+    def quantile(self, q: float):
+        """Digest-estimated quantile of the observed stream (honest
+        p50/p95/p99, not a bucket bound); None while empty."""
+        with self._lock:
+            return self._digest.quantile(q)
+
     def _reset(self):
         with self._lock:
             self._counts = [0] * (len(self.buckets) + 1)
@@ -151,6 +165,7 @@ class Histogram:
             self._sum = 0.0
             self._min = None
             self._max = None
+            self._digest._reset()
 
     def _snap(self):
         with self._lock:
@@ -163,7 +178,74 @@ class Histogram:
                 "buckets": {str(b): c for b, c in
                             zip(self.buckets, self._counts)},
                 "inf": self._counts[-1],
+                "p50": self._digest.quantile(0.5),
+                "p95": self._digest.quantile(0.95),
+                "p99": self._digest.quantile(0.99),
+                "digest": self._digest.to_dict(),
             }
+
+
+class _FanoutCounter:
+    """Child-registry counter: writes land on the local (per-namespace)
+    counter AND roll up into the parent registry's same-name counter.
+    Reads delegate to the local metric."""
+
+    __slots__ = ("local", "up")
+    kind = "counter"
+
+    def __init__(self, local, up):
+        self.local = local
+        self.up = up
+
+    def inc(self, v=1):
+        self.local.inc(v)
+        self.up.inc(v)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "local"), item)
+
+
+class _FanoutGauge:
+    __slots__ = ("local", "up")
+    kind = "gauge"
+
+    def __init__(self, local, up):
+        self.local = local
+        self.up = up
+
+    def set(self, v):
+        self.local.set(v)
+        self.up.set(v)
+
+    def inc(self, v=1):
+        self.local.inc(v)
+        self.up.inc(v)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "local"), item)
+
+
+class _FanoutHistogram:
+    __slots__ = ("local", "up")
+    kind = "histogram"
+
+    def __init__(self, local, up):
+        self.local = local
+        self.up = up
+
+    def observe(self, v):
+        self.local.observe(v)
+        self.up.observe(v)
+
+    def quantile(self, q):
+        return self.local.quantile(q)
+
+    def __getattr__(self, item):
+        return getattr(object.__getattribute__(self, "local"), item)
+
+
+_FANOUT = {"counter": _FanoutCounter, "gauge": _FanoutGauge,
+           "histogram": _FanoutHistogram}
 
 
 class MetricsRegistry:
@@ -172,11 +254,19 @@ class MetricsRegistry:
     Lookup (`counter`/`gauge`/`histogram`) is get-or-create; hot call
     sites should hold the returned object instead of re-looking-up per
     event. Requesting an existing name as a different kind raises.
+
+    `child(namespace)` returns a namespaced child registry whose metric
+    writes fan out to both the child's own metrics and this registry's
+    same-name metrics — the mechanism that keeps co-hosted serving
+    replicas from conflating their `serving/*` series while the global
+    rollup stays intact.
     """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
+        self._children: Dict[str, "ChildRegistry"] = {}
+        self.namespace: Optional[str] = None
         self._flush_thread: Optional[threading.Thread] = None
         self._flush_stop = threading.Event()
         self._flush_path: Optional[str] = None
@@ -206,17 +296,37 @@ class MetricsRegistry:
     def histogram(self, name: str, buckets=None) -> Histogram:
         return self._get(name, Histogram, buckets)
 
+    def child(self, namespace: str) -> "ChildRegistry":
+        """Get-or-create the namespaced child registry (e.g. one per
+        serving replica). Stable: the same namespace always returns the
+        same child, so a FleetSupervisor-restarted engine re-binds to
+        its replica's existing series."""
+        with self._lock:
+            c = self._children.get(namespace)
+            if c is None:
+                c = self._children[namespace] = ChildRegistry(
+                    self, namespace)
+            return c
+
+    def children(self) -> Dict[str, "ChildRegistry"]:
+        with self._lock:
+            return dict(self._children)
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._metrics)
 
     def reset(self):
         """Zero every metric IN PLACE (instrumented modules hold direct
-        references to metric objects, so they must not be replaced)."""
+        references to metric objects, so they must not be replaced).
+        Child registries are zeroed too."""
         with self._lock:
             metrics = list(self._metrics.values())
+            children = list(self._children.values())
         for m in metrics:
             m._reset()
+        for c in children:
+            c.reset()
 
     # -- exporters --------------------------------------------------------
     def snapshot(self) -> dict:
@@ -224,6 +334,8 @@ class MetricsRegistry:
             metrics = dict(self._metrics)
         out = {"ts": time.time(), "pid": os.getpid(),
                "counters": {}, "gauges": {}, "histograms": {}}
+        if self.namespace is not None:
+            out["namespace"] = self.namespace
         for name in sorted(metrics):
             m = metrics[name]
             out[m.kind + "s"][name] = m._snap()
@@ -322,11 +434,48 @@ class MetricsRegistry:
         self._flush_path = None
 
 
+class ChildRegistry(MetricsRegistry):
+    """Namespaced registry whose metrics fan out to a parent.
+
+    `child.counter("serving/requests").inc()` bumps both the child's
+    local counter (per-replica truth, what `snapshot()` reports) and
+    the parent registry's counter of the same name (the global rollup
+    existing dashboards and tests read)."""
+
+    def __init__(self, parent: MetricsRegistry, namespace: str):
+        super().__init__()
+        self.parent = parent
+        self.namespace = namespace
+
+    def _get(self, name, cls, *args):
+        m = self._metrics.get(name)
+        if m is not None:
+            if m.kind != cls.kind:
+                raise TypeError(
+                    f"metric '{name}' already registered as {m.kind}")
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                up = self.parent._get(name, cls, *args)
+                local = cls(name, *args)
+                m = self._metrics[name] = _FANOUT[cls.kind](local, up)
+            elif m.kind != cls.kind:
+                raise TypeError(
+                    f"metric '{name}' already registered as {m.kind}")
+            return m
+
+
 _REGISTRY = MetricsRegistry()
 
 
 def registry() -> MetricsRegistry:
     return _REGISTRY
+
+
+def child(namespace: str) -> ChildRegistry:
+    """Namespaced child of the process-wide registry."""
+    return _REGISTRY.child(namespace)
 
 
 def counter(name: str) -> Counter:

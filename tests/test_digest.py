@@ -1,0 +1,225 @@
+"""Mergeable quantile sketch (ISSUE 11): t-digest accuracy against
+numpy.percentile, merge associativity, bounded memory, JSON transport,
+and the registry integration (Histogram digests + per-replica child
+registries with fan-out writes).
+"""
+import bisect
+import json
+
+import numpy as np
+import pytest
+
+from paddle_tpu.profiler import metrics as _metrics
+from paddle_tpu.profiler.digest import QuantileDigest
+
+QS = (0.5, 0.95, 0.99)
+
+
+def _rank_error(sorted_vals, est, q):
+    """|empirical rank of the estimate - q| — the honest accuracy metric
+    for a quantile sketch (value-space error is scale-dependent)."""
+    lo = bisect.bisect_left(sorted_vals, est)
+    hi = bisect.bisect_right(sorted_vals, est)
+    pos = (lo + hi) / 2.0
+    return abs(pos / len(sorted_vals) - q)
+
+
+def _assert_accurate(values, dg, tol=0.015):
+    sv = sorted(values)
+    for q in QS:
+        est = dg.quantile(q)
+        assert est is not None
+        assert _rank_error(sv, est, q) < tol, \
+            f"q={q}: est {est} off by {_rank_error(sv, est, q):.4f} rank"
+
+
+# ---------------------------------------------------------------------------
+# accuracy vs numpy.percentile
+# ---------------------------------------------------------------------------
+
+def test_uniform_accuracy():
+    vals = np.random.RandomState(0).uniform(0, 1000, 100_000)
+    dg = QuantileDigest()
+    dg.update_many(vals)
+    _assert_accurate(vals, dg)
+    # tails are exact
+    assert dg.min == pytest.approx(vals.min())
+    assert dg.max == pytest.approx(vals.max())
+    assert dg.quantile(0.0) <= np.percentile(vals, 1)
+    assert dg.quantile(1.0) == pytest.approx(vals.max())
+
+
+def test_lognormal_accuracy():
+    """Heavy right tail — the latency shape the digest exists for."""
+    vals = np.random.RandomState(1).lognormal(3.0, 1.5, 100_000)
+    dg = QuantileDigest()
+    dg.update_many(vals)
+    _assert_accurate(vals, dg)
+    # value-space check on the tail too: within 5% of the true p99
+    assert dg.quantile(0.99) == pytest.approx(
+        np.percentile(vals, 99), rel=0.05)
+
+
+def test_adversarial_sorted_stream():
+    """A pre-sorted stream is the classic clustering-quality killer:
+    every buffer flush sees monotone data."""
+    vals = np.sort(np.random.RandomState(2).uniform(0, 1e6, 100_000))
+    dg = QuantileDigest()
+    dg.update_many(vals)
+    _assert_accurate(vals, dg)
+    # and reversed
+    dg2 = QuantileDigest()
+    dg2.update_many(vals[::-1])
+    _assert_accurate(vals, dg2)
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+def test_merge_matches_whole_stream():
+    rng = np.random.RandomState(3)
+    parts = [rng.lognormal(2.0, 1.0, 11_111) for _ in range(9)]
+    whole = np.concatenate(parts)
+    merged = QuantileDigest()
+    for p in parts:
+        part = QuantileDigest()
+        part.update_many(p)
+        merged.merge(part)
+    assert merged.count == whole.size
+    assert merged.min == pytest.approx(whole.min())
+    assert merged.max == pytest.approx(whole.max())
+    _assert_accurate(whole, merged)
+
+
+def test_merge_associativity():
+    """((a+b)+c) and (a+(b+c)) must quote the same percentiles (within
+    sketch tolerance) — the fleet aggregator merges replicas in
+    whatever order snapshots arrive."""
+    rng = np.random.RandomState(4)
+    streams = [rng.uniform(0, 100, 20_000),
+               rng.uniform(50, 300, 20_000),
+               rng.lognormal(2, 1, 20_000)]
+    whole = sorted(np.concatenate(streams))
+
+    def dg(v):
+        d = QuantileDigest()
+        d.update_many(v)
+        return d
+
+    left = dg(streams[0]).merge(dg(streams[1])).merge(dg(streams[2]))
+    right = dg(streams[0]).merge(dg(streams[1]).merge(dg(streams[2])))
+    for q in QS:
+        assert _rank_error(whole, left.quantile(q), q) < 0.015
+        assert _rank_error(whole, right.quantile(q), q) < 0.015
+        # both orders agree with each other in rank space
+        assert abs(_rank_error(whole, left.quantile(q), q)
+                   - _rank_error(whole, right.quantile(q), q)) < 0.02
+
+
+def test_merge_empty_is_noop():
+    dg = QuantileDigest()
+    dg.update_many([1.0, 2.0, 3.0])
+    before = dg.quantile(0.5)
+    dg.merge(QuantileDigest())
+    assert dg.count == 3
+    assert dg.quantile(0.5) == before
+
+
+# ---------------------------------------------------------------------------
+# bounded memory
+# ---------------------------------------------------------------------------
+
+def test_fixed_memory_at_1e6_observations():
+    """The whole point: retained points stay O(compression) no matter
+    how long the stream runs."""
+    dg = QuantileDigest(compression=128)
+    rng = np.random.RandomState(5)
+    sizes = []
+    for _ in range(100):
+        dg.update_many(rng.uniform(0, 1e3, 10_000))
+        sizes.append(dg.size())
+    assert dg.count == 1_000_000
+    bound = 2 * dg.compression + dg._buf_cap
+    assert max(sizes) <= bound
+    dg._compress()
+    assert dg.size() < 2 * dg.compression      # post-compression bound
+    # still accurate at the end of the long stream
+    assert dg.quantile(0.5) == pytest.approx(500.0, rel=0.05)
+    assert dg.quantile(0.99) == pytest.approx(990.0, rel=0.05)
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+def test_json_roundtrip_preserves_quantiles():
+    dg = QuantileDigest()
+    dg.update_many(np.random.RandomState(6).lognormal(1, 1, 50_000))
+    back = QuantileDigest.from_dict(
+        json.loads(json.dumps(dg.to_dict())))
+    assert back.count == dg.count
+    assert back.min == dg.min and back.max == dg.max
+    for q in QS:
+        assert back.quantile(q) == pytest.approx(dg.quantile(q))
+
+
+def test_empty_and_degenerate():
+    dg = QuantileDigest()
+    assert dg.quantile(0.5) is None
+    assert dg.count == 0 and dg.min is None and dg.max is None
+    dg.observe(7.0)
+    assert dg.quantile(0.0) == 7.0
+    assert dg.quantile(0.5) == 7.0
+    assert dg.quantile(1.0) == 7.0
+    with pytest.raises(ValueError):
+        QuantileDigest(compression=4)
+
+
+# ---------------------------------------------------------------------------
+# registry integration: Histogram digests + child registries
+# ---------------------------------------------------------------------------
+
+def test_histogram_snapshot_carries_digest_percentiles():
+    reg = _metrics.MetricsRegistry()
+    h = reg.histogram("serving/ttft_ms")
+    for v in range(1, 101):
+        h.observe(float(v))
+    snap = reg.snapshot()["histograms"]["serving/ttft_ms"]
+    assert snap["count"] == 100
+    assert snap["p50"] == pytest.approx(50.0, abs=2.0)
+    assert snap["p95"] == pytest.approx(95.0, abs=2.0)
+    assert snap["p99"] == pytest.approx(99.0, abs=2.0)
+    # the embedded digest reproduces the registry-side quantile exactly
+    dg = QuantileDigest.from_dict(snap["digest"])
+    assert dg.quantile(0.95) == h.quantile(0.95)
+
+
+def test_child_registry_fanout_writes_both():
+    reg = _metrics.MetricsRegistry()
+    child = reg.child("r0")
+    child.counter("serving/requests").inc(3)
+    child.gauge("serving/batch_occupancy").set(0.5)
+    child.histogram("serving/ttft_ms").observe(12.0)
+    # child series AND the parent rollup both saw the writes
+    assert child.snapshot()["counters"]["serving/requests"] == 3
+    assert reg.snapshot()["counters"]["serving/requests"] == 3
+    assert reg.snapshot()["histograms"]["serving/ttft_ms"]["count"] == 1
+    assert child.snapshot()["namespace"] == "r0"
+    # same namespace -> same child (stable identity for a replica)
+    assert reg.child("r0") is child
+    # two namespaces do NOT conflate (the PR-9 bug this fixes)
+    other = reg.child("r1")
+    other.histogram("serving/ttft_ms").observe(999.0)
+    assert child.snapshot()["histograms"]["serving/ttft_ms"]["count"] == 1
+    assert other.snapshot()["histograms"]["serving/ttft_ms"]["count"] == 1
+    assert reg.snapshot()["histograms"]["serving/ttft_ms"]["count"] == 2
+
+
+def test_child_registry_reset_with_parent():
+    reg = _metrics.MetricsRegistry()
+    child = reg.child("rep")
+    child.counter("serving/requests").inc()
+    reg.reset()
+    assert child.snapshot()["counters"]["serving/requests"] == 0
+    assert reg.snapshot()["counters"]["serving/requests"] == 0

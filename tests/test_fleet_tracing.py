@@ -1,0 +1,380 @@
+"""Fleet-wide distributed tracing (ISSUE 11): trace contexts threaded
+through the serving request lifecycle and across migration/requeue
+hand-offs, the always-on span ring, the crash flight recorder, and the
+fleet metrics aggregation plane (snapshot shipping, digest rollup,
+clock-offset estimation, stragglers).
+
+The load-bearing invariant: a request that moves between engines —
+disagg migration or drain under chaos — keeps ONE trace id, so the
+merged chrome trace shows its admission, queue, prefill, hand-off, and
+decode spans as one connected tree.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.inference import disagg
+from paddle_tpu.inference.fleet_supervisor import (FleetSupervisor,
+                                                   FleetSupervisorConfig,
+                                                   LoopbackTransport)
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import (PagedCausalLM,
+                                          PagedServingConfig,
+                                          SamplingParams, ServingEngine)
+from paddle_tpu.profiler import aggregate as _aggregate
+from paddle_tpu.profiler import metrics as _metrics
+from paddle_tpu.profiler import tracing
+
+
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.clear_ring()
+    yield
+    faults.disarm()
+    tracing.set_flight_dir(None)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def _fresh_engine(model, seed=0, **over):
+    cfg = PagedServingConfig(**{**BASE, **over})
+    return ServingEngine.from_model(model, cfg, seed=seed)
+
+
+def _build_fleet(model, **over):
+    def factory(idx):
+        eng = _fresh_engine(model, seed=10 + idx, **over)
+        eng.fault_rank = idx
+        return eng
+
+    router = ReplicaRouter([Replica(factory(i), name=f"r{i}",
+                                    restore_after=2)
+                            for i in range(2)])
+    sup = FleetSupervisor(router, engine_factory=factory,
+                          cfg=FleetSupervisorConfig(backoff_base_s=0.0))
+    return router, sup
+
+
+def _submit_wave(router, max_new=6):
+    rng = np.random.RandomState(31)
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+    return [router.submit(list(rng.randint(1, 90, n)),
+                          max_new_tokens=max_new, sampling=sp)
+            for n in (9, 11, 7, 13)]
+
+
+def _spans_by_trace():
+    by = {}
+    for s in tracing.ring_spans():
+        by.setdefault(s["trace_id"], []).append(s)
+    return by
+
+
+# ---------------------------------------------------------------------------
+# trace contexts, spans, ring
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_via_contextvar():
+    assert tracing.current() is None
+    with tracing.span("outer", k=1) as outer:
+        assert tracing.current() is outer.ctx
+        with tracing.span("inner") as inner:
+            assert inner.ctx.trace_id == outer.ctx.trace_id
+            assert inner.ctx.parent_id == outer.ctx.span_id
+    assert tracing.current() is None
+    names = {s["name"]: s for s in tracing.ring_spans()}
+    assert set(names) >= {"outer", "inner"}
+    assert names["inner"]["parent_id"] == names["outer"]["span_id"]
+    assert names["outer"]["parent_id"] is None
+    assert names["outer"]["args"] == {"k": 1}
+
+
+def test_record_span_chaining_and_meta_roundtrip():
+    root = tracing.record_span("serving::admit", 0.0, 0.1)
+    child = tracing.record_span("serving::queue", 0.1, 0.2, parent=root)
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    meta = tracing.inject({}, tracing.child_of(root))
+    back = tracing.extract(json.loads(json.dumps(meta)))
+    assert back.trace_id == root.trace_id
+    assert back.parent_id == root.span_id
+    assert tracing.extract({}) is None
+    assert tracing.extract(None) is None
+
+
+def test_span_ring_is_bounded():
+    cap = tracing._ring.maxlen
+    for i in range(cap + 500):
+        tracing.record_span("serving::admit", 0.0, 0.0)
+    assert len(tracing.ring_spans()) == cap
+
+
+def test_export_chrome_ids_and_clock_offset(tmp_path):
+    ctx = tracing.record_span("train/step", 1.0, 1.5, args={"rank": 0})
+    path = str(tmp_path / "t.json")
+    doc = tracing.export_chrome(path, clock_offset_s=2.0)
+    ev = [e for e in doc["traceEvents"] if e["name"] == "train/step"][0]
+    assert ev["ts"] == pytest.approx(3.0 * 1e6)
+    assert ev["dur"] == pytest.approx(0.5 * 1e6)
+    assert ev["args"]["trace_id"] == ctx.trace_id
+    assert ev["args"]["rank"] == 0
+    assert json.load(open(path)) == doc
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_recorder_dump(tmp_path):
+    tracing.set_flight_dir(str(tmp_path))
+    tracing.flight_note("probe", detail="before the crash")
+    tracing.record_span("serving::decode", 0.0, 0.1)
+    path = tracing.flight_dump("engine_dead", replica="r1")
+    assert path is not None and os.path.isfile(path)
+    doc = json.load(open(path))
+    assert doc["reason"] == "engine_dead"
+    assert doc["meta"] == {"replica": "r1"}
+    assert any(e["kind"] == "probe" for e in doc["events"])
+    # span completions mirror into the black box
+    assert any(e.get("name") == "serving::decode" for e in doc["events"])
+    assert "counter_deltas" in doc and "metrics" in doc
+    # unconfigured -> silent no-op, never an exception
+    tracing.set_flight_dir(None)
+    assert tracing.flight_dump("engine_dead") is None
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle spans: admission -> queue -> prefill -> decode
+# ---------------------------------------------------------------------------
+
+def test_request_lifecycle_spans_single_engine(model):
+    eng = _fresh_engine(model)
+    rid = eng.add_request(list(range(1, 10)), max_new_tokens=4)
+    eng.run_to_completion()
+    tid = eng._requests[rid].trace.trace_id
+    spans = _spans_by_trace()[tid]
+    names = [s["name"] for s in spans]
+    for phase in ("serving::admit", "serving::queue",
+                  "serving::prefill", "serving::decode"):
+        assert phase in names, f"missing {phase} in {names}"
+    by_id = {s["span_id"]: s for s in spans}
+    admit = next(s for s in spans if s["name"] == "serving::admit")
+    for s in spans:
+        if s is admit:
+            assert s["parent_id"] is None
+        else:       # every later phase hangs off the admit root
+            assert s["parent_id"] in by_id or s["parent_id"] == \
+                admit["span_id"]
+
+
+def test_disagg_migration_shares_trace_id(model):
+    """Explicit prefill->decode hand-off: the shipped meta carries the
+    trace context; the receiver's migrate_in span parents to the
+    sender's migrate span."""
+    src = _fresh_engine(model, seed=1)
+    dst = _fresh_engine(model, seed=1)
+    tp = LoopbackTransport()
+    rid = src.add_request(list(range(1, 12)), max_new_tokens=5)
+    while not (src._requests[rid].generated
+               and src._requests[rid].length - src._requests[rid].cached
+               == 1):
+        src.step()
+    tid = src._requests[rid].trace.trace_id
+    disagg.migrate_request(src, rid, tp, dst=1)
+    new_rid = disagg.receive_request(dst, tp, src=0)
+    while not dst._requests[new_rid].done:
+        dst.step()
+    spans = _spans_by_trace()[tid]
+    names = {s["name"]: s for s in spans}
+    assert "serving::migrate" in names and "serving::migrate_in" in names
+    assert names["serving::migrate_in"]["parent_id"] == \
+        names["serving::migrate"]["span_id"]
+    # the receiver's decode span continues the SAME trace
+    decodes = [s for s in spans if s["name"] == "serving::decode"]
+    assert decodes and all(s["trace_id"] == tid for s in decodes)
+    assert dst._requests[new_rid].trace.trace_id == tid
+
+
+# ---------------------------------------------------------------------------
+# e2e: kill@decode chaos -> connected tree + flight dump (the ISSUE 11
+# acceptance path)
+# ---------------------------------------------------------------------------
+
+def test_kill_mid_decode_trace_tree_connected(model, tmp_path):
+    tracing.set_flight_dir(str(tmp_path))
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+    assert all(len(out[h]) == 6 for h in hs)       # nothing lost
+
+    # the killed engine's flight recorder hit the disk
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flight_engine_dead")]
+    assert len(dumps) == 1
+    doc = json.load(open(tmp_path / dumps[0]))
+    assert doc["meta"]["replica"] == "r1"
+    assert doc["metrics"]["counters"].get("serving/replica_failures")
+
+    # some drained request's pre- and post-hand-off spans share a trace
+    bridged = [
+        (tid, {s["name"] for s in spans})
+        for tid, spans in _spans_by_trace().items()
+        if {"serving::migrate", "serving::migrate_in"} <= {
+            s["name"] for s in spans}
+        or "serving::requeue" in {s["name"] for s in spans}]
+    assert bridged, "no trace survived the hand-off with one trace id"
+    # and at least one bridged trace starts at an admission root
+    assert any("serving::admit" in names for _, names in bridged)
+
+
+def test_requeue_drain_bridges_trace(model, tmp_path):
+    """kill at prefill -> no decode tip -> requeue fallback; the peer's
+    request continues the origin trace through a serving::requeue
+    span."""
+    tracing.set_flight_dir(str(tmp_path))
+    faults.arm("kill@prefill#1:rank=1")
+    router, sup = _build_fleet(model)
+    hs = _submit_wave(router)
+    out = router.run_to_completion()
+    faults.disarm()
+    assert all(len(out[h]) == 6 for h in hs)
+    requeued = [tid for tid, spans in _spans_by_trace().items()
+                if any(s["name"] == "serving::requeue" for s in spans)]
+    assert requeued
+    spans = _spans_by_trace()[requeued[0]]
+    names = {s["name"] for s in spans}
+    assert "serving::admit" in names       # origin admission, same trace
+
+
+# ---------------------------------------------------------------------------
+# per-replica child registries (satellite: no more metric conflation)
+# ---------------------------------------------------------------------------
+
+def test_replicas_get_distinct_metric_namespaces(model):
+    router, _sup = _build_fleet(model)
+    ns = [r.engine.metrics_namespace for r in router.replicas]
+    assert ns == ["r0", "r1"]
+    # the r0/r1 child registries are module-global; compare deltas
+    before = [_metrics.child(n).snapshot()["counters"]
+              .get("serving/requests", 0) for n in ns]
+    hs = _submit_wave(router)
+    router.run_to_completion()
+    snaps = [_metrics.child(n).snapshot() for n in ns]
+    served = [s["counters"].get("serving/requests", 0) - b
+              for s, b in zip(snaps, before)]
+    assert sum(served) == len(hs)          # split across replicas...
+    assert all(v > 0 for v in served)      # ...not conflated onto one
+    for s in snaps:
+        h = s["histograms"].get("serving/ttft_ms")
+        assert h and h["count"] > 0 and h.get("digest")
+
+
+def test_restarted_engine_keeps_replica_namespace(model):
+    faults.arm("kill@decode#2:rank=1")
+    router, sup = _build_fleet(model)
+    _submit_wave(router)
+    router.run_to_completion()
+    faults.disarm()
+    assert sup.restarts[1] == 1
+    assert router.replicas[1].engine.metrics_namespace == "r1"
+
+
+# ---------------------------------------------------------------------------
+# aggregation plane
+# ---------------------------------------------------------------------------
+
+def test_aggregator_per_replica_p95_matches_local_digest():
+    reg = _metrics.MetricsRegistry()
+    agg = _aggregate.FleetAggregator()
+    rng = np.random.RandomState(7)
+    locals_ = {}
+    for i, rep in enumerate(("r0", "r1")):
+        child = reg.child(rep)
+        h = child.histogram("serving/ttft_ms")
+        for v in rng.lognormal(3 + i, 0.5, 2000):
+            h.observe(float(v))
+        locals_[rep] = h.quantile(0.95)
+        snap = child.snapshot()
+        snap["host_id"] = "h0"
+        snap["replica"] = rep
+        agg.ingest(snap)
+    # the acceptance criterion: aggregator-side p95 == local digest p95
+    for rep, want in locals_.items():
+        got = agg.percentile("serving/ttft_ms", 0.95,
+                             host_id="h0", replica=rep)
+        assert got == pytest.approx(want)
+    fleet = agg.fleet_snapshot()
+    assert fleet["n_replicas"] == 2
+    merged = fleet["fleet"]["histograms"]["serving/ttft_ms"]
+    assert merged["count"] == 4000
+    assert min(locals_.values()) <= merged["p95"] <= \
+        max(locals_.values()) * 1.05
+
+
+def test_collector_publish_and_poll_over_transport():
+    reg = _metrics.MetricsRegistry()
+    reg.counter("serving/requests").inc(5)
+    reg.histogram("serving/tpot_ms").observe(3.0)
+    tp = LoopbackTransport()
+    col = _aggregate.MetricsCollector(tp, dst=0, host_id="h1",
+                                      replica="r0", registry=reg)
+    col.publish()
+    agg = _aggregate.FleetAggregator()
+    key = agg.poll(tp, src=1)
+    assert key == ("h1", "r0")
+    snap = agg.replica_snapshot("h1", "r0")
+    assert snap["counters"]["serving/requests"] == 5
+    assert snap["histograms"]["serving/tpot_ms"]["count"] == 1
+
+
+def test_straggler_report_flags_slow_rank():
+    reg = _metrics.MetricsRegistry()
+    agg = _aggregate.FleetAggregator()
+    rng = np.random.RandomState(9)
+    for i in range(4):
+        child = reg.child(f"rank{i}")
+        h = child.histogram("train/step_ms")
+        base = 400.0 if i == 2 else 100.0      # rank2 lags 4x
+        for v in base + rng.uniform(0, 10, 500):
+            h.observe(float(v))
+        snap = child.snapshot()
+        snap["host_id"] = f"h{i % 2}"
+        snap["replica"] = f"rank{i}"
+        agg.ingest(snap)
+    rep = agg.straggler_report("train/step_ms", factor=1.5)
+    assert rep["stragglers"] == ["h0/rank2"]
+    assert rep["per_rank"]["h0/rank2"]["p95"] > \
+        1.5 * rep["median_p95"]
+
+
+def test_clock_offset_estimation_recovers_skew():
+    tp = LoopbackTransport()
+    skew = 2.5
+    responder = threading.Thread(
+        target=_aggregate.serve_clock,
+        kwargs=dict(transport=tp, peer=0, n=4, skew_s=skew))
+    responder.start()
+    off = _aggregate.estimate_clock_offset(tp, peer=1, n=4)
+    responder.join(timeout=10)
+    assert not responder.is_alive()
+    assert off == pytest.approx(skew, abs=0.05)
+    assert _metrics.gauge("fleet/clock_offset_ms").value == \
+        pytest.approx(off * 1e3)

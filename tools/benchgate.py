@@ -126,7 +126,13 @@ def _serving_metrics(result):
 # fleet row signals: value is True when HIGHER is better (a drop fails),
 # False for latencies (a rise fails)
 _FLEET_GATES = {"requests_per_sec": True, "prefix_hit_rate": True,
-                "ttft_mean_s": False}
+                "ttft_mean_s": False,
+                # digest tail latency (PR 10): an honest p95 over every
+                # request in the fleet row, not a mean that hides tails.
+                # Old baselines without the key are skipped (set
+                # intersection below), so the gate phases in as soon as
+                # a baseline carries it.
+                "ttft_p95_s": False}
 
 
 def _fleet_metrics(result):

@@ -76,6 +76,7 @@ KNOWN_METRICS = (
     "train/steps", "train/snapshots", "train/snapshot_bytes",
     "train/replication_errors", "train/anomalies",
     "train/skipped_batches", "train/rollbacks", "train/sdc_flags",
+    "train/step_ms",
     # checkpoint retention (distributed/resilience/recovery.py)
     "ckpt/pruned", "ckpt/swept_incomplete",
     # serving engine (inference/serving.py)
@@ -108,6 +109,11 @@ KNOWN_METRICS = (
     "analysis/programs_analyzed", "analysis/ops_analyzed",
     "analysis/findings", "analysis/peak_bytes",
     "analysis/verify_failures",
+    # distributed tracing + crash flight recorder (profiler/tracing.py)
+    "trace/*",
+    # fleet metrics aggregation plane (profiler/aggregate.py):
+    # snapshot shipping, replica census, clock-offset estimation
+    "fleet/*",
 )
 
 
@@ -149,7 +155,12 @@ def summarize_trace(trace: dict) -> str:
 
 
 def _hist_quantile(h: dict, q: float):
-    """Bucket-estimated quantile (upper bound of the covering bucket)."""
+    """Digest quantile when the snapshot carries one (exact-ish, the
+    t-digest value computed registry-side), else bucket-estimated
+    (upper bound of the covering bucket)."""
+    key = {0.5: "p50", 0.95: "p95", 0.99: "p99"}.get(q)
+    if key is not None and h.get(key) is not None:
+        return h[key]
     total = h.get("count", 0)
     if not total:
         return None
@@ -197,6 +208,78 @@ def summarize_metrics(snap: dict) -> str:
     return "\n".join(lines) if lines else "  (empty snapshot)"
 
 
+def merge_traces(traces, offsets=None) -> dict:
+    """Merge per-host chrome traces onto one timeline.
+
+    `offsets` (seconds, one per trace; see
+    paddle_tpu.profiler.aggregate.estimate_clock_offset) is ADDED to
+    each trace's timestamps to land them on the reference host's clock.
+    Span ids/trace ids pass through untouched — a request migrated
+    between hosts keeps one trace id across the merged file."""
+    out = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for i, tr in enumerate(traces):
+        off_us = (offsets[i] if offsets and i < len(offsets) else 0.0) * 1e6
+        for ev in tr.get("traceEvents", []):
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + off_us
+            ev.setdefault("args", {})
+            ev["args"].setdefault("source_trace", i)
+            out["traceEvents"].append(ev)
+    out["traceEvents"].sort(key=lambda e: e.get("ts", 0.0))
+    return out
+
+
+def trace_tree_check(trace: dict) -> dict:
+    """Connectivity census over span ids: how many distinct trace ids,
+    and which ones span more than one pid (a request that moved between
+    engines/hosts but kept one trace id — the migration invariant)."""
+    by_trace = defaultdict(set)
+    for ev in trace.get("traceEvents", []):
+        args = ev.get("args", {})
+        tid = args.get("trace_id")
+        if tid:
+            by_trace[tid].add((ev.get("pid"), args.get("engine")))
+    cross = sorted(t for t, owners in by_trace.items() if len(owners) > 1)
+    return {"n_traces": len(by_trace), "cross_process": cross}
+
+
+def straggler_section(snaps, metric: str = "train/step_ms",
+                      factor: float = 1.5) -> str:
+    """Per-rank p95 comparison across metrics snapshots: flag ranks
+    whose `metric` p95 exceeds `factor` x the fleet median p95. Uses
+    the digest percentiles embedded in each histogram snapshot."""
+    rows = []
+    for i, snap in enumerate(snaps):
+        h = snap.get("histograms", {}).get(metric)
+        if not h:
+            continue
+        who = snap.get("replica") or snap.get("namespace") \
+            or f"snap{i}(pid{snap.get('pid')})"
+        host = snap.get("host_id")
+        if host:
+            who = f"{host}/{who}"
+        rows.append((who, h.get("count", 0), _hist_quantile(h, 0.5),
+                     _hist_quantile(h, 0.95), h.get("max")))
+    if not rows:
+        return f"  (no {metric} histograms across snapshots)"
+    p95s = sorted(r[3] for r in rows if r[3] is not None)
+    median = p95s[len(p95s) // 2] if p95s else None
+    lines = [f"  {'Rank':<30} {'Count':>7} {'p50':>10} {'p95':>10} "
+             f"{'Max':>10}  flag"]
+    for who, count, p50, p95, mx in sorted(rows):
+        flag = "STRAGGLER" if (median and p95 is not None
+                               and p95 > factor * median) else ""
+        def fmt(v):
+            return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+        lines.append(f"  {who[:30]:<30} {count:>7} {fmt(p50):>10} "
+                     f"{fmt(p95):>10} {fmt(mx):>10}  {flag}")
+    if median is not None:
+        lines.append(f"  (median p95 {median:.3f}, straggler threshold "
+                     f"{factor:g}x = {factor * median:.3f})")
+    return "\n".join(lines)
+
+
 def build_report(trace: dict = None, metrics: dict = None) -> str:
     parts = ["paddle_tpu trace report", "=" * 70]
     if metrics is not None:
@@ -216,19 +299,53 @@ def build_report(trace: dict = None, metrics: dict = None) -> str:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--trace", help="chrome-trace JSON (Profiler.export)")
-    ap.add_argument("--metrics", help="metrics snapshot JSON")
+    ap.add_argument("--trace", action="append", default=[],
+                    help="chrome-trace JSON (Profiler.export or "
+                         "tracing.export_chrome); repeat for a "
+                         "multi-host merge")
+    ap.add_argument("--clock-offset", action="append", default=[],
+                    type=float, metavar="SECONDS",
+                    help="per --trace clock offset (aggregate."
+                         "estimate_clock_offset), positional match; "
+                         "missing entries default to 0")
+    ap.add_argument("--metrics", action="append", default=[],
+                    help="metrics snapshot JSON; repeat for a per-rank "
+                         "straggler report")
+    ap.add_argument("--straggler-metric", default="train/step_ms",
+                    help="histogram compared across ranks "
+                         "(default: train/step_ms)")
+    ap.add_argument("--merged-trace", help="also write the merged "
+                                           "chrome trace JSON here")
     ap.add_argument("-o", "--output", help="write report here "
                                            "(default: stdout)")
     args = ap.parse_args(argv)
-    trace = metrics = None
-    if args.trace:
-        with open(args.trace) as f:
-            trace = json.load(f)
-    if args.metrics:
-        with open(args.metrics) as f:
-            metrics = json.load(f)
-    report = build_report(trace, metrics)
+    traces = []
+    for path in args.trace:
+        with open(path) as f:
+            traces.append(json.load(f))
+    snaps = []
+    for path in args.metrics:
+        with open(path) as f:
+            snaps.append(json.load(f))
+    trace = None
+    if traces:
+        trace = traces[0] if len(traces) == 1 \
+            else merge_traces(traces, args.clock_offset)
+    report = build_report(trace, snaps[0] if snaps else None)
+    if len(snaps) > 1:
+        report += "\n".join([
+            "", f"Per-rank stragglers ({args.straggler_metric})",
+            "-" * 70, straggler_section(snaps, args.straggler_metric), ""])
+    if trace is not None and len(traces) > 1:
+        tree = trace_tree_check(trace)
+        report += "\n".join([
+            "", "Merged-trace connectivity", "-" * 70,
+            f"  {len(traces)} traces merged, {tree['n_traces']} distinct "
+            f"trace ids, {len(tree['cross_process'])} spanning multiple "
+            f"processes", ""])
+    if args.merged_trace and trace is not None:
+        with open(args.merged_trace, "w") as f:
+            json.dump(trace, f)
     if args.output:
         with open(args.output, "w") as f:
             f.write(report + "\n")
