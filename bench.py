@@ -968,6 +968,163 @@ def bench_host_recovery(on_tpu):
     }}
 
 
+def bench_gateway_storm(on_tpu):
+    """Gateway overload gate row (ISSUE 12): two replicas behind the
+    FleetGateway; the ``overload@admit`` chaos pattern turns every
+    arriving request into 4 (three synthetic best-effort clones under
+    the ``_storm`` tenant).  Gate signals: every interactive request
+    completes with zero deadline misses once the brownout ladder
+    engages, goodput holds, and every completed real stream stays
+    token-bitwise-identical to the unloaded reference run (clamped
+    batch streams must be exact PREFIXES of their reference — the
+    ladder may shorten a stream, never alter it)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.inference.gateway import (BrownoutConfig,
+                                              FleetGateway,
+                                              GatewayConfig,
+                                              SLOClassConfig,
+                                              TenantConfig,
+                                              BROWNOUT_LEVELS)
+    from paddle_tpu.inference.router import Replica, ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+    from paddle_tpu.profiler import metrics as _pmetrics
+
+    n_int, n_batch, prompt_len, max_new = 6, 4, 12, 6
+    cfg = PagedServingConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=4, max_blocks_per_seq=6, token_budget=32,
+        max_queue=6, prefix_cache=True)
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(13)
+    int_prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+                   for _ in range(n_int)]
+    batch_prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+                     for _ in range(n_batch)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    def gateway_cfg():
+        return GatewayConfig(
+            classes={
+                "interactive": SLOClassConfig(deadline_s=5.0,
+                                              priority=0,
+                                              protected=True),
+                "batch": SLOClassConfig(deadline_s=30.0, priority=1,
+                                        deferrable=True),
+                "best_effort": SLOClassConfig(priority=2,
+                                              sheddable=True),
+            },
+            tenants={"alpha": TenantConfig(rate=500.0, burst=100.0,
+                                           weight=2.0),
+                     "beta": TenantConfig(rate=500.0, burst=100.0,
+                                          weight=1.0)},
+            brownout=BrownoutConfig(enter_load=1.2, exit_load=0.6,
+                                    hysteresis=2, clamp_max_new=4,
+                                    retry_after_s=0.25),
+            retry_cap=20.0, retry_deposit=0.2, retry_floor=4.0)
+
+    def build():
+        engines = []
+        for i in range(2):
+            e = ServingEngine.from_model(model, cfg, seed=30 + i)
+            e.fault_rank = i
+            engines.append(e)
+        router = ReplicaRouter(
+            [Replica(e, name=f"r{i}") for i, e in enumerate(engines)])
+        return FleetGateway(router, gateway_cfg())
+
+    def drive(gw):
+        """Submit the REAL mixed-tenant request set (stable stream
+        keys — the bitwise identity) and run the fleet dry."""
+        t_int, t_batch = [], []
+        for i, p in enumerate(int_prompts):
+            t_int.append(gw.submit(p, max_new_tokens=max_new,
+                                   sampling=sp, tenant="alpha",
+                                   slo="interactive",
+                                   stream_key=1000 + i))
+        for i, p in enumerate(batch_prompts):
+            t_batch.append(gw.submit(p, max_new_tokens=max_new,
+                                     sampling=sp, tenant="beta",
+                                     slo="batch", stream_key=2000 + i))
+        out = gw.run_to_completion(max_steps=4000)
+        return t_int, t_batch, out
+
+    faults.disarm()
+    gw = build()
+    t_int, t_batch, out = drive(gw)          # warm + unloaded reference
+    ref = {gw.ticket_info(t)["stream_key"]: out.get(t, [])
+           for t in t_int + t_batch}
+
+    storm0 = _pmetrics.counter("gateway/storm_injected").value
+    shed0 = _pmetrics.counter("gateway/shed").value
+    defer0 = _pmetrics.counter("gateway/deferrals").value
+    requeue0 = _pmetrics.counter("serving/requeues").value
+    exhausted0 = _pmetrics.counter("serving/requeue_exhausted").value
+    faults.arm("overload@admit%1.0:x=4")
+    gw = build()
+    t0 = time.perf_counter()
+    t_int, t_batch, out = drive(gw)
+    total_s = time.perf_counter() - t0
+    faults.disarm()
+
+    # bitwise discipline: under 4x overload every completed REAL
+    # stream must be a bitwise prefix of its unloaded reference, and
+    # protected interactive streams must be complete AND exact
+    bitwise = True
+    for t in t_int + t_batch:
+        toks = out.get(t)
+        if not toks:
+            continue
+        r = ref[gw.ticket_info(t)["stream_key"]]
+        if toks != r[:len(toks)]:
+            bitwise = False
+    int_completed = sum(1 for t in t_int
+                        if len(out.get(t, [])) == max_new)
+    batch_completed = sum(1 for t in t_batch if out.get(t))
+    misses = [t for t in gw.timed_out()
+              if gw.ticket_info(t)["slo"] == "interactive"
+              and not gw.ticket_info(t)["synthetic"]]
+    ttfts = sorted(gw.ttft(t) for t in t_int
+                   if gw.ttft(t) is not None)
+    ttft_p95 = ttfts[min(len(ttfts) - 1,
+                         int(0.95 * len(ttfts)))] if ttfts else None
+
+    return {"gateway_storm": {
+        "n_interactive": n_int, "n_batch": n_batch,
+        "storm_factor": 4, "max_new": max_new,
+        "storm_injected":
+            _pmetrics.counter("gateway/storm_injected").value - storm0,
+        "interactive_completed": int_completed,
+        "batch_completed": batch_completed,
+        "interactive_deadline_misses": len(misses),
+        "interactive_ttft_p95_s":
+            round(ttft_p95, 4) if ttft_p95 is not None else None,
+        "goodput_rps":
+            round((int_completed + batch_completed) / total_s, 2),
+        "total_s": round(total_s, 4),
+        "shed":
+            _pmetrics.counter("gateway/shed").value - shed0,
+        "shed_by_class": dict(gw.shed_by_class),
+        "deferrals":
+            _pmetrics.counter("gateway/deferrals").value - defer0,
+        "requeues":
+            _pmetrics.counter("serving/requeues").value - requeue0,
+        "requeue_exhausted":
+            _pmetrics.counter("serving/requeue_exhausted").value
+            - exhausted0,
+        "brownout_max_level": BROWNOUT_LEVELS[gw.brownout.max_level],
+        "brownout_transitions": len(gw.brownout.transitions),
+        "bitwise_match": bitwise,
+    }}
+
+
 def host_dispatch_bench(measure_us):
     """Host-path dispatch cost (tunnel-free), shared by bench.py and
     tools/op_bench.py: the same grad-recorded matmul+add dispatches
@@ -1194,6 +1351,7 @@ WORKLOADS = (
     ("fleet", bench_fleet_serving, True),
     ("fleet_recovery", bench_fleet_recovery, True),
     ("host_recovery", bench_host_recovery, True),
+    ("gateway_storm", bench_gateway_storm, True),
     ("second_order", bench_second_order, False),
 )
 

@@ -14,6 +14,7 @@ __all__ = [
     "TransportError", "TransportClosedError", "TransportTimeoutError",
     "FrameCorruptError", "PeerUnreachableError", "CommTimeoutError",
     "EngineDeadError", "StoreTimeoutError", "StaleGenerationError",
+    "GatewayRejectedError",
 ]
 
 
@@ -87,6 +88,31 @@ class EngineDeadError(RuntimeError):
         super().__init__(
             f"serving engine {name} is dead{at}: drain its in-flight "
             f"requests to a healthy replica and restart it")
+
+
+class GatewayRejectedError(RuntimeError):
+    """The traffic gateway refused a request — by policy, not by
+    accident.  Carries the machine-readable triage a client (or the
+    storm bench) needs: WHY it was refused (``reason`` — e.g.
+    ``tenant_rate``, ``brownout_shed``, ``brownout_reject``,
+    ``retry_budget``, ``injected_drop``), who asked (``tenant``,
+    ``slo_class``), and ``retry_after_s`` — the gateway's hint for when
+    capacity should exist again (the HTTP 429/503 Retry-After analog).
+    A None ``retry_after_s`` means "do not retry" (e.g. the request
+    itself is malformed or the tenant is over a hard quota)."""
+
+    def __init__(self, reason: str, tenant: Optional[str] = None,
+                 slo_class: Optional[str] = None,
+                 retry_after_s: Optional[float] = None):
+        self.reason = reason
+        self.tenant = tenant
+        self.slo_class = slo_class
+        self.retry_after_s = retry_after_s
+        hint = (f"; retry after {retry_after_s:.3f}s"
+                if retry_after_s is not None else "; do not retry")
+        super().__init__(
+            f"gateway rejected request (reason={reason}, "
+            f"tenant={tenant}, class={slo_class}){hint}")
 
 
 class StoreTimeoutError(TransportError, TimeoutError):

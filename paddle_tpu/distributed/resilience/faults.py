@@ -46,6 +46,8 @@ Optional filters: ``:rank=R`` (only this global rank injects) and
   mid-collective (exercises watchdog escalation on the survivors),
   mid-step (exercises supervisor re-form + snapshot restore), or
   mid-save (exercises torn-checkpoint discovery)
+- ``overload`` (``admit`` only) a traffic storm: each arrival at the
+  gateway becomes ``x`` arrivals (``:x=4``, default 4)
 
 At the ``step``/``save``/``host`` sites only ``kill`` and ``delay``
 are meaningful; frame-level kinds (drop/dup/corrupt) are REJECTED by
@@ -73,6 +75,18 @@ engine cannot ship its KV pages — exercises the requeue fallback) and
 ``kill`` again fells the source engine. Use ``:rank=R`` with the
 engine's ``fault_rank`` to target one replica of an in-process fleet.
 
+The ``admit`` site is the traffic-storm site: the FleetGateway
+(``inference/gateway.py``) consults it once per arriving request.
+``overload`` (valid ONLY at ``admit``) turns each arrival into ``x``
+arrivals (``:x=4`` — the gateway injects ``x - 1`` synthetic
+best-effort clones, a reproducible 4x burst), ``drop`` sheds the
+arrival the way a vanished client would, and ``delay`` stalls it.
+Process/frame kinds (kill/dup/corrupt/partition) are rejected at
+``admit`` — requests do not die there, fleets do::
+
+    PT_FAULT_PLAN="overload@admit%1.0:x=4"    # sustained 4x storm
+    PT_FAULT_PLAN="overload@admit#1:x=8"      # one 8x burst
+
 Every injected fault increments ``faults/injected`` and
 ``faults/<kind>`` in the metrics registry so a chaos run's report shows
 exactly what was thrown at the system.
@@ -96,9 +110,11 @@ __all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
            "injector", "arm", "disarm", "is_armed", "parse_plan",
            "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
 
-FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition")
+FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition",
+               "overload")
 FAULT_SITES = ("send", "dial", "recv", "step", "save",
-               "prefill", "decode", "migrate", "cache_save", "host")
+               "prefill", "decode", "migrate", "cache_save", "host",
+               "admit")
 
 # frame-level kinds are meaningless away from the wire: the validator
 # REJECTS them at the process/host sites instead of silently no-oping
@@ -106,6 +122,11 @@ _FRAME_KINDS = ("drop", "dup", "corrupt")
 _PROCESS_SITES = ("step", "save", "host")
 # a partition severs links: it only means something where dials happen
 _PARTITION_SITES = ("dial",)
+# a traffic storm only means something at the gateway's admission site,
+# and the only failures admission exhibits are storms, vanished clients
+# (drop) and stalls (delay) — anything else at admit is a typo'd plan
+_OVERLOAD_SITES = ("admit",)
+_ADMIT_KINDS = ("overload", "drop", "delay")
 
 
 @dataclass(frozen=True)
@@ -115,6 +136,7 @@ class FaultAction:
     kind: str                      # one of FAULT_KINDS
     delay_ms: float = 100.0        # for kind == "delay"
     exit_code: int = 1             # for kind == "kill"
+    factor: int = 4                # for kind == "overload": arrival x
 
 
 @dataclass
@@ -128,6 +150,7 @@ class FaultRule:
     host: Optional[str] = None     # only on events from this host_id
     delay_ms: float = 100.0
     exit_code: int = 1
+    factor: int = 4                # overload: arrivals per real arrival
     # runtime state
     seen: int = 0
     fired: int = 0
@@ -205,6 +228,15 @@ def parse_plan(spec: str) -> FaultPlan:
                 f"kind 'partition' only applies at the "
                 f"{'/'.join(_PARTITION_SITES)} site(s), not {site!r} in "
                 f"{clause!r}")
+        if kind == "overload" and site not in _OVERLOAD_SITES:
+            raise ValueError(
+                f"kind 'overload' only applies at the "
+                f"{'/'.join(_OVERLOAD_SITES)} site(s), not {site!r} in "
+                f"{clause!r}")
+        if site == "admit" and kind not in _ADMIT_KINDS:
+            raise ValueError(
+                f"kind {kind!r} is meaningless at the 'admit' site in "
+                f"{clause!r} (only {'/'.join(_ADMIT_KINDS)} fire there)")
         for opt in opts:
             k, _, v = opt.partition("=")
             if k == "rank":
@@ -217,6 +249,12 @@ def parse_plan(spec: str) -> FaultPlan:
                 rule.delay_ms = float(v)
             elif k == "code":
                 rule.exit_code = int(v)
+            elif k == "x":
+                rule.factor = int(v)
+                if rule.factor < 2:
+                    raise ValueError(
+                        f"overload factor x={rule.factor} in {clause!r} "
+                        f"must be >= 2 (x arrivals per real arrival)")
             else:
                 raise ValueError(f"unknown option {opt!r} in {clause!r}")
         plan.rules.append(rule)
@@ -302,7 +340,8 @@ class FaultInjector:
                 _metrics.inc("faults/injected")
                 _metrics.inc(f"faults/{rule.kind}")
                 action = FaultAction(rule.kind, delay_ms=rule.delay_ms,
-                                     exit_code=rule.exit_code)
+                                     exit_code=rule.exit_code,
+                                     factor=rule.factor)
                 if site == "host" and rule.kind == "kill" \
                         and host is not None:
                     self._felled_hosts.add(host)

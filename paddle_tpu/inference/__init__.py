@@ -364,6 +364,10 @@ _FLEET_EXPORTS = {
     "FleetSupervisor": "fleet_supervisor",
     "FleetSupervisorConfig": "fleet_supervisor",
     "LoopbackTransport": "fleet_supervisor",
+    "FleetGateway": "gateway", "GatewayConfig": "gateway",
+    "SLOClassConfig": "gateway", "TenantConfig": "gateway",
+    "BrownoutConfig": "gateway", "BrownoutController": "gateway",
+    "TokenBucket": "gateway", "RetryBudget": "gateway",
 }
 
 

@@ -232,13 +232,20 @@ class FleetSupervisor:
         to an uninterrupted run, just re-paying the prefill."""
         src = self.router.replicas[src_idx].engine
         r = src._requests[rid]
+        # the fleet-wide retry budget covers drain-requeues too (each
+        # re-pays a full prefill); migrations are exempt — they ship
+        # work already done instead of redoing it
+        gate = getattr(self.router, "retry_gate", None)
+        if gate is not None and not gate("drain"):
+            return False
         origin_seed = src.seed if r.salt_seed is None else r.salt_seed
         for dst_idx in targets:
             dst = self.router.replicas[dst_idx].engine
             try:
                 new_rid = dst.add_request(
                     list(r.prompt), max_new_tokens=r.max_new,
-                    sampling=r.sampling, eos_token_id=r.eos_token_id)
+                    sampling=r.sampling, eos_token_id=r.eos_token_id,
+                    tenant=r.tenant)
             except (EngineOverloadedError, EngineDeadError):
                 continue
             req = dst._requests[new_rid]

@@ -66,34 +66,73 @@ _m_snapshots = _metrics.counter("serving/cache_snapshots")
 
 
 class _Node:
-    __slots__ = ("page", "refs", "lru", "parent", "children", "restored")
+    __slots__ = ("page", "refs", "lru", "parent", "children", "restored",
+                 "ns")
 
-    def __init__(self, page: int, parent: Optional[bytes], lru: int):
+    def __init__(self, page: int, parent: Optional[bytes], lru: int,
+                 ns: Optional[str] = None):
         self.page = page
         self.refs = 1          # created on behalf of the inserting request
         self.lru = lru
         self.parent = parent
         self.children = 0
         self.restored = False  # re-materialized from a disk snapshot
+        self.ns = ns           # tenant namespace (None = shared default)
 
 
 class PrefixCache:
-    """Trie of cached full-block KV pages keyed by token-block digests."""
+    """Trie of cached full-block KV pages keyed by token-block digests.
 
-    def __init__(self, block_size: int):
+    Tenant namespaces: every read/write takes a ``namespace`` — the
+    digest chain of namespace ``t`` is rooted at a ``t``-seeded root
+    key, so identical prompts under different tenants live on DISJOINT
+    trie paths (no cross-tenant KV reuse, by construction — a tenant
+    cannot probe another's cached prompts).  ``page_quota`` (default
+    for every namespace) and ``set_quota`` (per-namespace override)
+    bound how many cache pages one namespace may OWN: insert stops
+    registering once the namespace is at quota, so one hot tenant's
+    prefix churn cannot evict-starve the rest of the pool."""
+
+    def __init__(self, block_size: int,
+                 page_quota: Optional[int] = None):
         self.block_size = int(block_size)
         self._nodes: Dict[bytes, _Node] = {}
         self._page_owner: Dict[int, bytes] = {}   # page -> node key
         self._tick = 0
         self.lookups = 0
         self.hits = 0
+        # per-namespace page-ownership quotas: default for all, plus
+        # per-namespace overrides; _ns_pages tracks current ownership
+        self.page_quota = page_quota
+        self._quotas: Dict[Optional[str], int] = {}
+        self._ns_pages: Dict[Optional[str], int] = {}
+
+    # -- namespaces --------------------------------------------------------
+    def set_quota(self, namespace: Optional[str],
+                  pages: Optional[int]) -> None:
+        """Override the page quota for one namespace (None restores the
+        cache-wide default)."""
+        if pages is None:
+            self._quotas.pop(namespace, None)
+        else:
+            self._quotas[namespace] = int(pages)
+
+    def _quota(self, namespace: Optional[str]) -> Optional[int]:
+        return self._quotas.get(namespace, self.page_quota)
+
+    def namespace_pages(self, namespace: Optional[str]) -> int:
+        """Pages currently owned by one namespace's nodes."""
+        return self._ns_pages.get(namespace, 0)
 
     # -- keys --------------------------------------------------------------
-    def _chain(self, tokens, n_blocks: int) -> List[bytes]:
+    def _chain(self, tokens, n_blocks: int,
+               namespace: Optional[str] = None) -> List[bytes]:
         """Chained digests for the first ``n_blocks`` full blocks: digest
-        of block i commits to all tokens of blocks 0..i."""
+        of block i commits to all tokens of blocks 0..i (and to the
+        namespace, via the seeded root)."""
         bs = self.block_size
-        key = b"\x00prefix-root"
+        key = b"\x00prefix-root" if namespace is None \
+            else b"\x00prefix-root:" + str(namespace).encode()
         out = []
         for i in range(n_blocks):
             h = hashlib.blake2b(key, digest_size=16)
@@ -104,7 +143,8 @@ class PrefixCache:
         return out
 
     # -- read path ---------------------------------------------------------
-    def match(self, prompt) -> Tuple[List[int], List[bytes], int]:
+    def match(self, prompt, namespace: Optional[str] = None
+              ) -> Tuple[List[int], List[bytes], int]:
         """Longest cached block chain covering a STRICT prefix of
         ``prompt`` (the tip token is always recomputed so its logits can
         be sampled).  Acquires one ref on every matched node.  Returns
@@ -114,7 +154,7 @@ class PrefixCache:
         n_max = max(len(prompt) - 1, 0) // self.block_size
         pages: List[int] = []
         held: List[bytes] = []
-        for k in self._chain(prompt, n_max):
+        for k in self._chain(prompt, n_max, namespace):
             node = self._nodes.get(k)
             if node is None:
                 break
@@ -131,6 +171,20 @@ class PrefixCache:
             self.hits += 1
         return pages, held, len(held) * self.block_size
 
+    def probe(self, prompt, namespace: Optional[str] = None) -> int:
+        """How many leading tokens of ``prompt`` a ``match`` would serve
+        from cache RIGHT NOW — without acquiring refs, touching LRU
+        ticks, or counting a lookup.  The gateway's affinity signal:
+        score each replica's cache before placing a session's next
+        turn, then ``match`` only on the replica actually chosen."""
+        n_max = max(len(prompt) - 1, 0) // self.block_size
+        n = 0
+        for k in self._chain(prompt, n_max, namespace):
+            if k not in self._nodes:
+                break
+            n += 1
+        return n * self.block_size
+
     def release(self, keys) -> None:
         """Drop one ref per key (request finished / evicted / preempted).
         Zero-ref nodes stay resident — warm cache — until ``evict``."""
@@ -140,21 +194,29 @@ class PrefixCache:
                 node.refs -= 1
 
     # -- write path --------------------------------------------------------
-    def insert(self, prompt, pages) -> List[bytes]:
+    def insert(self, prompt, pages,
+               namespace: Optional[str] = None) -> List[bytes]:
         """Register the FULL prompt blocks backed by ``pages`` (the
         request's block list, block i at ``pages[i]``).  Pages of blocks
         not yet cached transfer ownership to the cache; the caller holds
         one ref on each returned (new) key and must ``release`` them.
         Blocks already cached (two identical prompts racing through
-        prefill) are skipped — the second copy stays a private page."""
+        prefill) are skipped — the second copy stays a private page.
+        Registration stops at the namespace's page quota: the blocks
+        past it stay the request's private pages (correctness is
+        untouched; only reuse is bounded)."""
         n = min(len(prompt) // self.block_size, len(pages))
-        keys = self._chain(prompt, n)
+        keys = self._chain(prompt, n, namespace)
+        quota = self._quota(namespace)
         new: List[bytes] = []
         parent: Optional[bytes] = None
         for i, k in enumerate(keys):
             if k in self._nodes:
                 parent = k
                 continue
+            if quota is not None \
+                    and self._ns_pages.get(namespace, 0) >= quota:
+                break                      # namespace at its page quota
             page = int(pages[i])
             if page in self._page_owner:
                 # a page cannot serve two blocks; stop registering here
@@ -162,8 +224,11 @@ class PrefixCache:
             if parent is not None and parent not in self._nodes:
                 break                      # gap in the chain: unreachable
             self._tick += 1
-            self._nodes[k] = _Node(page, parent, self._tick)
+            self._nodes[k] = _Node(page, parent, self._tick,
+                                   ns=namespace)
             self._page_owner[page] = k
+            self._ns_pages[namespace] = \
+                self._ns_pages.get(namespace, 0) + 1
             if parent is not None:
                 self._nodes[parent].children += 1
             new.append(k)
@@ -199,6 +264,8 @@ class PrefixCache:
                 break
             node = self._nodes.pop(best)
             self._page_owner.pop(node.page, None)
+            if self._ns_pages.get(node.ns, 0) > 0:
+                self._ns_pages[node.ns] -= 1
             if node.parent is not None and node.parent in self._nodes:
                 self._nodes[node.parent].children -= 1
             freed.append(node.page)
@@ -326,7 +393,8 @@ def save_snapshot(engine, root: str,
         "nodes": [{"key": k.hex(),
                    "parent": (node.parent.hex()
                               if node.parent is not None else None),
-                   "slab": key_index[k]}
+                   "slab": key_index[k],
+                   "ns": node.ns}
                   for k, node in order],
     })
     _m_snapshots.inc()
@@ -405,11 +473,13 @@ def restore_snapshot(engine, root: str, sweep: bool = True) -> int:
         key = bytes.fromhex(rec["key"])
         parent = bytes.fromhex(rec["parent"]) if rec["parent"] else None
         cache._tick += 1
-        node = _Node(int(page), parent, cache._tick)
+        # "ns" absent in pre-namespace snapshots: default namespace
+        node = _Node(int(page), parent, cache._tick, ns=rec.get("ns"))
         node.refs = 0          # no live request holds restored blocks
         node.restored = True
         cache._nodes[key] = node
         cache._page_owner[int(page)] = key
+        cache._ns_pages[node.ns] = cache._ns_pages.get(node.ns, 0) + 1
         if parent is not None and parent in cache._nodes:
             cache._nodes[parent].children += 1
     _m_restore_ms.observe((time.perf_counter() - t0) * 1e3)

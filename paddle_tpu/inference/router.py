@@ -10,7 +10,12 @@ probe fails (watchdog ``__unhealthy__`` mark, aborted/closed transport,
 or any caller-supplied predicate) so traffic drains away from a sick
 host without dropping in-flight work elsewhere, and (4) installs each
 engine's ``requeue_hook`` so a deadline-evicted request is retried on
-another replica (``serving/requeues``) instead of dying with a 504.
+another replica (``serving/requeues``) instead of dying with a 504 —
+BOUNDED: each request carries a requeue count and stops retrying after
+``max_requeues`` (``serving/requeue_exhausted``), so an expired request
+cannot ping-pong between overloaded replicas forever; an installed
+``retry_gate`` (the FleetGateway's fleet-wide retry budget) can veto
+any reroute/requeue before the per-request cap is reached.
 
 Demotion is a CIRCUIT BREAKER, not a death sentence: a demoted replica
 stops receiving admissions but keeps earning half-open recovery probes
@@ -44,6 +49,7 @@ _m_reroutes = _metrics.counter("serving/reroutes")
 _m_requeues = _metrics.counter("serving/requeues")
 _m_restored = _metrics.counter("serving/replica_restored")
 _m_failures = _metrics.counter("serving/replica_failures")
+_m_requeue_exhausted = _metrics.counter("serving/requeue_exhausted")
 
 
 def transport_healthy(tp) -> bool:
@@ -155,7 +161,8 @@ class ReplicaRouter:
     it); ``run_to_completion``/``results`` collect generations by
     handle."""
 
-    def __init__(self, replicas, requeue_deadline_s: Optional[float] = None):
+    def __init__(self, replicas, requeue_deadline_s: Optional[float] = None,
+                 max_requeues: int = 3):
         self.replicas: List[Replica] = [
             r if isinstance(r, Replica) else Replica(r) for r in replicas]
         if not self.replicas:
@@ -163,6 +170,10 @@ class ReplicaRouter:
         # a requeued request gets this fresh deadline (None: no deadline
         # on the retry — it already burned its first one)
         self.requeue_deadline_s = requeue_deadline_s
+        # bounded deadline-requeue: a request that keeps expiring stops
+        # retrying after this many requeues (serving/requeue_exhausted)
+        # instead of ping-ponging between overloaded replicas forever
+        self.max_requeues = max(int(max_requeues), 0)
         self._handles: Dict[int, Tuple[int, int]] = {}   # h -> (idx, rid)
         self._by_engine: Dict[Tuple[int, int], int] = {}
         self._next_handle = 0
@@ -170,6 +181,11 @@ class ReplicaRouter:
         # (EngineDeadError): the fleet supervisor installs its drain +
         # restart here
         self.failure_hook: Optional[Callable[[int], None]] = None
+        # fleet-wide retry budget: called with the retry flavor
+        # ("requeue" | "reroute" | "drain") before each retry attempt;
+        # False vetoes it.  The FleetGateway installs its token-bucket
+        # budget here so overload cannot amplify into a retry storm.
+        self.retry_gate: Optional[Callable[[str], bool]] = None
         for idx, rep in enumerate(self.replicas):
             rep.engine.requeue_hook = self._make_requeue_hook(idx)
 
@@ -189,26 +205,32 @@ class ReplicaRouter:
                       key=lambda i: self.replicas[i].load_score())
 
     def submit(self, prompt_tokens, max_new_tokens=8, sampling=None,
-               eos_token_id=None, deadline_s=None) -> int:
+               eos_token_id=None, deadline_s=None, tenant=None,
+               prefer: Optional[int] = None) -> int:
         """Admit on the least-loaded healthy replica; an overloaded
         replica is skipped (counted as a reroute) instead of failing the
-        request.  Raises EngineOverloadedError only when EVERY healthy
-        replica sheds (the fleet is genuinely saturated — or fully
-        demoted)."""
+        request.  ``prefer`` tries that replica index first regardless
+        of load (the gateway's prefix-affinity placement); ``tenant``
+        scopes the request's prefix-cache namespace.  Raises
+        EngineOverloadedError only when EVERY healthy replica sheds (the
+        fleet is genuinely saturated — or fully demoted), or when the
+        ``retry_gate`` vetoes rerouting past a shed."""
         order = self._ordered()
-        for pos, idx in enumerate(order):
+        if prefer is not None and prefer in order:
+            order.remove(prefer)
+            order.insert(0, prefer)
+        for idx in order:
             try:
                 rid = self.replicas[idx].engine.add_request(
                     prompt_tokens, max_new_tokens=max_new_tokens,
                     sampling=sampling, eos_token_id=eos_token_id,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, tenant=tenant)
             except EngineOverloadedError:
                 _m_reroutes.inc()
+                if self.retry_gate is not None \
+                        and not self.retry_gate("reroute"):
+                    break      # retry budget spent: stop fanning out
                 continue
-            if pos > 0:
-                # admitted, but not on first choice — already counted
-                # one reroute per replica skipped above
-                pass
             h = self._next_handle
             self._next_handle += 1
             self._handles[h] = (idx, rid)
@@ -223,6 +245,18 @@ class ReplicaRouter:
         def hook(info):
             _m_requeues.inc()
             handle = self._by_engine.pop((src_idx, info["rid"]), None)
+            n_prior = int(info.get("requeues", 0))
+            if n_prior >= self.max_requeues \
+                    or (self.retry_gate is not None
+                        and not self.retry_gate("requeue")):
+                # the request burned its retry allowance (per-request
+                # cap, or the fleet-wide budget said no): stop the
+                # ping-pong — the handle keeps pointing at the
+                # timed-out request so results() reports it honestly
+                _m_requeue_exhausted.inc()
+                if handle is not None:
+                    self._by_engine[(src_idx, info["rid"])] = handle
+                return
             for idx in self._ordered(exclude=src_idx):
                 try:
                     rid = self.replicas[idx].engine.add_request(
@@ -230,10 +264,22 @@ class ReplicaRouter:
                         max_new_tokens=info["max_new"],
                         sampling=info["sampling"],
                         eos_token_id=info["eos_token_id"],
-                        deadline_s=self.requeue_deadline_s)
+                        deadline_s=self.requeue_deadline_s,
+                        tenant=info.get("tenant"))
                 except EngineOverloadedError:
                     _m_reroutes.inc()
                     continue
+                retry_req = self.replicas[idx].engine._requests[rid]
+                retry_req.requeues = n_prior + 1
+                # carry the sampling-salt identity: the retry
+                # regenerates the ORIGINAL stream bitwise (same
+                # drain/migrate semantics as the fleet supervisor)
+                if "salt_rid" in info:
+                    retry_req.salt_rid = info["salt_rid"]
+                    salt_seed = info.get("salt_seed")
+                    if salt_seed is None:
+                        salt_seed = self.replicas[src_idx].engine.seed
+                    retry_req.salt_seed = salt_seed
                 # the retry joins the original request's trace: a
                 # requeue span bridges the evicted request to its new
                 # replica, and the new request's lifecycle spans parent

@@ -105,7 +105,7 @@ class PagedServingConfig:
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
                  num_kv_heads=None, dtype="float32", cache_quant=None,
                  max_queue=None, prefix_cache=False,
-                 prefix_snapshot_root=None):
+                 prefix_snapshot_root=None, prefix_page_quota=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -138,6 +138,10 @@ class PagedServingConfig:
         # prefix hits immediately) and save_prefix_cache() snapshots
         # there by default.
         self.prefix_snapshot_root = prefix_snapshot_root
+        # prefix_page_quota: default per-tenant-namespace cap on cache
+        # pages OWNED (prefix_cache.py quotas; None = unbounded) — the
+        # gateway overrides per tenant via PrefixCache.set_quota
+        self.prefix_page_quota = prefix_page_quota
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -484,7 +488,7 @@ class _Request:
                  "cached", "done", "sampling", "eos_token_id",
                  "submit_t", "first_tok_t", "deadline_t", "timed_out",
                  "shared_keys", "prefix_registered", "salt_rid",
-                 "salt_seed", "trace", "sched_t0")
+                 "salt_seed", "trace", "sched_t0", "requeues", "tenant")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
                  deadline_s=None):
@@ -518,6 +522,13 @@ class _Request:
         # payloads so a migrated request's spans share one trace id
         self.trace = None
         self.sched_t0 = None       # first time a step scheduled this row
+        # deadline-requeue accounting: how many times a router has
+        # already retried this request on another replica — the bounded
+        # cap lives in ReplicaRouter.max_requeues
+        self.requeues = 0
+        # admission tenant: prefix-cache namespace + the gateway's
+        # fairness/quota identity; None = the shared default namespace
+        self.tenant = None
 
     @property
     def length(self):
@@ -584,7 +595,9 @@ class ServingEngine:
         if cfg.prefix_cache:
             from .prefix_cache import PrefixCache
 
-            self._prefix_cache = PrefixCache(cfg.block_size)
+            self._prefix_cache = PrefixCache(
+                cfg.block_size,
+                page_quota=getattr(cfg, "prefix_page_quota", None))
         else:
             self._prefix_cache = None
         # deadline-evicted requests are surfaced here instead of dropped:
@@ -699,14 +712,17 @@ class ServingEngine:
 
     # -- scheduling ------------------------------------------------------
     def add_request(self, prompt_tokens, max_new_tokens=8, sampling=None,
-                    eos_token_id=None, deadline_s=None):
+                    eos_token_id=None, deadline_s=None, tenant=None):
         """Admit one request. `deadline_s` (seconds from submit) bounds
         its total latency: a request still unfinished past its deadline
         is evicted at the next step (pages released, `timed_out` set)
         so a stuck/starved request cannot pin pool pages forever.
-        Raises EngineOverloadedError when cfg.max_queue live requests
-        already exist (load shedding at admission, not deep in the
-        queue)."""
+        `tenant` scopes the request's prefix-cache reads/writes to that
+        tenant's namespace (inference/prefix_cache.py): tenants never
+        hit each other's cached prefixes and each is bounded by its
+        page quota.  Raises EngineOverloadedError when cfg.max_queue
+        live requests already exist (load shedding at admission, not
+        deep in the queue)."""
         self._check_alive()
         if len(prompt_tokens) == 0:
             raise ValueError("prompt must contain at least one token "
@@ -725,6 +741,7 @@ class ServingEngine:
         self._next_rid += 1
         req = _Request(rid, prompt_tokens, max_new_tokens,
                        sampling, eos_token_id, deadline_s=deadline_s)
+        req.tenant = tenant
         self._requests[rid] = req
         self._try_prefix_match(req)
         # root (or ambient-parented) span of this request's trace; the
@@ -752,7 +769,8 @@ class ServingEngine:
         cache = self._prefix_cache
         if cache is None or req.pages:
             return
-        pages, keys, n_tok = cache.match(req.prompt)
+        pages, keys, n_tok = cache.match(req.prompt,
+                                         namespace=req.tenant)
         if n_tok:
             req.pages = list(pages)
             req.shared_keys = keys
@@ -769,7 +787,8 @@ class ServingEngine:
                 or req.cached < len(req.prompt):
             return
         req.prefix_registered = True
-        req.shared_keys.extend(cache.insert(req.prompt, req.pages))
+        req.shared_keys.extend(cache.insert(req.prompt, req.pages,
+                                            namespace=req.tenant))
 
     def _evict_expired(self):
         """Deadline sweep, run before scheduling: requests past their
@@ -797,7 +816,9 @@ class ServingEngine:
         return {"rid": r.rid, "prompt": list(r.prompt),
                 "generated": list(r.generated), "max_new": r.max_new,
                 "sampling": r.sampling, "eos_token_id": r.eos_token_id,
-                "timed_out": True,
+                "timed_out": True, "requeues": r.requeues,
+                "tenant": r.tenant, "salt_rid": r.salt_rid,
+                "salt_seed": r.salt_seed,
                 "trace": r.trace.to_dict() if r.trace is not None
                 else None}
 

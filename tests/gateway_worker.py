@@ -1,0 +1,116 @@
+"""Cross-process gateway drain worker, spawned 2x by test_gateway.py.
+
+Rank 0 stands a one-replica fleet behind a FleetGateway, admits one
+request with a pinned stream key, steps it to its decode tip, and
+drains it over the real CRC/ACK TensorTransport to rank 1's replica in
+the OTHER process (disagg.migrate_request — the same hand-off the
+fleet supervisor drives in-process).  Rank 1 receives the request at
+its decode tip under its origin salt identity and finishes the stream.
+Each rank dumps its tokens to OUT_DIR/rank{r}.npz; the parent asserts
+the remotely finished stream is bitwise-identical to rank 0's locally
+computed uninterrupted reference.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# keep the request identity in ONE place so the two ranks and the
+# parent's assertions cannot drift
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+MAX_NEW = 6
+STREAM_KEY = 777
+CHANNEL = "gw_drain"
+
+
+def _model():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig)
+
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out_dir = os.environ["GATEWAY_OUT_DIR"]
+    from paddle_tpu.distributed.transport import init_transport
+    from paddle_tpu.inference import disagg
+    from paddle_tpu.inference.serving import (PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+
+    model = _model()
+    cfg = PagedServingConfig(**BASE)
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+    tp = init_transport()
+    assert tp is not None
+
+    if rank == 0:
+        from paddle_tpu.inference.gateway import (FleetGateway,
+                                                  GatewayConfig,
+                                                  default_classes)
+        from paddle_tpu.inference.router import Replica, ReplicaRouter
+
+        eng = ServingEngine.from_model(model, cfg, seed=10)
+        router = ReplicaRouter([Replica(eng, name="r0")])
+        classes = default_classes()
+        classes["interactive"].deadline_s = None   # no eviction races
+        gw = FleetGateway(router, GatewayConfig(classes=classes))
+        ticket = gw.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=sp,
+                           slo="interactive", stream_key=STREAM_KEY)
+        gw.pump()
+        handle = gw.ticket_info(ticket)["handle"]
+        assert handle is not None
+        rid = router._handles[handle][1]
+        r = eng._requests[rid]
+        for _ in range(50):                        # reach the decode tip
+            if not r.done and r.length - r.cached == 1:
+                break
+            eng.step()
+        pre = list(r.generated)
+        disagg.migrate_request(eng, rid, tp, 1, channel=CHANNEL)
+
+        # uninterrupted reference under the SAME salt identity the
+        # gateway pinned — the engine seed is deliberately different:
+        # the stream must not depend on it
+        ref_eng = ServingEngine.from_model(model, cfg, seed=55)
+        ref_rid = ref_eng.add_request(PROMPT, max_new_tokens=MAX_NEW,
+                                      sampling=sp)
+        ref_eng._requests[ref_rid].salt_rid = STREAM_KEY
+        ref_eng._requests[ref_rid].salt_seed = 0
+        while ref_eng.pending():
+            ref_eng.step()
+        np.savez(os.path.join(out_dir, "rank0.npz"),
+                 pre=np.asarray(pre, dtype=np.int64),
+                 ref=np.asarray(ref_eng._requests[ref_rid].generated,
+                                dtype=np.int64))
+        tp.barrier("gw_drain_done", [0, 1])
+        time.sleep(1.0)        # rank 0 hosts the store: linger briefly
+    else:
+        eng = ServingEngine.from_model(model, cfg, seed=20)
+        rid = disagg.receive_request(eng, tp, 0, channel=CHANNEL)
+        while eng.pending():
+            eng.step()
+        np.savez(os.path.join(out_dir, "rank1.npz"),
+                 post=np.asarray(eng._requests[rid].generated,
+                                 dtype=np.int64))
+        tp.barrier("gw_drain_done", [0, 1])
+    tp.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -105,8 +105,8 @@ def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
 def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
     assert gate == ["llama_train", "eager_dispatch", "serving", "fleet",
-                    "fleet_recovery", "host_recovery"]
-    assert len(bench.WORKLOADS) == 11
+                    "fleet_recovery", "host_recovery", "gateway_storm"]
+    assert len(bench.WORKLOADS) == 12
 
 
 # ---------------------------------------------------------------------------
@@ -261,6 +261,38 @@ def test_benchgate_host_recovery_row_gated_like_fleet(tmp_path):
                  _host_recovery_result()) == 1
     # a baseline predating the host_recovery row gates only the rest
     assert _gate(tmp_path, _host_recovery_result(), _result()) == 0
+
+
+def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022, **kw):
+    out = _result(**kw)
+    out["extra"]["gateway_storm"] = {
+        "gateway_storm": {"n_interactive": 6, "n_batch": 4,
+                          "storm_factor": 4,
+                          "interactive_completed": completed,
+                          "goodput_rps": goodput,
+                          "interactive_ttft_p95_s": ttft,
+                          "interactive_deadline_misses": 0,
+                          "shed": 26, "bitwise_match": True},
+    }
+    return out
+
+
+def test_benchgate_gateway_storm_row_gated(tmp_path):
+    """gateway_storm (4x admit-site overload): zero slack on
+    interactive_completed — the brownout ladder must keep every
+    protected interactive request completing — threshold slack on
+    goodput and interactive p95 TTFT."""
+    assert _gate(tmp_path, _gateway_result(goodput=225.0, ttft=0.0225),
+                 _gateway_result()) == 0
+    # losing even one of six interactive requests fails, no slack
+    assert _gate(tmp_path, _gateway_result(completed=5.0),
+                 _gateway_result()) == 1
+    assert _gate(tmp_path, _gateway_result(goodput=180.0),
+                 _gateway_result()) == 1
+    assert _gate(tmp_path, _gateway_result(ttft=0.030),
+                 _gateway_result()) == 1
+    # a baseline predating the gateway row gates only the rest
+    assert _gate(tmp_path, _gateway_result(), _result()) == 0
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

@@ -1,0 +1,604 @@
+"""FleetGateway (ISSUE 12): overload-safe traffic tier — SLO-class
+admission, per-tenant token buckets + weighted-fair dequeue, a
+fleet-wide retry budget over the router's retry paths, the hysteretic
+brownout ladder, tenant-namespaced prefix caches with page quotas and
+session affinity — plus the bounded deadline-requeue fix and the new
+``overload@admit`` chaos pattern.
+
+The load-bearing invariant (same bar as the fleet-resilience suite):
+degradation may DEFER, SHORTEN, or REFUSE a stream, but never alter
+one — every completed stream is bitwise-identical to (a prefix of) the
+unloaded reference under the gateway-pinned salt identity.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.distributed.resilience.errors import GatewayRejectedError
+from paddle_tpu.inference.gateway import (BrownoutConfig,
+                                          BrownoutController,
+                                          FleetGateway, GatewayConfig,
+                                          RetryBudget, SLOClassConfig,
+                                          TenantConfig, TokenBucket,
+                                          L_CLAMP, L_DEFER_BATCH,
+                                          L_NORMAL, L_REJECT, L_SHED,
+                                          default_classes)
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import (PagedCausalLM,
+                                          PagedServingConfig,
+                                          SamplingParams, ServingEngine)
+from paddle_tpu.profiler import metrics as _metrics
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import gateway_worker  # noqa: E402  (shared cross-process constants)
+
+
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+
+SP = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+
+def _cval(name):
+    return _metrics.counter(name).value
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def _fresh_engine(model, seed=0, **over):
+    cfg = PagedServingConfig(**{**BASE, **over})
+    return ServingEngine.from_model(model, cfg, seed=seed)
+
+
+def _classes(deadline=None):
+    """Gateway classes with engine deadlines disabled (or overridden):
+    the unit tests drive determinism, not wall-clock."""
+    cls = default_classes()
+    for c in cls.values():
+        c.deadline_s = deadline
+    return cls
+
+
+def _fleet(model, gcfg=None, n=2, **over):
+    router = ReplicaRouter(
+        [Replica(_fresh_engine(model, seed=10 + i, **over),
+                 name=f"r{i}") for i in range(n)])
+    return FleetGateway(router, gcfg or GatewayConfig(
+        classes=_classes())), router
+
+
+def _reference(model, prompt, stream_key, max_new=6, salt_seed=0,
+               seed=99):
+    """Uninterrupted single-engine run under a pinned salt identity."""
+    eng = _fresh_engine(model, seed=seed)
+    rid = eng.add_request(list(prompt), max_new_tokens=max_new,
+                          sampling=SP)
+    eng._requests[rid].salt_rid = stream_key
+    eng._requests[rid].salt_seed = salt_seed
+    while eng.pending():
+        eng.step()
+    return eng._requests[rid].generated
+
+
+# ---------------------------------------------------------------------------
+# admission plumbing: token bucket + retry budget (pure units)
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_rates_and_retry_after():
+    now = [0.0]
+    b = TokenBucket(rate=2.0, burst=3.0, clock=lambda: now[0])
+    assert all(b.try_take() for _ in range(3))      # burst drained
+    assert not b.try_take()
+    assert b.time_to() == pytest.approx(0.5)        # 1 token @ 2/s
+    now[0] += 0.5
+    assert b.try_take() and not b.try_take()
+    now[0] += 10.0                                  # refill caps at burst
+    assert sum(b.try_take() for _ in range(10)) == 3
+
+
+def test_retry_budget_deposit_and_floor():
+    rb = RetryBudget(cap=2.0, deposit=0.5, floor=1.0)
+    assert rb.take() and not rb.take()              # floor spent
+    for _ in range(10):
+        rb.deposit()                                # caps at 2.0
+    assert rb.balance() == pytest.approx(2.0)
+    assert rb.take() and rb.take() and not rb.take()
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder state machine (synthetic pressure)
+# ---------------------------------------------------------------------------
+
+def test_brownout_climbs_one_level_per_hot_eval():
+    bc = BrownoutController(BrownoutConfig(enter_load=1.5,
+                                           exit_load=1.0,
+                                           hysteresis=3))
+    for want in (1, 2, 3, 4, 4):                    # clamps at reject
+        assert bc.observe(2.0) == want
+    assert bc.max_level == L_REJECT
+    assert bc.transitions[:2] == [(0, 1), (1, 2)]
+
+
+def test_brownout_hysteresis_needs_consecutive_calm():
+    bc = BrownoutController(BrownoutConfig(enter_load=1.5,
+                                           exit_load=1.0,
+                                           hysteresis=3))
+    bc.observe(2.0)
+    bc.observe(2.0)
+    assert bc.level == 2
+    # two calm evals, then a mid-band one: streak resets, no step-down
+    bc.observe(0.5)
+    bc.observe(0.5)
+    assert bc.observe(1.2) == 2                     # 1.0 < load < 1.5
+    bc.observe(0.5)
+    bc.observe(0.5)
+    assert bc.level == 2                            # still only 2 in a row
+    assert bc.observe(0.5) == 1                     # 3rd consecutive calm
+    for _ in range(3):
+        bc.observe(0.5)
+    assert bc.level == L_NORMAL
+    assert bc.observe(0.5) == L_NORMAL              # floor holds
+
+
+def test_brownout_ttft_signal_also_escalates():
+    bc = BrownoutController(BrownoutConfig(
+        enter_load=1.5, exit_load=1.0, enter_ttft_ms=100.0,
+        exit_ttft_ms=50.0, hysteresis=1))
+    assert bc.observe(0.2, ttft_p95_ms=250.0) == 1  # load calm, tail hot
+    assert bc.observe(0.2, ttft_p95_ms=80.0) == 1   # between thresholds
+    assert bc.observe(0.2, ttft_p95_ms=10.0) == 0
+
+
+# ---------------------------------------------------------------------------
+# gateway end-to-end: bitwise determinism + structured rejection
+# ---------------------------------------------------------------------------
+
+def test_gateway_streams_bitwise_match_pinned_identity(model):
+    """Tokens depend only on (salt_seed, stream_key, position): the
+    gateway's placement across two replicas must not change a single
+    token vs a one-engine reference run with different engine seeds."""
+    gw, _router = _fleet(model)
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, 96, size=n)) for n in (9, 11, 7, 13)]
+    tickets = [gw.submit(p, max_new_tokens=6, sampling=SP,
+                         slo="interactive", stream_key=100 + i)
+               for i, p in enumerate(prompts)]
+    res = gw.run_to_completion()
+    for i, t in enumerate(tickets):
+        assert res[t] == _reference(model, prompts[i], 100 + i)
+    assert gw.timed_out() == [] and gw.rejected() == {}
+
+
+def test_tenant_rate_limit_rejects_structured(model):
+    gw, _ = _fleet(model, GatewayConfig(
+        classes=_classes(),
+        tenants={"acme": TenantConfig(rate=1.0, burst=2.0)}))
+    t0 = _cval("gateway/throttled")
+    gw.submit([1, 2, 3], tenant="acme")
+    gw.submit([1, 2, 3], tenant="acme")
+    with pytest.raises(GatewayRejectedError) as ei:
+        gw.submit([1, 2, 3], tenant="acme")
+    err = ei.value
+    assert err.reason == "tenant_rate" and err.tenant == "acme"
+    assert err.slo_class == "interactive"
+    assert 0.0 < err.retry_after_s <= 1.0
+    assert _cval("gateway/throttled") == t0 + 1
+
+
+def test_unknown_slo_class_is_an_error(model):
+    gw, _ = _fleet(model)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        gw.submit([1, 2, 3], slo="platinum")
+
+
+def test_weighted_fair_dequeue_prevents_starvation(model):
+    """A hot tenant floods 10x the cold tenant's traffic FIRST; the
+    cold tenant (carrying the higher weight — it is the polite,
+    latency-sensitive one) still dispatches both its requests in the
+    very first pump instead of aging behind the hot backlog, and
+    completes.  A FIFO queue would have parked it behind all 20."""
+    gw, router = _fleet(model, GatewayConfig(
+        classes=_classes(),
+        tenants={"hot": TenantConfig(rate=1e3, burst=1e3, weight=1.0),
+                 "cold": TenantConfig(rate=1e3, burst=1e3,
+                                      weight=10.0)}),
+        max_queue=2)
+    rng = np.random.RandomState(5)
+    hot = [gw.submit(list(rng.randint(1, 96, size=8)),
+                     max_new_tokens=4, sampling=SP, tenant="hot")
+           for _ in range(20)]
+    cold = [gw.submit(list(rng.randint(1, 96, size=8)),
+                      max_new_tokens=4, sampling=SP, tenant="cold")
+            for _ in range(2)]
+    gw.pump()
+    # fleet capacity is 4 slots: the weighted share gives the cold
+    # tenant both of its requests in wave one, the hot tenant only two
+    assert all(gw.ticket_info(t)["handle"] is not None for t in cold)
+    dispatched_hot = sum(gw.ticket_info(t)["handle"] is not None
+                         for t in hot)
+    assert dispatched_hot == 2
+    res = gw.run_to_completion()
+    assert all(len(res[t]) == 4 for t in cold)
+    assert gw.timed_out() == []
+
+
+def test_retry_budget_exhaustion_rejects_with_retry_after(model):
+    """With no free redispatch allowance and an empty budget, an entry
+    that cannot place resolves as a structured rejection instead of
+    camping in the queue forever."""
+    gcfg = GatewayConfig(classes=_classes(), retry_cap=1.0,
+                         retry_deposit=0.0, retry_floor=0.0,
+                         free_redispatches=0)
+    gcfg.brownout.retry_after_s = 2.5
+    gw, router = _fleet(model, gcfg, max_queue=1)
+    rng = np.random.RandomState(6)
+    # saturate both replicas (max_queue=1 each); don't step the fleet
+    for _ in range(2):
+        gw.submit(list(rng.randint(1, 96, size=8)), max_new_tokens=4,
+                  sampling=SP)
+    gw.pump()
+    t = gw.submit(list(rng.randint(1, 96, size=8)), max_new_tokens=4,
+                  sampling=SP)
+    d0 = _cval("gateway/retry_budget_denied")
+    gw.pump()                        # first dispatch attempt is free
+    assert gw.ticket_info(t)["handle"] is None and t not in gw.rejected()
+    gw.pump()                        # retry needs budget: none left
+    err = gw.rejected()[t]
+    assert err.reason == "retry_budget"
+    assert err.retry_after_s == pytest.approx(2.5)
+    # two denials: the router's reroute gate vetoed fanning out past
+    # the first shed, then the gateway's re-dispatch charge failed
+    assert _cval("gateway/retry_budget_denied") == d0 + 2
+
+
+def test_fleet_retry_budget_gates_router_requeues(model):
+    """The same budget vetoes the router's deadline-requeue path: a
+    dry budget turns an eviction into requeue_exhausted instead of a
+    retry storm."""
+    gw, router = _fleet(model, GatewayConfig(
+        classes=_classes(), retry_cap=1.0, retry_deposit=0.0,
+        retry_floor=0.0))
+    assert router.retry_gate is not None
+    x0 = _cval("serving/requeue_exhausted")
+    assert not router.retry_gate("requeue")
+    assert _cval("gateway/retry_budget_denied") >= 1
+    # and through the real path: an engine-evicted request is NOT
+    # requeued while the budget is dry
+    h = router.submit([5, 6, 7, 8], max_new_tokens=4, sampling=SP,
+                      deadline_s=500.0)
+    idx, rid = router._handles[h]
+    router.replicas[idx].engine._requests[rid].deadline_t = 0.0
+    router.step_all()
+    assert _cval("serving/requeue_exhausted") == x0 + 1
+    assert h in router.timed_out()
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder driven through the gateway
+# ---------------------------------------------------------------------------
+
+def _pressure_gcfg(**kw):
+    return GatewayConfig(
+        classes=_classes(),
+        brownout=BrownoutConfig(enter_load=0.05, exit_load=0.01,
+                                hysteresis=2, clamp_max_new=2,
+                                retry_after_s=0.5, **kw))
+
+
+def test_brownout_defers_sheds_and_rejects_by_class(model):
+    gw, router = _fleet(model, _pressure_gcfg(), max_queue=2)
+    rng = np.random.RandomState(8)
+    mk = lambda: list(rng.randint(1, 96, size=8))
+    for _ in range(4):                      # fills both replicas
+        gw.submit(mk(), max_new_tokens=4, sampling=SP, slo="interactive")
+    tb = gw.submit(mk(), max_new_tokens=4, sampling=SP, slo="batch")
+    tbe = [gw.submit(mk(), max_new_tokens=4, sampling=SP,
+                     slo="best_effort") for _ in range(2)]
+    d0 = _cval("gateway/deferrals")
+    gw.pump()                               # load 0 -> dispatch wave
+    assert gw.brownout.level == L_NORMAL
+    gw.pump()                               # saturated -> defer_batch
+    assert gw.brownout.level == L_DEFER_BATCH
+    assert gw.ticket_info(tb)["deferred"] is True
+    assert _cval("gateway/deferrals") == d0 + 1
+    gw.pump()
+    assert gw.brownout.level == L_CLAMP
+    gw.pump()                               # shed queued best-effort
+    assert gw.brownout.level == L_SHED
+    for t in tbe:
+        err = gw.rejected()[t]
+        assert err.reason == "brownout_shed"
+        assert err.retry_after_s == pytest.approx(0.5)
+    with pytest.raises(GatewayRejectedError) as ei:
+        gw.submit(mk(), slo="best_effort")  # admission refused too
+    assert ei.value.reason == "brownout_shed"
+    gw.pump()
+    assert gw.brownout.level == L_REJECT
+    with pytest.raises(GatewayRejectedError) as ei:
+        gw.submit(mk(), slo="batch")
+    assert ei.value.reason == "brownout_reject"
+    assert ei.value.retry_after_s == pytest.approx(0.5)
+    ti = gw.submit(mk(), max_new_tokens=4, sampling=SP,
+                   slo="interactive")       # protected: still admitted
+    res = gw.run_to_completion()
+    # pressure drained -> hysteretic recovery unwound the ladder far
+    # enough for the deferred batch request to dispatch and complete
+    assert len(res[tb]) == 4 and len(res[ti]) == 4
+    assert gw.ticket_info(tb)["clamped"] is False
+    downs = [t for t in gw.brownout.transitions if t[1] < t[0]]
+    assert len(downs) >= 4                  # it DID step down, repeatedly
+    for _ in range(20):                     # idle fleet: calm evals only
+        gw.pump()
+    assert gw.brownout.level == L_NORMAL
+
+
+def test_brownout_clamps_best_effort_to_bitwise_prefix(model):
+    """Level >= 2 shortens non-interactive streams; the clamped stream
+    must be an exact prefix of its unloaded reference — shorter, never
+    different."""
+    gcfg = GatewayConfig(classes=_classes(), brownout=BrownoutConfig(
+        enter_load=100.0, exit_load=-1.0, hysteresis=10,
+        clamp_max_new=2, retry_after_s=0.5))
+    gw, router = _fleet(model, gcfg, max_queue=4)
+    gw.brownout.level = L_CLAMP             # pinned: never hot/never calm
+    rng = np.random.RandomState(9)
+    kp = list(rng.randint(1, 96, size=8))
+    keeper = gw.submit(kp, max_new_tokens=8, sampling=SP,
+                       slo="interactive", stream_key=4141)
+    p = list(rng.randint(1, 96, size=8))
+    c0 = _cval("gateway/clamped")
+    t = gw.submit(p, max_new_tokens=6, sampling=SP, slo="best_effort",
+                  stream_key=4242)
+    gw.pump()
+    assert gw.brownout.level == L_CLAMP
+    assert gw.ticket_info(t)["clamped"] is True
+    assert gw.ticket_info(keeper)["clamped"] is False
+    assert _cval("gateway/clamped") == c0 + 1
+    res = gw.run_to_completion()
+    ref = _reference(model, p, 4242, max_new=6)
+    assert len(res[t]) == 2 and res[t] == ref[:2]
+    # interactive is never clamped: full length, bitwise intact
+    assert res[keeper] == _reference(model, kp, 4141, max_new=8)
+
+
+# ---------------------------------------------------------------------------
+# overload + drop chaos at the admit site
+# ---------------------------------------------------------------------------
+
+def test_overload_chaos_multiplies_arrivals(model):
+    gw, _ = _fleet(model)
+    s0 = _cval("gateway/storm_injected")
+    faults.arm("overload@admit%1.0:x=3")
+    p = [7, 8, 9, 10, 11, 12, 13, 14]
+    t = gw.submit(p, max_new_tokens=4, sampling=SP, stream_key=61)
+    faults.disarm()
+    assert _cval("gateway/storm_injected") == s0 + 2
+    assert gw.queued() == 3                 # the real one + 2 clones
+    res = gw.run_to_completion()
+    assert res[t] == _reference(model, p, 61, max_new=4)
+
+
+def test_drop_chaos_rejects_then_recovers(model):
+    gw, _ = _fleet(model)
+    faults.arm("drop@admit#1")
+    with pytest.raises(GatewayRejectedError) as ei:
+        gw.submit([1, 2, 3, 4])
+    assert ei.value.reason == "injected_drop"
+    t = gw.submit([1, 2, 3, 4])             # one-shot: next admit works
+    assert gw.ticket_info(t)["handle"] is None and t not in gw.rejected()
+
+
+def test_fault_plan_validates_admit_site():
+    plan = faults.parse_plan("overload@admit%1.0:x=4")
+    assert plan.rules[0].factor == 4
+    with pytest.raises(ValueError):
+        faults.parse_plan("kill@admit#1")   # only overload/drop/delay
+    with pytest.raises(ValueError):
+        faults.parse_plan("overload@send#1")
+    with pytest.raises(ValueError):
+        faults.parse_plan("overload@admit#1:x=1")
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: bounded deadline requeues + salt-preserving requeue
+# ---------------------------------------------------------------------------
+
+def test_requeue_cap_bounds_deadline_pingpong(model):
+    """A request whose deadline keeps expiring must not ping-pong
+    between replicas forever: after max_requeues retries the router
+    gives up, counts requeue_exhausted, and reports the timeout."""
+    router = ReplicaRouter(
+        [Replica(_fresh_engine(model, seed=10 + i), name=f"r{i}")
+         for i in range(2)],
+        requeue_deadline_s=1e-4, max_requeues=2)
+    r0 = _cval("serving/requeues")
+    x0 = _cval("serving/requeue_exhausted")
+    h = router.submit([9, 8, 7, 6, 5], max_new_tokens=4, sampling=SP,
+                      deadline_s=1e-4)
+    for _ in range(10):
+        router.step_all()
+    # evict #1 -> requeue 1, evict #2 -> requeue 2, evict #3 -> capped
+    assert _cval("serving/requeues") == r0 + 3
+    assert _cval("serving/requeue_exhausted") == x0 + 1
+    assert h in router.timed_out()
+    idx, rid = router._handles[h]
+    assert router.replicas[idx].engine._requests[rid].requeues == 2
+
+
+def test_requeue_preserves_salt_identity(model):
+    """A deadline-evicted request retried on the peer regenerates the
+    ORIGINAL stream bitwise (the drain/migrate determinism contract now
+    covers the requeue path too)."""
+    router = ReplicaRouter(
+        [Replica(_fresh_engine(model, seed=10 + i), name=f"r{i}")
+         for i in range(2)])
+    p = [11, 12, 13, 14, 15, 16, 17, 18]
+    h = router.submit(p, max_new_tokens=5, sampling=SP,
+                      deadline_s=500.0)
+    idx, rid = router._handles[h]
+    src = router.replicas[idx].engine
+    for _ in range(5):                      # generate a token or two
+        router.step_all()
+        if len(src._requests[rid].generated) >= 1:
+            break
+    assert len(src._requests[rid].generated) >= 1
+    src._requests[rid].deadline_t = 0.0     # force the next sweep
+    router.step_all()                       # evict + requeue on peer
+    n_idx, _ = router._handles[h]
+    assert n_idx != idx
+    out = router.run_to_completion()
+    assert out[h] == _reference(model, p, rid, max_new=5,
+                                salt_seed=src.seed)
+
+
+# ---------------------------------------------------------------------------
+# tenant prefix-cache namespaces, page quotas, session affinity
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_namespaces_isolate_and_probe():
+    c = PrefixCache(block_size=4)
+    prompt = list(range(1, 13))             # 3 full blocks
+    c.release(c.insert(prompt, [3, 4, 5], namespace="a"))
+    pages, keys, n = c.match(prompt, namespace="a")
+    assert n == 8 and pages == [3, 4]       # strict prefix: tip block out
+    c.release(keys)
+    # same tokens under another tenant: invisible
+    assert c.match(prompt, namespace="b")[2] == 0
+    # probe scores coverage WITHOUT acquiring refs
+    assert c.probe(prompt, namespace="a") == 8
+    assert c.probe(prompt + [77], namespace="a") == 12
+    assert c.probe(prompt + [77], namespace="b") == 0
+    assert c.evictable_count() == 3         # probe pinned nothing
+    assert c.namespace_pages("a") == 3 and c.namespace_pages("b") == 0
+
+
+def test_prefix_cache_namespace_quota_bounds_pages():
+    c = PrefixCache(block_size=4)
+    c.set_quota("small", 1)
+    c.insert(list(range(1, 13)), [3, 4, 5], namespace="small")
+    assert c.namespace_pages("small") == 1  # quota stopped the insert
+    c.insert(list(range(1, 13)), [6, 7, 8], namespace="big")
+    assert c.namespace_pages("big") == 3    # other tenants unaffected
+
+
+def test_gateway_session_affinity_routes_to_prefix_holder(model):
+    gw, router = _fleet(model, GatewayConfig(
+        classes=_classes(),
+        tenants={"acme": TenantConfig(page_quota=8)}),
+        prefix_cache=True)
+    rng = np.random.RandomState(12)
+    turn1 = list(rng.randint(1, 96, size=16))      # two full blocks
+    t1 = gw.submit(turn1, max_new_tokens=4, sampling=SP, tenant="acme",
+                   session="chat-1", stream_key=900)
+    gw.run_to_completion()
+    idx1, _ = router._handles[gw.ticket_info(t1)["handle"]]
+    a0 = _cval("gateway/affinity_hits")
+    t2 = gw.submit(turn1 + [40, 41], max_new_tokens=4, sampling=SP,
+                   tenant="acme", session="chat-1", stream_key=901)
+    gw.pump()
+    idx2, _ = router._handles[gw.ticket_info(t2)["handle"]]
+    assert idx2 == idx1                      # followed its prefix chain
+    assert _cval("gateway/affinity_hits") == a0 + 1
+    # the tenant quota was pushed onto every replica's cache
+    cache = router.replicas[idx1].engine._prefix_cache
+    assert cache.namespace_pages("acme") <= 8
+    # and another tenant sees none of acme's pages
+    assert cache.probe(turn1, namespace="other") == 0
+    gw.run_to_completion()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: cross-process drain over the real TensorTransport
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_gateway_pair(out_dir, port, timeout=240):
+    worker = os.path.join(os.path.dirname(__file__), "gateway_worker.py")
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_JAX_DISTRIBUTED": "0",
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": "127.0.0.1:6190,127.0.0.1:6191",
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:619{rank}",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_STORE_TIMEOUT": "120",
+            "GATEWAY_OUT_DIR": out_dir,
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs, rcs = [], []
+    hung = False
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            hung = True
+        outs.append(out.decode())
+        rcs.append(p.returncode)
+    transient = hung or any(
+        ("PeerUnreachableError" in o or "Connection refused" in o
+         or "Connection reset" in o or "ConnectionResetError" in o
+         or "store key" in o) for o in outs)
+    return rcs, transient, outs
+
+
+def test_cross_process_gateway_drain_bitwise(model, tmp_path_factory):
+    """Two replicas in SEPARATE processes behind the real CRC/ACK
+    TensorTransport: rank 0's gateway admits a request, steps it to its
+    decode tip, and drains it to rank 1, which finishes the stream.
+    The remotely finished stream must be bitwise-identical to the
+    uninterrupted single-engine reference under the gateway-pinned
+    salt identity."""
+    rcs, outs = [1], []
+    for attempt in range(3):
+        out_dir = str(tmp_path_factory.mktemp(f"gwdrain{attempt}"))
+        rcs, transient, outs = _spawn_gateway_pair(out_dir, _free_port())
+        if all(rc == 0 for rc in rcs) or not transient:
+            break
+    if not all(rc == 0 for rc in rcs):
+        pytest.fail("gateway drain cluster failed; outputs:\n"
+                    + "\n----\n".join(outs))
+    r0 = np.load(os.path.join(out_dir, "rank0.npz"))
+    r1 = np.load(os.path.join(out_dir, "rank1.npz"))
+    pre, ref, post = (r0["pre"].tolist(), r0["ref"].tolist(),
+                      r1["post"].tolist())
+    assert len(pre) >= 1                       # drained mid-decode
+    assert post[:len(pre)] == pre              # history shipped intact
+    assert post == ref                         # bitwise vs uninterrupted
+    assert len(post) == gateway_worker.MAX_NEW

@@ -18,6 +18,11 @@ signal regressed:
   felled at once): ``requests_completed`` dropping AT ALL (every
   admitted request must survive the kill; no threshold slack), or
   ``recovery_s`` rising more than the threshold,
+- overload (bench.py's ``gateway_storm`` row — every arrival
+  multiplied 4x at the gateway's admit site): ``interactive_completed``
+  dropping AT ALL (the brownout ladder must protect interactive
+  traffic; no slack), ``goodput_rps`` dropping or
+  ``interactive_ttft_p95_s`` rising more than the threshold,
 - the candidate missing the flagship metric entirely (a timed-out
   flagship row must fail the gate, not silently pass it — the r05
   failure mode).
@@ -143,20 +148,31 @@ def _fleet_metrics(result):
             if isinstance(fleet.get(m), (int, float))}
 
 
-# chaos recovery rows: a replica (fleet_recovery) or a whole host's
-# replicas (host_recovery) are killed mid-decode and the supervisor
-# must drain + restart. requests_completed is gated with ZERO slack
-# (any drop means an admitted request was lost under the kill);
-# recovery_s gets the normal relative threshold. Both rows share the
-# gate shape; they differ only in which bench row they read.
+# chaos rows, all sharing one gate shape: {metric: True} means higher
+# is better (a drop fails), False means lower is better (a rise fails).
+# Metrics named in the third field are gated with ZERO slack — any drop
+# under the injected fault means an admitted request was lost (the
+# recovery rows) or a protected interactive request failed to complete
+# under the 4x storm (gateway_storm).
 _RECOVERY_GATES = {"requests_completed": True, "recovery_s": False}
-_RECOVERY_ROWS = ("fleet_recovery", "host_recovery")
+_GATEWAY_GATES = {"interactive_completed": True, "goodput_rps": True,
+                  "interactive_ttft_p95_s": False}
+_CHAOS_ROWS = (
+    # fleet_recovery: one replica killed mid-decode; host_recovery: a
+    # whole host's replicas felled at once; gateway_storm: every
+    # arrival multiplied 4x at the admit site
+    ("fleet_recovery", _RECOVERY_GATES, ("requests_completed",)),
+    ("host_recovery", _RECOVERY_GATES, ("requests_completed",)),
+    ("gateway_storm", _GATEWAY_GATES, ("interactive_completed",)),
+)
+_RECOVERY_ROWS = tuple(r for r, _, _ in _CHAOS_ROWS)
 
 
-def _recovery_metrics(result, row):
-    """{metric: value} for one gated chaos-recovery row."""
+def _recovery_metrics(result, row, gates=None):
+    """{metric: value} for one gated chaos row."""
+    gates = gates or _RECOVERY_GATES
     rec = ((result.get("extra") or {}).get(row) or {}).get(row) or {}
-    return {m: float(rec[m]) for m in _RECOVERY_GATES
+    return {m: float(rec[m]) for m in gates
             if isinstance(rec.get(m), (int, float))}
 
 
@@ -217,19 +233,19 @@ def compare(candidate, baseline, threshold=0.05):
                 f"fleet.{m} {word} {delta * 100:.1f}% "
                 f"(> {threshold * 100:.0f}%)")
 
-    for row in _RECOVERY_ROWS:
-        cand_rc = _recovery_metrics(candidate, row)
-        base_rc = _recovery_metrics(baseline, row)
+    for row, gates, zero_slack in _CHAOS_ROWS:
+        cand_rc = _recovery_metrics(candidate, row, gates)
+        base_rc = _recovery_metrics(baseline, row, gates)
         for m in sorted(set(cand_rc) & set(base_rc)):
             b, c = base_rc[m], cand_rc[m]
             if b <= 0:
                 continue
-            if _RECOVERY_GATES[m]:
-                # completed-request count: ANY drop under the injected
-                # kill means a request was lost — no threshold slack.
+            if gates[m]:
                 delta = (b - c) / b
                 word = "dropped"
-                budget = 0.0
+                # zero-slack counts: ANY drop under the injected fault
+                # means an admitted/protected request was lost
+                budget = 0.0 if m in zero_slack else threshold
             else:
                 delta = (c - b) / b
                 word = "rose"
@@ -237,7 +253,7 @@ def compare(candidate, baseline, threshold=0.05):
             verdict = "FAIL" if delta > budget else "ok"
             lines.append(
                 f"{row}.{m}: {b:g} -> {c:g}  "
-                f"({-delta * 100 if _RECOVERY_GATES[m] else delta * 100:+.1f}%) "
+                f"({-delta * 100 if gates[m] else delta * 100:+.1f}%) "
                 f"[{verdict}]")
             if delta > budget:
                 failures.append(

@@ -98,6 +98,11 @@ KNOWN_METRICS = (
     # cross-host serving failover: off-host drain targets + real
     # TensorTransport KV hand-offs (inference/fleet_supervisor.py)
     "serving/cross_host_drains", "serving/cross_host_migrations",
+    # bounded deadline-requeue retries (inference/router.py)
+    "serving/requeue_exhausted",
+    # overload-safe traffic tier: SLO-class admission, tenant fairness,
+    # retry budget, brownout ladder (inference/gateway.py)
+    "gateway/*",
     "serving/prefix_hits_restored", "serving/cache_restore_ms",
     "serving/cache_snapshots", "serving/cache_snapshots_swept",
     "serving/cache_snapshots_pruned",
