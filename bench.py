@@ -1165,6 +1165,107 @@ def host_dispatch_bench(measure_us):
                 "matmul_add_fwd_bwd_us": round(measure_us(fwdbwd_h), 1)}
 
 
+def bench_spec_decode(on_tpu):
+    """Speculative decoding gate row (ISSUE 13): a DRAFTABLE
+    shared-prompt workload — B greedy requests behind one common system
+    prompt whose continuations an NGramDrafter has already observed —
+    decoded step-by-step WITH and WITHOUT speculation.  Both sides pay
+    one engine dispatch per iteration; the speculative side verifies k
+    drafted tokens in that one paged step and emits every accepted one,
+    so tokens/s is the accept rate made visible.  ``bitwise_match`` is
+    the exactness contract (spec streams identical to the baseline,
+    zero slack in benchgate); accept_rate and per-step latency are
+    reported so a drafter regression shows up as itself rather than as
+    a mystery throughput drop."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              ServingEngine)
+    from paddle_tpu.inference.speculative import NGramDrafter
+
+    if on_tpu:
+        cfg = PagedServingConfig.llama_1b(
+            max_batch=4, num_blocks=4 * 14 + 16, max_blocks_per_seq=14)
+        shared_len, tail_len, max_new, k = 96, 4, 128, 8
+    else:
+        cfg = PagedServingConfig(vocab_size=128, hidden_size=32,
+                                 num_layers=2, num_heads=4,
+                                 num_kv_heads=2, ffn_size=64,
+                                 block_size=8, num_blocks=64,
+                                 max_batch=4, max_blocks_per_seq=8,
+                                 token_budget=64)
+        shared_len, tail_len, max_new, k = 24, 3, 24, 4
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    shared = list(rng.randint(1, cfg.vocab_size, shared_len))
+    prompts = [shared + list(rng.randint(1, cfg.vocab_size, tail_len))
+               for _ in range(cfg.max_batch)]
+
+    def decode_wave(engine):
+        """Submit, prefill to the tip, then time the pure decode loop:
+        one engine dispatch per iteration on both sides."""
+        rids = [engine.add_request(list(p), max_new_tokens=max_new)
+                for p in prompts]
+        while any(r.length - r.cached > 1 for r in engine.pending()):
+            engine.step()
+        t0 = time.perf_counter()
+        steps = 0
+        while engine.pending():
+            engine.step()
+            steps += 1
+        dt = time.perf_counter() - t0
+        out = engine.run_to_completion()
+        return [out[r] for r in rids], dt, steps
+
+    # teach wave: serve the workload once, plainly, and let the drafter
+    # observe the streams (the prefix-cache-digest block table plus the
+    # n-gram table now know every continuation)
+    drafter = NGramDrafter(block_size=cfg.block_size)
+    ref, _, _ = decode_wave(ServingEngine.from_model(model, cfg, seed=0))
+    for p, toks in zip(prompts, ref):
+        drafter.observe(list(p) + toks)
+
+    # baseline: warmed non-speculative step loop
+    base_out, base_dt, base_steps = decode_wave(
+        ServingEngine.from_model(model, cfg, seed=0))
+
+    # speculative: warm wave compiles the verify shapes, second wave is
+    # the measured one
+    def spec_engine():
+        eng = ServingEngine.from_model(model, cfg, seed=0)
+        eng.set_drafter(drafter, k=k)
+        return eng
+
+    decode_wave(spec_engine())
+    eng = spec_engine()
+    spec_out, spec_dt, spec_steps = decode_wave(eng)
+
+    n_tok = sum(len(t) for t in spec_out)
+    accept = eng._spec_accepted_total / max(eng._spec_drafted_total, 1)
+    base_tps = sum(len(t) for t in base_out) / base_dt
+    spec_tps = n_tok / spec_dt
+    return {"spec_decode": {
+        "tokens_per_sec": round(spec_tps, 1),
+        "baseline_tokens_per_sec": round(base_tps, 1),
+        "speedup": round(spec_tps / base_tps, 3),
+        "accept_rate": round(accept, 4),
+        "spec_tokens_per_step": round(n_tok / max(spec_steps, 1), 2),
+        "step_ms": round(spec_dt / max(spec_steps, 1) * 1e3, 3),
+        "baseline_step_ms": round(base_dt / max(base_steps, 1) * 1e3, 3),
+        "decode_steps": spec_steps,
+        "baseline_decode_steps": base_steps,
+        "bitwise_match": 1.0 if spec_out == base_out == ref else 0.0,
+        "k": k,
+        "drafter": "ngram+block",
+        "max_new": max_new,
+        "shared_prompt_len": shared_len,
+        "batch": cfg.max_batch,
+    }}
+
+
 def bench_eager_dispatch(on_tpu):
     """Eager per-op dispatch cost through the per-signature jit cache
     (VERDICT r2 #1; reference analog: the all-C++ eager hot path,
@@ -1348,6 +1449,7 @@ WORKLOADS = (
     ("eager_dispatch", bench_eager_dispatch, True),
     ("llama13b_block", bench_llama13b_block, False),
     ("serving", bench_serving, True),
+    ("spec_decode", bench_spec_decode, True),
     ("fleet", bench_fleet_serving, True),
     ("fleet_recovery", bench_fleet_recovery, True),
     ("host_recovery", bench_host_recovery, True),

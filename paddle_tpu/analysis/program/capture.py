@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
 __all__ = ["Capture", "PRESETS", "capture_mlp", "capture_llama_block",
-           "load_target"]
+           "capture_decode_step", "decode_step_spec", "load_target"]
 
 
 @dataclass
@@ -83,10 +83,117 @@ def capture_llama_block(batch: int = 2, seq: int = 64, hidden: int = 128,
                                                intermediate).program)
 
 
+def decode_step_spec(rows: int = 4, heads: int = 4, head_dim: int = 16,
+                     block_size: int = 8, max_blocks: int = 4,
+                     n_pages: int = 16, ffn: int = 128,
+                     vocab: int = 256):
+    """(fn, input_spec) for one serving DECODE iteration — the callable
+    ``jit.lower_stablehlo(fn, spec, auto_fuse=True)`` captures so the
+    whole decode step lowers as ONE verified fused region, and the body
+    ``capture_decode_step`` records for the ``decode`` preset.
+
+    Structurally the read path of ``PagedCausalLM.forward`` at decode
+    shapes: paged KV gather (``index_select`` over the page pool by the
+    block table), one-query-per-row attention with an additive length
+    mask, RMSNorm chains, swiglu MLP, LM head and the on-device argmax
+    sample — the memory-bound elementwise/softmax/norm chains between
+    the matmuls are exactly what ``auto_fuse`` is meant to collapse.
+    The cache APPEND (a dynamic-update-slice into the pool) is left
+    out: it is a write, not a fusion candidate, and needs no roofline.
+    """
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from ...incubate.nn.functional import swiglu
+    from ...jit.api import InputSpec
+
+    hidden = heads * head_dim
+    S = max_blocks * block_size
+    rng = np.random.RandomState(7)
+
+    def w(*shape):
+        return paddle.to_tensor(
+            rng.randn(*shape).astype(np.float32) * 0.05)
+
+    g1, g2, gf = w(hidden), w(hidden), w(hidden)
+    wq, wk, wv = w(hidden, hidden), w(hidden, hidden), w(hidden, hidden)
+    wo = w(hidden, hidden)
+    w_gate, w_up = w(hidden, ffn), w(hidden, ffn)
+    w_down = w(ffn, hidden)
+    w_head = w(hidden, vocab)
+    scale = 1.0 / float(np.sqrt(head_dim))
+
+    def rms(x, g):
+        m = paddle.mean(x * x, axis=-1, keepdim=True)
+        return x * paddle.rsqrt(m + 1e-6) * g
+
+    def fn(x, kpages, vpages, bt, mask):
+        h = rms(x, g1)
+        q = paddle.matmul(h, wq)
+        # (decode writes this step's k/v into the pool too; the gather
+        # below reads the pool state, which dominates the traffic)
+        paddle.matmul(h, wk)
+        paddle.matmul(h, wv)
+
+        def heads_first(pages):
+            t = paddle.index_select(pages, bt, axis=0)
+            t = paddle.reshape(t, [rows, S, heads, head_dim])
+            t = paddle.transpose(t, [0, 2, 1, 3])
+            return paddle.reshape(t, [rows * heads, S, head_dim])
+
+        k_all = heads_first(kpages)
+        v_all = heads_first(vpages)
+        q_r = paddle.reshape(q, [rows * heads, 1, head_dim])
+        scores = paddle.matmul(q_r, k_all, transpose_y=True) * scale
+        scores = paddle.reshape(scores, [rows, heads, 1, S]) + mask
+        probs = paddle.nn.functional.softmax(scores, axis=-1)
+        probs = paddle.reshape(probs, [rows * heads, 1, S])
+        attn = paddle.reshape(paddle.matmul(probs, v_all),
+                              [rows, hidden])
+        x1 = x + paddle.matmul(attn, wo)
+        h2 = rms(x1, g2)
+        gate = paddle.matmul(h2, w_gate)
+        up = paddle.matmul(h2, w_up)
+        x2 = x1 + paddle.matmul(swiglu(gate, up), w_down)
+        logits = paddle.matmul(rms(x2, gf), w_head)
+        sampled = paddle.argmax(logits, axis=-1)
+        return logits, sampled
+
+    spec = [
+        InputSpec((rows, hidden), "float32", "x"),
+        InputSpec((n_pages, block_size, hidden), "float32", "kpages"),
+        InputSpec((n_pages, block_size, hidden), "float32", "vpages"),
+        InputSpec((rows * max_blocks,), "int32", "block_tables"),
+        InputSpec((rows, 1, 1, S), "float32", "mask"),
+    ]
+    return fn, spec
+
+
+def capture_decode_step(rows: int = 4, heads: int = 4, head_dim: int = 16,
+                        block_size: int = 8, max_blocks: int = 4,
+                        n_pages: int = 16, ffn: int = 128,
+                        vocab: int = 256) -> Capture:
+    """The ``decode`` preset: ``decode_step_spec``'s iteration recorded
+    into a fresh Program for auto_fuse/roofline/StableHLO — the
+    inspectable compiler artifact of serving.py's whole-step decode
+    executable (tools/fusereport.py --preset decode)."""
+    from ...jit.api import capture_program
+
+    fn, spec = decode_step_spec(rows, heads, head_dim, block_size,
+                                max_blocks, n_pages, ffn, vocab)
+    prog = capture_program(fn, spec)
+    return Capture(
+        name="decode", program=prog,
+        capture_fn=lambda: capture_decode_step(
+            rows, heads, head_dim, block_size, max_blocks, n_pages,
+            ffn, vocab).program)
+
+
 PRESETS: Dict[str, Callable[[], Capture]] = {
     "mlp": capture_mlp,
     "llama": capture_llama_block,
     "llama-block": capture_llama_block,
+    "decode": capture_decode_step,
 }
 
 

@@ -361,6 +361,8 @@ _FLEET_EXPORTS = {
     "migrate_request": "disagg", "receive_request": "disagg",
     "Replica": "router", "ReplicaRouter": "router",
     "WeightStreamer": "weight_stream",
+    "Drafter": "speculative", "NGramDrafter": "speculative",
+    "DraftModelDrafter": "speculative",
     "FleetSupervisor": "fleet_supervisor",
     "FleetSupervisorConfig": "fleet_supervisor",
     "LoopbackTransport": "fleet_supervisor",

@@ -243,7 +243,12 @@ class DecodeWorker:
         for _ in range(max_steps):
             if not self.engine.pending():
                 break
-            if not self.engine.decode_run(window):
+            if self.engine._drafter is not None:
+                # speculative engine: step() diverts decode-tip batches
+                # through the draft+verify path (more tokens per
+                # dispatch than the one-token-per-step scan window)
+                self.engine.step()
+            elif not self.engine.decode_run(window):
                 self.engine.step()      # page-tight fallback (can preempt)
         return {rid: list(r.generated)
                 for rid, r in self.engine._requests.items()}

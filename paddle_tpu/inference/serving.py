@@ -56,7 +56,9 @@ class _EngineMetrics:
 
     __slots__ = ("ttft", "tpot", "steps", "tokens", "requests",
                  "preempt", "occupancy", "kv_util", "deadline", "shed",
-                 "prefix_rate", "prefix_pages")
+                 "prefix_rate", "prefix_pages", "spec_steps",
+                 "spec_drafted", "spec_accepted", "spec_accept_rate",
+                 "spec_tokens_per_step", "fused_regions")
 
     def __init__(self, reg):
         self.ttft = reg.histogram("serving/ttft_ms")
@@ -71,6 +73,16 @@ class _EngineMetrics:
         self.shed = reg.counter("serving/load_shed")
         self.prefix_rate = reg.gauge("serving/prefix_hit_rate")
         self.prefix_pages = reg.counter("serving/prefix_pages_reused")
+        # speculative decoding (inference/speculative.py + _spec_step)
+        self.spec_steps = reg.counter("serving/spec_steps")
+        self.spec_drafted = reg.counter("serving/spec_drafted_tokens")
+        self.spec_accepted = reg.counter("serving/spec_accepted_tokens")
+        self.spec_accept_rate = reg.gauge("serving/spec_accept_rate")
+        self.spec_tokens_per_step = reg.gauge(
+            "serving/spec_tokens_per_step")
+        # distinct whole-iteration decode executables this engine built
+        # (decode windows + speculative verify shapes)
+        self.fused_regions = reg.counter("compiler/fused_decode_regions")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
@@ -252,6 +264,12 @@ def _topk_fast_ok(temps, topks):
                                     & (topks <= _TOPK_FAST_C))))
 
 
+def _next_pow2(n):
+    """Smallest power of two >= n (n >= 1) — the shape-bucketing unit
+    that bounds decode/verify retraces at log2 distinct executables."""
+    return 1 << (int(n) - 1).bit_length()
+
+
 _greedy_tokens_dev = jax.jit(
     lambda logits: jnp.argmax(logits, axis=-1).astype(jnp.int32))
 _sample_tokens_dev = jax.jit(_sample_core)
@@ -417,6 +435,14 @@ class PagedCausalLM(Layer):
             h = self.ln2[li](x)
             x = x + self._mlp(li, h, cur_w)
         x = self.ln_f(x)
+        if getattr(self, "_step_mode", None) == "spec_verify":
+            # speculative verify: logits at EVERY packed position (the
+            # engine samples each drafted slot with its own salt and
+            # accepts the longest matching run) instead of pick_last
+            logits = self.head(x)                        # [T, V]
+            if quant:
+                return logits, new_kc, new_vc, new_ks, new_vs
+            return logits, new_kc, new_vc
         # last token of each row: cu_q[i+1]-1 (rows with 0 tokens this
         # step read their previous row's last token — masked host-side)
         def pick_last(xa, cu):
@@ -488,7 +514,8 @@ class _Request:
                  "cached", "done", "sampling", "eos_token_id",
                  "submit_t", "first_tok_t", "deadline_t", "timed_out",
                  "shared_keys", "prefix_registered", "salt_rid",
-                 "salt_seed", "trace", "sched_t0", "requeues", "tenant")
+                 "salt_seed", "trace", "sched_t0", "requeues", "tenant",
+                 "spec_observed")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
                  deadline_s=None):
@@ -529,6 +556,10 @@ class _Request:
         # admission tenant: prefix-cache namespace + the gateway's
         # fairness/quota identity; None = the shared default namespace
         self.tenant = None
+        # speculative decoding: how much of prompt+generated the
+        # engine's drafter has already observed (0 on any new engine —
+        # a migrated/requeued request re-teaches the peer's drafter)
+        self.spec_observed = 0
 
     @property
     def length(self):
@@ -568,6 +599,15 @@ class ServingEngine:
         else:
             self._fixed_token_len = None
         self._compiled_fresh = None   # set by from_model (jit engines)
+        self._compiled_verify = None  # all-positions logits (from_model)
+        # speculative decoding (inference/speculative.py): attached via
+        # set_drafter; while set, _step diverts pure decode-tip batches
+        # through _spec_step (draft k, verify in one paged step)
+        self._drafter = None
+        self._spec_k = 0
+        self._spec_shapes = set()     # verify tok_lens compiled so far
+        self._spec_drafted_total = 0
+        self._spec_accepted_total = 0
         self.seed = seed
         self.cfg = cfg
         L = cfg.num_layers
@@ -643,17 +683,24 @@ class ServingEngine:
         weight-streaming-bound decode step (the PR 2 int8-KV finding).
         ``"int8"`` prefetches; ``"int8-noprefetch"`` dequantizes at use
         (the honest baseline the micro-bench prices the overlap
-        against).  Generations match an engine over the dequantized
-        weights bitwise; vs the full-precision engine they differ by the
-        quantization error."""
+        against); ``"int4"`` packs two 4-bit codes per byte with
+        per-(input-group, out-channel) scales — quarter the streamed
+        bytes of bf16 at a larger quant error.  Generations match an
+        engine over the dequantized weights bitwise; vs the
+        full-precision engine they differ by the quantization error."""
         from ..jit import functional as FB
 
+        if weight_stream not in (None, "int8", "int8-noprefetch",
+                                 "int4"):
+            raise ValueError(
+                f"weight_stream={weight_stream!r}: expected None, "
+                f"'int8', 'int8-noprefetch' or 'int4'")
         eng = cls(None, cfg, seed=seed)
         share_key = (cfg.dtype, cfg.cache_quant, weight_stream)
         cached = getattr(model, "_serving_shared", None)
         if cached is not None and cached[0] == share_key:
-            (_, eng._compiled, eng._compiled_fresh, eng._params,
-             eng._buffers) = cached
+            (_, eng._compiled, eng._compiled_fresh,
+             eng._compiled_verify, eng._params, eng._buffers) = cached
             return eng
         params = FB.current_params(model)
         buffers = FB.current_buffers(model)
@@ -667,7 +714,8 @@ class ServingEngine:
 
             streamer = WeightStreamer.build(
                 model, cast, tgt,
-                prefetch=weight_stream != "int8-noprefetch")
+                prefetch=weight_stream != "int8-noprefetch",
+                mode="int4" if weight_stream == "int4" else "int8")
         else:
             streamer = None
         flat_p, tree_p = jax.tree_util.tree_flatten(cast)
@@ -700,14 +748,24 @@ class ServingEngine:
             finally:
                 object.__setattr__(model, "_step_mode", None)
 
+        def pure_verify(fp, fb, *ins):
+            # trace-time flag: the LM head runs at every packed position
+            # (speculative verify samples each drafted slot)
+            object.__setattr__(model, "_step_mode", "spec_verify")
+            try:
+                return pure(fp, fb, *ins)
+            finally:
+                object.__setattr__(model, "_step_mode", None)
+
         eng._params = jax.device_put(flat_p)
         eng._buffers = jax.device_put(flat_b)
         eng._compiled = jax.jit(pure)
         eng._compiled_fresh = jax.jit(pure_fresh)
+        eng._compiled_verify = jax.jit(pure_verify)
         object.__setattr__(model, "_serving_shared",
                            (share_key, eng._compiled,
-                            eng._compiled_fresh, eng._params,
-                            eng._buffers))
+                            eng._compiled_fresh, eng._compiled_verify,
+                            eng._params, eng._buffers))
         return eng
 
     # -- scheduling ------------------------------------------------------
@@ -761,6 +819,42 @@ class ServingEngine:
             else _metrics.child(namespace)
         self._m = _EngineMetrics(reg)
         return self._m
+
+    def set_drafter(self, drafter, k=None):
+        """Attach a speculative drafter (inference/speculative.py).
+
+        While a drafter is set, any step whose scheduled batch is pure
+        decode-tip rows runs as ONE speculative verify step: the
+        drafter proposes up to ``k`` tokens per row, the target model
+        scores the proposal in a single paged-attention dispatch, and
+        each position is sampled under the SAME salt the plain path
+        would use — so the emitted stream is token-bitwise-identical to
+        non-speculative decoding, and rejected-tail KV pages roll back
+        to the pool.  ``k`` defaults to ``PT_SPEC_K`` (env) or 4.
+        ``set_drafter(None)`` turns speculation off."""
+        if drafter is not None and self._compiled_verify is None:
+            raise ValueError(
+                "speculative decoding needs a from_model engine: the "
+                "exported serving artifact has no all-positions verify "
+                "entry")
+        self._drafter = drafter
+        if k is not None:
+            self._spec_k = int(k)
+        elif self._spec_k <= 0:
+            import os
+
+            self._spec_k = int(os.environ.get("PT_SPEC_K", "4"))
+        if self._spec_k < 1:
+            raise ValueError("speculative draft length k must be >= 1")
+        return drafter
+
+    def _spec_observe(self, r):
+        """Feed the drafter everything of this request it has not seen
+        (prompt on first contact, then each newly emitted suffix)."""
+        seq = r.prompt + r.generated
+        if r.spec_observed < len(seq):
+            self._drafter.observe(seq, start=r.spec_observed)
+            r.spec_observed = len(seq)
 
     def _try_prefix_match(self, req):
         """Map the request's leading full prompt blocks onto cached pages
@@ -1042,6 +1136,15 @@ class ServingEngine:
                         parent=r.trace,
                         args={"rid": r.rid, "engine": self.name})
 
+        # speculative divert: a pure decode-tip batch (every scheduled
+        # row needs exactly its next token) runs as one draft+verify
+        # step instead — transparent to every caller of step(), so the
+        # router/gateway/supervisor tiers become speculative unchanged
+        if self._drafter is not None and all(
+                chunk == 1 and r.cached == r.length - 1
+                for r, chunk in rows):
+            return self._spec_step(rows)
+
         B1 = cfg.max_batch + 1
         enc = np.zeros(B1, np.int32)
         dec = np.zeros(B1, np.int32)
@@ -1131,6 +1234,172 @@ class ServingEngine:
         self._m.tokens.inc(len(produced))
         return produced
 
+    # -- speculative decode (draft k, verify in one paged step) ----------
+    def _spec_step(self, rows):
+        """One speculative iteration over decode-tip rows: the drafter
+        proposes up to ``_spec_k`` tokens per row, the target model
+        scores tip+drafts in ONE paged-attention dispatch (the verify
+        chunk is shaped exactly like a chunked-prefill continuation),
+        and every position is sampled under the salt the plain decode
+        path would use at that generated index.  A draft is accepted
+        only when it EQUALS the token the target sampled at the
+        previous position, so the emitted stream is token-bitwise-
+        identical to non-speculative decoding; KV pages holding only
+        rejected-tail slots roll back to the pool, leaving each row at
+        its decode tip (migratable/requeueable) after every step."""
+        cfg = self.cfg
+        B1 = cfg.max_batch + 1
+        drafter = self._drafter
+
+        # plan: per-row draft length, clamped to the remaining max_new
+        # budget (later rows keep >= 1 slot each) and the page pool
+        budget = cfg.token_budget
+        avail = len(self._free_pages)
+        if self._prefix_cache is not None:
+            avail += self._prefix_cache.evictable_count()
+        plans = []
+        for idx, (r, _chunk) in enumerate(rows):
+            self._spec_observe(r)
+            rows_after = len(rows) - idx - 1
+            cap = min(self._spec_k,
+                      r.max_new - len(r.generated) - 1,
+                      budget - 1 - rows_after)
+            drafts = []
+            if cap > 0:
+                proposed = drafter.propose(r.prompt + r.generated, cap)
+                for t in list(proposed)[:cap]:
+                    t = int(t)
+                    if not 0 <= t < cfg.vocab_size:
+                        break      # alien draft vocab: stop the run
+                    drafts.append(t)
+            while drafts and max(
+                    math.ceil((r.cached + 1 + len(drafts))
+                              / cfg.block_size) - len(r.pages),
+                    0) > avail:
+                drafts.pop()       # page-limited: shorten the proposal
+            avail -= max(math.ceil((r.cached + 1 + len(drafts))
+                                   / cfg.block_size) - len(r.pages), 0)
+            budget -= 1 + len(drafts)
+            plans.append((r, drafts))
+
+        enc = np.zeros(B1, np.int32)
+        dec = np.zeros(B1, np.int32)
+        this = np.zeros(B1, np.int32)
+        bt = np.zeros((B1, cfg.max_blocks_per_seq), np.int32)
+        packed = []
+        spans = []
+        for i, (r, drafts) in enumerate(plans):
+            n_feed = 1 + len(drafts)
+            dec[i] = r.cached
+            this[i] = n_feed
+            self._ensure_pages(r, r.cached + n_feed)
+            bt[i, :len(r.pages)] = r.pages
+            spans.append((len(packed), n_feed))
+            packed.append((r.prompt + r.generated)[-1])
+            packed.extend(drafts)
+        self._update_pool_gauges(len(plans))
+        # pad to a power-of-two token length (the trash row absorbs the
+        # padding, exactly as in _step) so verify executables stay
+        # bounded at log2(token_budget) shapes
+        tok_len = self._fixed_token_len \
+            or min(_next_pow2(len(packed)), cfg.token_budget)
+        if tok_len not in self._spec_shapes:
+            if self._spec_shapes:
+                from ..jit.api import note_retrace
+
+                note_retrace("spec_verify")
+            self._spec_shapes.add(tok_len)
+            self._m.fused_regions.inc()
+        n_pad = tok_len - len(packed)
+        this[B1 - 1] = n_pad
+        enc[B1 - 1] = n_pad
+        tokens = np.asarray(packed + [0] * n_pad, np.int32)
+        cu = np.zeros(B1 + 1, np.int32)
+        cu[1:] = np.cumsum(this)
+
+        extra = (self._ks, self._vs) if self._ks is not None else ()
+        out = self._compiled_verify(
+            self._params, self._buffers, tokens, enc, dec, this, cu,
+            bt, self._kc, self._vc, *extra)
+        logits = out[0]                                # [tok_len, V]
+        self._set_caches(out[1], out[2])
+        if self._ks is not None:
+            self._ks, self._vs = out[3], out[4]
+
+        # sample EVERY fed position under its own schedule-independent
+        # salt: position j of row r is generated-index g0+j, so the
+        # draw equals what the plain path would make there
+        P = len(packed)
+        Pb = min(_next_pow2(max(P, 1)), tok_len)
+        temps = np.zeros(Pb, np.float32)
+        topks = np.zeros(Pb, np.int32)
+        topps = np.ones(Pb, np.float32)
+        salts = np.zeros(Pb, np.int32)
+        for i, (r, _drafts) in enumerate(plans):
+            p0, n_feed = spans[i]
+            sp = r.sampling
+            g0 = len(r.generated)
+            for j in range(n_feed):
+                temps[p0 + j] = sp.temperature
+                topks[p0 + j] = sp.top_k
+                topps[p0 + j] = sp.top_p
+                salts[p0 + j] = self._salt(r, g0 + j)
+        lg = logits[:Pb]
+        if not np.any(temps > 0):
+            sampled = np.asarray(_greedy_tokens_dev(lg))
+        elif _topk_fast_ok(temps, topks):
+            sampled = np.asarray(_sample_topk_dev(
+                lg, temps, topks, topps, salts))
+        else:
+            sampled = np.asarray(_sample_tokens_dev(
+                lg, temps, topks, topps, salts))
+
+        produced = []
+        now = time.perf_counter()
+        for i, (r, drafts) in enumerate(plans):
+            p0, n_feed = spans[i]
+            # accept the longest run of drafts matching the target's
+            # own sampled choices; the first mismatch position still
+            # yields its (correct) target-sampled token
+            emitted = [int(sampled[p0])]
+            for j in range(1, n_feed):
+                if drafts[j - 1] != emitted[-1]:
+                    break
+                emitted.append(int(sampled[p0 + j]))
+            self._spec_drafted_total += len(drafts)
+            self._spec_accepted_total += len(emitted) - 1
+            self._m.spec_drafted.inc(len(drafts))
+            self._m.spec_accepted.inc(len(emitted) - 1)
+            for t in emitted:
+                r.generated.append(t)
+                produced.append((r.rid, t))
+                self._note_first_token(r, now)
+                if len(r.generated) >= r.max_new \
+                        or (r.eos_token_id is not None
+                            and t == r.eos_token_id):
+                    r.done = True
+                    break
+            # back to the decode tip: KV for the accepted run is valid;
+            # pages holding only rejected-tail slots return to the pool
+            r.cached = r.length - 1
+            self._maybe_register_prefix(r)
+            if r.done:
+                self._release(r)
+                self._trace_done(r, now)
+            else:
+                keep = math.ceil(r.cached / cfg.block_size)
+                if len(r.pages) > keep:
+                    self._free_pages.extend(r.pages[keep:])
+                    del r.pages[keep:]
+        self._m.spec_steps.inc()
+        self._m.tokens.inc(len(produced))
+        if self._spec_drafted_total:
+            self._m.spec_accept_rate.set(
+                self._spec_accepted_total / self._spec_drafted_total)
+        if plans:
+            self._m.spec_tokens_per_step.set(len(produced) / len(plans))
+        return produced
+
     # -- multi-step decode (one device program per window) ---------------
     def _decode_window_fn(self, n_rows, n_steps, sample_mode):
         """Jitted whole-window decoder: `n_steps` model steps + sampling
@@ -1143,6 +1412,15 @@ class ServingEngine:
         fn = self._window_fns.get(key)
         if fn is not None:
             return fn
+        if self._window_fns:
+            # a SECOND distinct window shape on this engine is a
+            # retrace of the fused decode region — the row-count
+            # bucketing in _decode_run exists to keep these rare (the
+            # regression test counts this cause)
+            from ..jit.api import note_retrace
+
+            note_retrace("decode_window")
+        self._m.fused_regions.inc()
         B1 = self.cfg.max_batch + 1
         cache_dt = self._cache_dt
         compiled = self._compiled
@@ -1179,6 +1457,28 @@ class ServingEngine:
 
         fn = self._window_fns[key] = jax.jit(window)
         return fn
+
+    def lower_fused_decode(self, n_rows=None):
+        """StableHLO text of this engine's decode iteration lowered as a
+        single auto-fused region via ``jit.lower_stablehlo(fn, spec,
+        auto_fuse=True)`` — the inspectable compiler artifact of the
+        whole-step decode executable ``_decode_window_fn`` dispatches.
+        ``n_rows`` defaults to the full batch and is bucketed to the
+        same pow2 grid ``_decode_run`` uses, so the dumped region
+        matches the shape the engine actually traces."""
+        from ..analysis.program.capture import decode_step_spec
+        from ..jit.api import lower_stablehlo
+
+        cfg = self.cfg
+        rows = min(_next_pow2(n_rows or cfg.max_batch), cfg.max_batch)
+        fn, spec = decode_step_spec(
+            rows=rows, heads=cfg.num_heads, head_dim=cfg.head_dim,
+            block_size=cfg.block_size,
+            max_blocks=cfg.max_blocks_per_seq, n_pages=cfg.num_blocks,
+            ffn=cfg.ffn_size, vocab=cfg.vocab_size)
+        self._m.fused_regions.inc()
+        return lower_stablehlo(fn, spec, name_prefix="decode",
+                               auto_fuse=True)
 
     def decode_run(self, n_steps):
         """Run up to `n_steps` decode iterations over the current decode
@@ -1228,13 +1528,19 @@ class ServingEngine:
         self._update_pool_gauges(B)
         self._m.steps.inc(n)
 
+        # bucket the row count to a power of two so batch-size drift
+        # between sweeps (requests finishing, new ones joining) reuses
+        # the compiled window instead of retracing per distinct B; the
+        # padded slots' tokens route to the trash row/page like any
+        # other padding
+        Bb = min(_next_pow2(B), cfg.max_batch)
         enc = np.zeros(B1, np.int32)
         this = np.zeros(B1, np.int32)
         this[:B] = 1
-        # jit engines feed exactly the B live tokens (decode matmuls run
-        # at T=B, not the full prefill budget); artifact engines must pad
+        # jit engines feed Bb live-bucket tokens (decode matmuls run at
+        # T=Bb, not the full prefill budget); artifact engines must pad
         # to the module's fixed token length
-        tok_len = self._fixed_token_len or B
+        tok_len = self._fixed_token_len or Bb
         n_pad = tok_len - B
         this[B1 - 1] = n_pad
         enc[B1 - 1] = n_pad
@@ -1269,7 +1575,7 @@ class ServingEngine:
         dec = np.zeros(B1, np.int32)
         dec[:B] = dec0
 
-        window = self._decode_window_fn(B, n, sample_mode)
+        window = self._decode_window_fn(Bb, n, sample_mode)
         scales = (self._ks, self._vs) if self._ks is not None else ()
         samples, kc, vc, scales = window(
             self._params, self._buffers, tokens, enc, dec, this, cu, bt,

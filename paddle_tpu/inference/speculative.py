@@ -1,0 +1,192 @@
+"""Speculative decoding drafters for the paged serving engine.
+
+The decode loop emits ONE token per target-model dispatch; every tier
+above it (router, disagg, gateway, supervisor) multiplies that cost.
+Speculative decoding breaks the one-token wall: a cheap DRAFTER
+proposes k continuation tokens and the target model verifies the whole
+proposal in a single paged-attention step (the verify chunk is shaped
+exactly like a chunked-prefill continuation, so the serving executable
+needs no new kernels — only an all-positions logits head,
+``_step_mode == "spec_verify"`` in serving.py).
+
+Exactness, not approximation: the engine samples every verify position
+with the SAME schedule-independent salt (``sampling_salt(seed, rid,
+n_generated)``) the non-speculative path would use, and accepts a draft
+token only when it EQUALS the token the target would have sampled
+there.  The emitted stream is therefore token-bitwise-identical to the
+non-speculative engine under any sampling params — greedy or
+temperature — and speculative requests stay at their decode tip between
+steps, so disagg migration, drain requeue and gateway dispatch carry
+them unchanged.  A drafter is pure opportunism: a bad proposal costs
+one wasted verify position, never a wrong token.
+
+Two in-tree drafters:
+
+- ``NGramDrafter`` — model-free. Learns next-token statistics from the
+  streams the engine has already served (most-recent-wins n-gram
+  backoff), plus a BLOCK table keyed by the prefix-cache trie's chained
+  block digests (prefix_cache.PrefixCache._chain): when a sequence sits
+  on a block boundary whose digest chain was seen before, the whole
+  next block is proposed at once.  Shared-prompt fleets (the prefix-
+  cache workload) draft entire continuations for free.
+- ``DraftModelDrafter`` — a small PagedCausalLM (or anything with
+  ``forward_dense``) rolled out greedily for k tokens.  The classic
+  two-model scheme; O(k * S^2) per proposal via the dense reference
+  path, intended for small drafts.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Drafter", "NGramDrafter", "DraftModelDrafter", "from_env"]
+
+
+class Drafter:
+    """Pluggable proposal source for speculative decoding.
+
+    ``propose(tokens, k)`` returns up to ``k`` draft continuation
+    tokens for the sequence (prompt + generated so far); returning
+    ``[]`` degrades the verify step to a plain decode step (the
+    drafter-off fallback).  ``observe(tokens, start=)`` feeds served
+    streams back so learning drafters improve online; ``start`` is the
+    first index not yet observed for this sequence."""
+
+    def propose(self, tokens: List[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+    def observe(self, tokens: List[int], start: int = 0) -> None:
+        return None
+
+
+class NGramDrafter(Drafter):
+    """Model-free drafter over the engine's own served streams.
+
+    Token level: a most-recent-wins table mapping each length-1..n
+    context tuple to the token that followed it last; proposals roll
+    the table forward greedily with longest-context backoff.
+
+    Block level: when ``block_size`` is set, observed sequences also
+    populate a table keyed by the prefix-cache trie's CHAINED block
+    digests — digest of blocks 0..i (which commits to every token of
+    those blocks) maps to the full token run of block i+1.  A proposal
+    starting exactly on a block boundary whose chain is known emits the
+    whole remembered next block, so repeated shared-prefix traffic
+    drafts at near-perfect accept rates without any model."""
+
+    def __init__(self, n: int = 3, block_size: Optional[int] = None):
+        if n < 1:
+            raise ValueError("n-gram order must be >= 1")
+        self.n = int(n)
+        self._gram: Dict[Tuple[int, ...], int] = {}
+        self.block_size = block_size
+        if block_size:
+            from .prefix_cache import PrefixCache
+
+            # reuse the trie's digest chaining verbatim so block keys
+            # here agree with what the prefix cache would compute
+            self._chainer = PrefixCache(block_size)
+        else:
+            self._chainer = None
+        self._blocks: Dict[bytes, List[int]] = {}
+
+    # -- learning --------------------------------------------------------
+    def observe(self, tokens, start: int = 0) -> None:
+        toks = [int(t) for t in tokens]
+        lo = max(1, int(start))
+        for j in range(lo, len(toks)):
+            for l in range(1, self.n + 1):
+                if l > j:
+                    break
+                self._gram[tuple(toks[j - l:j])] = toks[j]
+        if self._chainer is not None:
+            bs = self.block_size
+            n_full = len(toks) // bs
+            if n_full >= 2:
+                keys = self._chainer._chain(toks, n_full - 1)
+                for i, key in enumerate(keys):
+                    self._blocks[key] = toks[(i + 1) * bs:(i + 2) * bs]
+
+    # -- proposing -------------------------------------------------------
+    def _next(self, cur: List[int]) -> Optional[int]:
+        for l in range(min(self.n, len(cur)), 0, -1):
+            t = self._gram.get(tuple(cur[-l:]))
+            if t is not None:
+                return t
+        return None
+
+    def propose(self, tokens, k: int) -> List[int]:
+        cur = [int(t) for t in tokens]
+        out: List[int] = []
+        while len(out) < k:
+            blk = None
+            if self._chainer is not None:
+                bs = self.block_size
+                if cur and len(cur) % bs == 0:
+                    keys = self._chainer._chain(cur, len(cur) // bs)
+                    blk = self._blocks.get(keys[-1])
+            if blk is not None:
+                take = blk[:k - len(out)]
+                out.extend(take)
+                cur.extend(take)
+                continue
+            t = self._next(cur)
+            if t is None:
+                break
+            out.append(t)
+            cur.append(t)
+        return out
+
+
+class DraftModelDrafter(Drafter):
+    """Greedy rollout of a small draft model's dense reference path.
+
+    ``model`` needs ``forward_dense(input_ids [1, S]) -> [1, S, V]``
+    (PagedCausalLM provides it).  Each proposal re-runs the dense path
+    per drafted token — O(k * S^2), the honest cost of the no-KV-cache
+    draft loop — so this is for SMALL draft models where the target
+    model's verify step still dominates."""
+
+    def __init__(self, model, max_context: int = 256):
+        self.model = model
+        self.max_context = int(max_context)
+        self._vocab = int(model.cfg.vocab_size) \
+            if hasattr(model, "cfg") else None
+
+    def propose(self, tokens, k: int) -> List[int]:
+        import jax.numpy as jnp
+
+        cur = [int(t) for t in tokens][-self.max_context:]
+        if self._vocab is not None and any(
+                t >= self._vocab for t in cur):
+            return []          # sequence outside the draft vocab
+        out: List[int] = []
+        for _ in range(k):
+            ids = jnp.asarray([cur], jnp.int32)
+            logits = self.model.forward_dense(ids)
+            nxt = int(np.asarray(logits)[0, -1].argmax())
+            out.append(nxt)
+            cur.append(nxt)
+        return out
+
+
+def from_env(engine, default_k: int = 4):
+    """Attach a drafter to ``engine`` per environment knobs:
+    ``PT_SPEC_DRAFTER`` selects ``off`` (default) or ``ngram``;
+    ``PT_SPEC_K`` sets the draft length (default ``default_k``).
+    Returns the drafter, or None when speculation stays off."""
+    kind = os.environ.get("PT_SPEC_DRAFTER", "off").strip().lower()
+    if kind in ("", "off", "0", "none"):
+        return None
+    if kind == "ngram":
+        drafter = NGramDrafter(block_size=engine.cfg.block_size)
+    else:
+        raise ValueError(
+            f"PT_SPEC_DRAFTER={kind!r}: expected 'off' or 'ngram' "
+            f"(draft-model speculation is attached in code via "
+            f"DraftModelDrafter)")
+    k = int(os.environ.get("PT_SPEC_K", str(default_k)))
+    engine.set_drafter(drafter, k=k)
+    return drafter

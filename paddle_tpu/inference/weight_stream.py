@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from ..profiler import metrics as _metrics
 
 __all__ = ["STREAM_KINDS", "quantize_per_channel", "dequantize",
+           "INT4_GROUP", "quantize_int4_grouped", "dequantize_int4",
            "WeightStreamer", "measure_stream_win"]
 
 # the decoder Linear stacks streamed per layer (PagedCausalLM attribute
@@ -64,6 +65,51 @@ def dequantize(q, scale, dtype):
             * jnp.asarray(scale)).astype(dtype)
 
 
+# int4 streaming: per-channel symmetric quant at 4 bits loses too much
+# on the input dim, so scales are PER (input-group, output-channel) —
+# each `INT4_GROUP`-row slab of a weight gets its own scale, bounding
+# the quant error to the slab's dynamic range while still quartering
+# (vs bf16) the bytes the decode step streams.
+INT4_GROUP = 32
+
+
+def quantize_int4_grouped(w, group: int = INT4_GROUP
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric int4 with per-(input-group, out-channel) scales:
+    ``w`` [in, out] float -> (packed uint8 [in_pad//2, out],
+    f32 scales [n_groups, out]) with w ~= q * scale, q in [-7, 7].
+    Input rows pad to a multiple of ``group`` (zeros quantize to 0);
+    two 4-bit codes (stored biased, q+8) pack per byte along the input
+    axis — even row in the high nibble, odd row in the low."""
+    a = np.asarray(jax.device_get(w), np.float32)
+    d_in, d_out = a.shape
+    n_g = -(-d_in // group)
+    pad = n_g * group - d_in
+    if pad:
+        a = np.concatenate([a, np.zeros((pad, d_out), np.float32)])
+    g = a.reshape(n_g, group, d_out)
+    amax = np.max(np.abs(g), axis=1)                     # [n_g, out]
+    scale = np.where(amax > 0, amax / 7.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(g / scale[:, None, :]), -7, 7)
+    nib = (q.reshape(n_g * group, d_out) + 8).astype(np.uint8)
+    packed = (nib[0::2] << 4) | nib[1::2]
+    return packed, scale
+
+
+def dequantize_int4(packed, scale, dtype, in_dim: int,
+                    group: int = INT4_GROUP):
+    """The exact in-trace int4 dequant: unpack nibbles, unbias, apply
+    the per-group scale, drop the padding rows, cast.  Exposed so
+    parity tests can reproduce the streamed weights bitwise."""
+    p = jnp.asarray(packed)
+    hi = (p >> 4) & 0xF
+    lo = p & 0xF
+    nib = jnp.stack([hi, lo], axis=1).reshape(-1, p.shape[1])
+    q = nib.astype(jnp.float32) - 8.0
+    s = jnp.repeat(jnp.asarray(scale), group, axis=0)
+    return (q * s)[:in_dim].astype(dtype)
+
+
 class WeightStreamer:
     """Per-layer int8 weight groups + the trace-time dequant schedule.
 
@@ -75,20 +121,29 @@ class WeightStreamer:
     traced arrays and ``PagedCausalLM.forward`` pulls per-layer groups
     through ``dequant_layer`` with the double-buffer loop."""
 
-    def __init__(self, num_layers: int, dtype, prefetch: bool = True):
+    def __init__(self, num_layers: int, dtype, prefetch: bool = True,
+                 mode: str = "int8"):
+        if mode not in ("int8", "int4"):
+            raise ValueError("weight stream mode must be 'int8' or "
+                             "'int4'")
         self.num_layers = int(num_layers)
         self.dtype = dtype
         self.prefetch = bool(prefetch)
+        self.mode = mode
         self._q: Dict[Tuple[str, int], jnp.ndarray] = {}
         self._s: Dict[Tuple[str, int], jnp.ndarray] = {}
+        # int4: original input dims (the packed array loses them to the
+        # row padding) — host metadata, never traced
+        self._in_dim: Dict[Tuple[str, int], int] = {}
 
     @classmethod
     def build(cls, model, params: Dict[str, object], dtype,
-              prefetch: bool = True) -> "WeightStreamer":
+              prefetch: bool = True, mode: str = "int8"
+              ) -> "WeightStreamer":
         """Quantize the decoder Linear stacks out of ``params`` (the
         name->array cast tree from ``current_params``), replacing each
         streamed leaf with a scalar placeholder."""
-        ws = cls(model.cfg.num_layers, dtype, prefetch)
+        ws = cls(model.cfg.num_layers, dtype, prefetch, mode)
         for kind in STREAM_KINDS:
             for li in range(ws.num_layers):
                 name = f"{kind}.{li}.weight"
@@ -97,7 +152,12 @@ class WeightStreamer:
                         f"weight streaming expects '{name}' in the param "
                         f"tree (PagedCausalLM layout); have e.g. "
                         f"{sorted(params)[:4]}")
-                q, s = quantize_per_channel(params[name])
+                if mode == "int4":
+                    w = np.asarray(jax.device_get(params[name]))
+                    ws._in_dim[(kind, li)] = int(w.shape[0])
+                    q, s = quantize_int4_grouped(w)
+                else:
+                    q, s = quantize_per_channel(params[name])
                 ws._q[(kind, li)] = jnp.asarray(q)
                 ws._s[(kind, li)] = jnp.asarray(s)
                 params[name] = jnp.zeros((), dtype)
@@ -118,7 +178,9 @@ class WeightStreamer:
 
     def bind(self, flat) -> "WeightStreamer":
         """Rebind to the jit-traced copies of ``flat`` (same order)."""
-        ws = WeightStreamer(self.num_layers, self.dtype, self.prefetch)
+        ws = WeightStreamer(self.num_layers, self.dtype, self.prefetch,
+                            self.mode)
+        ws._in_dim = dict(self._in_dim)
         it = iter(flat)
         for key in self._ordered_keys():
             ws._q[key] = next(it)
@@ -129,6 +191,12 @@ class WeightStreamer:
         """Dequantize layer ``li``'s whole Linear group.  Where this call
         sits in program order IS the prefetch: issued one layer early
         under ``prefetch=True``, at the use site otherwise."""
+        if self.mode == "int4":
+            return {kind: dequantize_int4(self._q[(kind, li)],
+                                          self._s[(kind, li)],
+                                          self.dtype,
+                                          self._in_dim[(kind, li)])
+                    for kind in STREAM_KINDS}
         return {kind: dequantize(self._q[(kind, li)],
                                  self._s[(kind, li)], self.dtype)
                 for kind in STREAM_KINDS}

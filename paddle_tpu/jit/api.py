@@ -40,6 +40,16 @@ def _record_retrace(exc):
     _metrics.inc("jit/retrace_cause/" + type(exc).__name__)
 
 
+def note_retrace(cause: str):
+    """Public retrace tally for engine-level executable caches that
+    re-specialize outside this module (e.g. the serving decode window
+    compiling a new shape): same counter, cause-tagged, so
+    ``jit/retrace_count`` stays the one number that answers "what keeps
+    recompiling"."""
+    _m_retrace.inc()
+    _metrics.inc("jit/retrace_cause/" + cause)
+
+
 def _timed_first_call(callable_, *a, **kw):
     """First call of a fresh jit entry = trace+lower+compile+run; count
     it and histogram the wall time under a RecordEvent span."""

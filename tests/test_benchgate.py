@@ -104,9 +104,10 @@ def test_bench_main_survives_workload_timeout(tmp_path, monkeypatch,
 
 def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
-    assert gate == ["llama_train", "eager_dispatch", "serving", "fleet",
-                    "fleet_recovery", "host_recovery", "gateway_storm"]
-    assert len(bench.WORKLOADS) == 12
+    assert gate == ["llama_train", "eager_dispatch", "serving",
+                    "spec_decode", "fleet", "fleet_recovery",
+                    "host_recovery", "gateway_storm"]
+    assert len(bench.WORKLOADS) == 13
 
 
 # ---------------------------------------------------------------------------
@@ -293,6 +294,31 @@ def test_benchgate_gateway_storm_row_gated(tmp_path):
                  _gateway_result()) == 1
     # a baseline predating the gateway row gates only the rest
     assert _gate(tmp_path, _gateway_result(), _result()) == 0
+
+
+def _spec_result(tps=11000.0, accept=0.63, speedup=4.3, match=1.0,
+                 step_ms=1.1):
+    r = _result()
+    r["extra"]["spec_decode"] = {"spec_decode": {
+        "tokens_per_sec": tps, "baseline_tokens_per_sec": tps / speedup,
+        "speedup": speedup, "accept_rate": accept,
+        "bitwise_match": match, "step_ms": step_ms, "k": 4}}
+    return r
+
+
+def test_benchgate_spec_decode_row_gated(tmp_path):
+    """spec_decode: zero slack on bitwise_match — a speculative stream
+    that drifts from the baseline is a correctness bug, not a perf
+    regression — threshold slack on throughput/accept/speedup/step."""
+    assert _gate(tmp_path, _spec_result(tps=10800.0, accept=0.62),
+                 _spec_result()) == 0
+    assert _gate(tmp_path, _spec_result(match=0.0), _spec_result()) == 1
+    assert _gate(tmp_path, _spec_result(tps=9000.0), _spec_result()) == 1
+    assert _gate(tmp_path, _spec_result(accept=0.5), _spec_result()) == 1
+    assert _gate(tmp_path, _spec_result(speedup=3.0), _spec_result()) == 1
+    assert _gate(tmp_path, _spec_result(step_ms=1.3), _spec_result()) == 1
+    # a baseline predating the spec row gates only the rest
+    assert _gate(tmp_path, _spec_result(), _result()) == 0
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

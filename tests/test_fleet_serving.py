@@ -425,6 +425,73 @@ def test_weight_stream_decode_window_and_win_metric(model):
     assert t_s >= 0 and t_b >= 0
 
 
+def test_int4_quantize_roundtrip():
+    """Grouped int4: q in [-7, 7] two-per-byte, one scale per
+    (32-row group, out-channel); error bounded by half a scale step."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.inference.weight_stream import (INT4_GROUP,
+                                                    dequantize_int4,
+                                                    quantize_int4_grouped)
+
+    rng = np.random.RandomState(6)
+    w = rng.randn(70, 33).astype(np.float32)          # ragged both axes
+    q, s = quantize_int4_grouped(w)
+    n_groups = -(-70 // INT4_GROUP)
+    assert q.dtype == np.uint8
+    assert q.shape == (n_groups * INT4_GROUP // 2, 33)   # 2 nibbles/byte
+    assert s.shape == (n_groups, 33)
+    deq = np.asarray(dequantize_int4(q, s, jnp.float32, 70))
+    assert deq.shape == w.shape
+    # per-group half-ULP bound: |err| <= scale/2 everywhere
+    bound = np.repeat(s, INT4_GROUP, axis=0)[:70] * 0.5 + 1e-6
+    assert np.all(np.abs(deq - w) <= bound)
+    # an all-zero group keeps scale 1.0 and dequantizes to exact zero
+    w[:INT4_GROUP, 3] = 0
+    q, s = quantize_int4_grouped(w)
+    assert s[0, 3] == 1.0
+    deq = np.asarray(dequantize_int4(q, s, jnp.float32, 70))
+    assert np.all(deq[:INT4_GROUP, 3] == 0)
+
+
+def test_weight_stream_int4_matches_dequantized_reference(model):
+    """An int4 streaming engine reproduces a PLAIN engine whose weights
+    were replaced by the int4-dequantized values — packing/unpacking and
+    per-group scales cancel exactly in the matmuls."""
+    from paddle_tpu.inference.weight_stream import (STREAM_KINDS,
+                                                    dequantize_int4,
+                                                    quantize_int4_grouped)
+
+    rng = np.random.RandomState(24)
+    prompts = [list(rng.randint(1, 97, n)) for n in (10, 7)]
+    sp = SamplingParams(temperature=0.9, top_k=20, top_p=0.9)
+    eng = _fresh_engine(model, seed=0, _weight_stream="int4")
+    rids = [eng.add_request(p, max_new_tokens=6, sampling=sp)
+            for p in prompts]
+    res = eng.run_to_completion()
+    got = [res[r] for r in rids]
+
+    paddle.seed(3)
+    ref_model = PagedCausalLM(PagedServingConfig(**BASE))
+    ref_model.eval()
+    ref_model.set_state_dict(model.state_dict())
+    import jax.numpy as jnp
+
+    for kind in STREAM_KINDS:
+        stack = getattr(ref_model, kind)
+        for li in range(ref_model.cfg.num_layers):
+            w = stack[li].weight
+            wv = np.asarray(w.numpy(), np.float32)
+            q, s = quantize_int4_grouped(wv)
+            w.set_value(np.asarray(
+                dequantize_int4(q, s, jnp.float32, wv.shape[0])))
+    ref_eng = _fresh_engine(ref_model, seed=0)
+    rr = [ref_eng.add_request(p, max_new_tokens=6, sampling=sp)
+          for p in prompts]
+    ref_res = ref_eng.run_to_completion()
+    assert got == [ref_res[r] for r in rr]
+
+
 def test_weight_stream_quantize_roundtrip():
     from paddle_tpu.inference.weight_stream import quantize_per_channel
 

@@ -23,6 +23,11 @@ signal regressed:
   dropping AT ALL (the brownout ladder must protect interactive
   traffic; no slack), ``goodput_rps`` dropping or
   ``interactive_ttft_p95_s`` rising more than the threshold,
+- speculative decoding (bench.py's ``spec_decode`` row — the draftable
+  shared-prompt workload): ``bitwise_match`` dropping AT ALL (spec
+  streams must stay token-identical to the baseline; no slack),
+  ``tokens_per_sec`` / ``accept_rate`` / ``speedup`` dropping or
+  ``step_ms`` rising more than the threshold,
 - the candidate missing the flagship metric entirely (a timed-out
   flagship row must fail the gate, not silently pass it — the r05
   failure mode).
@@ -157,13 +162,23 @@ def _fleet_metrics(result):
 _RECOVERY_GATES = {"requests_completed": True, "recovery_s": False}
 _GATEWAY_GATES = {"interactive_completed": True, "goodput_rps": True,
                   "interactive_ttft_p95_s": False}
+# spec_decode: speculative decoding on the draftable shared-prompt
+# workload. bitwise_match is the exactness contract — speculative
+# streams must equal the non-speculative baseline's, so ANY drop from
+# a passing baseline (1.0) fails with zero slack; throughput, accept
+# rate and speedup-over-baseline gate with the normal threshold and
+# step latency must not rise.
+_SPEC_GATES = {"tokens_per_sec": True, "accept_rate": True,
+               "speedup": True, "bitwise_match": True, "step_ms": False}
 _CHAOS_ROWS = (
     # fleet_recovery: one replica killed mid-decode; host_recovery: a
     # whole host's replicas felled at once; gateway_storm: every
-    # arrival multiplied 4x at the admit site
+    # arrival multiplied 4x at the admit site; spec_decode: draft k /
+    # verify-in-one-step decoding vs the plain step loop
     ("fleet_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("host_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("gateway_storm", _GATEWAY_GATES, ("interactive_completed",)),
+    ("spec_decode", _SPEC_GATES, ("bitwise_match",)),
 )
 _RECOVERY_ROWS = tuple(r for r, _, _ in _CHAOS_ROWS)
 

@@ -12,10 +12,18 @@ peak-memory deltas.  ``--stablehlo DIR`` additionally dumps each fused
 region (and the whole post-fusion module) as .mlir artifacts — the
 inspectable-compiler-output contract of the fusion tier.
 
+``--preset NAME`` is target selection plus the artifact dump in one
+flag: ``--preset decode`` runs the serving decode-iteration capture
+(paged KV gather -> attention -> swiglu -> LM head -> argmax, the
+region serving.py executes as one fused program) and writes its
+roofline diff next to the .mlir dumps (default directory
+``fusereport_<preset>/`` unless ``--stablehlo`` names one).
+
 Usage:
   python tools/fusereport.py llama-block
   python tools/fusereport.py mlp --json
   python tools/fusereport.py llama-block --stablehlo /tmp/fused
+  python tools/fusereport.py --preset decode
   python tools/fusereport.py my_pkg.my_mod:make_capture --max-intensity 4
 """
 import argparse
@@ -132,8 +140,14 @@ def render(report: dict) -> str:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("target", nargs="?", default="llama-block",
-                    help="preset (mlp / llama-block) or module:callable")
+    ap.add_argument("target", nargs="?", default=None,
+                    help="preset (mlp / llama-block / decode) or "
+                         "module:callable (default: llama-block)")
+    ap.add_argument("--preset", metavar="NAME",
+                    help="preset target + artifact dump: run NAME and "
+                         "write the roofline report and region .mlir "
+                         "dumps (to --stablehlo, default "
+                         "fusereport_<NAME>/)")
     ap.add_argument("--max-intensity", type=float, default=8.0,
                     help="roofline intensity ceiling for chain members")
     ap.add_argument("--min-chain", type=int, default=2)
@@ -143,10 +157,23 @@ def main(argv=None):
     ap.add_argument("--stablehlo", metavar="DIR",
                     help="dump fused regions + module as .mlir here")
     args = ap.parse_args(argv)
-    report = build_report(args.target, max_intensity=args.max_intensity,
+    if args.preset and args.target and args.target != args.preset:
+        ap.error(f"both a positional target ({args.target!r}) and "
+                 f"--preset ({args.preset!r}) given — pick one")
+    target = args.preset or args.target or "llama-block"
+    stablehlo_dir = args.stablehlo
+    if args.preset and not stablehlo_dir:
+        stablehlo_dir = f"fusereport_{args.preset}"
+    report = build_report(target, max_intensity=args.max_intensity,
                           min_chain=args.min_chain,
                           verify=not args.no_verify,
-                          stablehlo_dir=args.stablehlo)
+                          stablehlo_dir=stablehlo_dir)
+    if args.preset:
+        path = os.path.join(stablehlo_dir, f"{report['target']}.roofline"
+                                           f".json")
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+        report["roofline_artifact"] = path
     print(json.dumps(report) if args.json else render(report))
     return 0
 

@@ -106,7 +106,16 @@ KNOWN_METRICS = (
     "serving/prefix_hits_restored", "serving/cache_restore_ms",
     "serving/cache_snapshots", "serving/cache_snapshots_swept",
     "serving/cache_snapshots_pruned",
-    # int8 double-buffered weight streaming (inference/weight_stream.py)
+    # speculative decoding (inference/speculative.py + serving.py
+    # _spec_step): drafted/accepted token funnel + per-step yield
+    "serving/spec_steps", "serving/spec_drafted_tokens",
+    "serving/spec_accepted_tokens", "serving/spec_accept_rate",
+    "serving/spec_tokens_per_step",
+    # whole-iteration decode executables (decode windows + speculative
+    # verify shapes) the engine compiled — the fused-decode region count
+    "compiler/fused_decode_regions",
+    # int8/int4 double-buffered weight streaming
+    # (inference/weight_stream.py)
     "weights/stream_prefetch_ms",
     # Executor-tier auto_fuse fallback (static/__init__.py)
     "compiler/executor_fuse_reverts",
