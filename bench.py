@@ -1266,6 +1266,127 @@ def bench_spec_decode(on_tpu):
     }}
 
 
+def bench_weight_publish(on_tpu):
+    """Live weight publishing gate row (ISSUE 15): a 3-replica fleet
+    serves a continuous wave while a canary-gated int8-free publish
+    lands mid-traffic (build -> ship over the CRC'd transport -> canary
+    probe of the STAGED version -> fleet promote).  Gate signals, zero
+    slack on the first two: every admitted request completes (a rollout
+    may never drop traffic), and every stream is token-bitwise-identical
+    to a fresh single-engine regeneration under the version it was
+    PINNED to — pre-publish streams finish under N, post-publish
+    streams run under N+1.  publish_s (build+canary+promote wall time)
+    and goodput under the rollout gate with the normal threshold."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.router import Replica, ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+    from paddle_tpu.inference.weight_publish import (WeightPublisher,
+                                                     build_weight_set)
+    from paddle_tpu.jit import functional as FB
+
+    n_wave, prompt_len, max_new = 5, 12, 6
+    cfg = PagedServingConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=4, max_blocks_per_seq=6, token_budget=32)
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(11)
+    prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+               for _ in range(2 * n_wave)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    # the candidate version: the serving params plus real perturbation
+    # (noise at a few percent of each tensor's scale — enough to change
+    # streams, finite enough to pass the canary)
+    nrng = np.random.RandomState(5)
+    old_params = {k: np.asarray(jax.device_get(v))
+                  for k, v in FB.current_params(model).items()}
+    new_params = {}
+    for k, v in old_params.items():
+        if np.issubdtype(v.dtype, np.floating):
+            f = v.astype(np.float32)
+            new_params[k] = (f + nrng.normal(
+                0.0, 0.03 * (np.std(f) + 1e-6), f.shape)
+            ).astype(v.dtype)
+        else:
+            new_params[k] = v
+
+    engines = [ServingEngine.from_model(model, cfg, seed=10 + i)
+               for i in range(3)]
+    for i, e in enumerate(engines):
+        e.fault_rank = i
+    router = ReplicaRouter(
+        [Replica(e, name=f"r{i}") for i, e in enumerate(engines)])
+    pub = WeightPublisher(router, model)
+
+    t0 = time.perf_counter()
+    wave_a = [router.submit(list(p), max_new_tokens=max_new, sampling=sp)
+              for p in prompts[:n_wave]]
+    for _ in range(3):                      # wave A genuinely in flight
+        router.step_all()
+    report = pub.publish(params=new_params)
+    wave_b = [router.submit(list(p), max_new_tokens=max_new, sampling=sp)
+              for p in prompts[n_wave:]]
+    out = router.run_to_completion()
+    total_s = time.perf_counter() - t0
+
+    handles = wave_a + wave_b
+    completed = sum(1 for h in handles if len(out.get(h) or []) == max_new)
+
+    # bitwise referee: regenerate every stream on a FRESH single engine
+    # holding only its pinned version, under the stream's recorded salt
+    # identity — the pinned-version contract made testable
+    ref = {0: ServingEngine.from_model(model, cfg, seed=0)}
+    arrays, crcs = build_weight_set(model, new_params, cfg)
+    ref1 = ServingEngine.from_model(model, cfg, seed=0)
+    ref1.stage_weight_set(report.version, arrays, crcs=crcs)
+    ref1.commit_weight_set(report.version)
+    ref[report.version] = ref1
+
+    def regenerate(prompt, salt_rid, salt_seed, version):
+        eng = ref[version]
+        rid = eng.add_request(list(prompt), max_new_tokens=max_new,
+                              sampling=sp)
+        r = eng._requests[rid]
+        r.salt_rid, r.salt_seed = salt_rid, salt_seed
+        while not r.done:
+            eng.step()
+        return list(r.generated)
+
+    bitwise = True
+    versions_served = set()
+    for h, prompt in zip(handles, prompts):
+        idx, rid = router._handles[h]
+        eng = router.replicas[idx].engine
+        r = eng._requests[rid]
+        seed = eng.seed if r.salt_seed is None else r.salt_seed
+        versions_served.add(r.weight_version)
+        if regenerate(prompt, r.salt_rid, seed,
+                      r.weight_version) != (out.get(h) or []):
+            bitwise = False
+
+    return {"weight_publish": {
+        "n_requests": len(handles), "max_new": max_new,
+        "requests_completed": completed,
+        "bitwise_match": 1.0 if bitwise else 0.0,
+        "publish_s": round(report.publish_s, 4),
+        "total_s": round(total_s, 4),
+        "goodput_rps": round(completed / total_s, 2),
+        "version": report.version,
+        "versions_served": sorted(versions_served),
+        "canary": report.canary,
+        "replicas_committed": len(report.committed),
+        "replicas_missed": len(report.missed),
+        "bytes_shipped": report.bytes_shipped,
+    }}
+
+
 def bench_eager_dispatch(on_tpu):
     """Eager per-op dispatch cost through the per-signature jit cache
     (VERDICT r2 #1; reference analog: the all-C++ eager hot path,
@@ -1453,6 +1574,7 @@ WORKLOADS = (
     ("fleet", bench_fleet_serving, True),
     ("fleet_recovery", bench_fleet_recovery, True),
     ("host_recovery", bench_host_recovery, True),
+    ("weight_publish", bench_weight_publish, True),
     ("gateway_storm", bench_gateway_storm, True),
     ("second_order", bench_second_order, False),
 )

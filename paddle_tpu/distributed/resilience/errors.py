@@ -14,7 +14,8 @@ __all__ = [
     "TransportError", "TransportClosedError", "TransportTimeoutError",
     "FrameCorruptError", "PeerUnreachableError", "CommTimeoutError",
     "EngineDeadError", "StoreTimeoutError", "StaleGenerationError",
-    "GatewayRejectedError",
+    "GatewayRejectedError", "PublishRejectedError",
+    "WeightTransferError",
 ]
 
 
@@ -150,6 +151,53 @@ class StaleGenerationError(RuntimeError):
             f"is stale (fence for domain {domain!r} is at generation "
             f"{fence_gen}) — this rank was partitioned out of the "
             f"re-formed group and must rejoin through rendezvous")
+
+
+class PublishRejectedError(RuntimeError):
+    """A live weight publish was refused — by policy, not by accident.
+    Carries the machine-readable triage the rollout controller needs:
+    WHY (``reason`` — ``stale_version`` when the store fence already
+    holds a newer epoch, ``canary_nonfinite`` / ``canary_drift`` when
+    the golden-prompt probe rejected the candidate, ``no_replicas``
+    when there is nothing healthy to canary on), the refused
+    ``version``, and for fence rejections the epoch that outran it
+    (``fence_version``). A rejected publish leaves the fleet serving
+    exactly what it served before — rejection is not an error state to
+    recover from, it is the safety contract working."""
+
+    def __init__(self, reason: str, version: int,
+                 fence_version: Optional[int] = None,
+                 detail: Optional[str] = None):
+        self.reason = reason
+        self.version = version
+        self.fence_version = fence_version
+        self.detail = detail
+        extra = ""
+        if fence_version is not None:
+            extra = f"; fence already at version {fence_version}"
+        if detail:
+            extra += f"; {detail}"
+        super().__init__(
+            f"weight publish of version {version} rejected "
+            f"(reason={reason}){extra} — fleet keeps serving its "
+            f"current version")
+
+
+class WeightTransferError(RuntimeError):
+    """A shipped weight set failed integrity verification at the
+    receiving replica (per-tensor CRC or set digest mismatch, or a
+    tensor count/shape that disagrees with the manifest). The staged
+    buffer is discarded and the replica keeps serving its current
+    version — a torn or corrupted transfer can never be committed."""
+
+    def __init__(self, version: int, replica: str, detail: str):
+        self.version = version
+        self.replica = replica
+        self.detail = detail
+        super().__init__(
+            f"weight set version {version} failed verification on "
+            f"replica {replica}: {detail} — staged buffer discarded, "
+            f"replica keeps its current version")
 
 
 class CommTimeoutError(TransportError):

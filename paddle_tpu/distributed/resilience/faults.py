@@ -10,10 +10,16 @@ manifest — a ``kill@save`` leaves exactly the torn checkpoint a real
 mid-save death leaves), plus four SERVING sites the fleet tier consults
 (``inference/``): ``prefill`` and ``decode`` (the engine, once per step
 that schedules a prefill chunk / a decode row), ``migrate`` (per
-in-flight KV hand-off in ``disagg.migrate_request``) and ``cache_save``
+in-flight KV hand-off in ``disagg.migrate_request``), ``cache_save``
 (the prefix-cache snapshot, between writing the page data and
 publishing the manifest — a ``kill@cache_save`` leaves exactly the torn
-snapshot a real mid-save death leaves). A ``FaultPlan`` names which
+snapshot a real mid-save death leaves) and ``publish`` (the live
+weight-publish path in ``inference/weight_publish.py``, consulted once
+per replica transfer: ``kill`` fells the receiving engine mid-stage —
+the manifest-last commit means version N keeps serving — ``drop``
+makes the transfer vanish so the replica catches up later, ``corrupt``
+flips a staged byte the CRC check must catch, ``delay`` stalls the
+rollout). A ``FaultPlan`` names which
 fault fires where —
 armed from the ``PT_FAULT_PLAN`` environment variable or
 programmatically — so the failure modes a TPU pod actually exhibits
@@ -114,7 +120,7 @@ FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition",
                "overload")
 FAULT_SITES = ("send", "dial", "recv", "step", "save",
                "prefill", "decode", "migrate", "cache_save", "host",
-               "admit")
+               "admit", "publish")
 
 # frame-level kinds are meaningless away from the wire: the validator
 # REJECTS them at the process/host sites instead of silently no-oping
@@ -127,6 +133,13 @@ _PARTITION_SITES = ("dial",)
 # (drop) and stalls (delay) — anything else at admit is a typo'd plan
 _OVERLOAD_SITES = ("admit",)
 _ADMIT_KINDS = ("overload", "drop", "delay")
+# the publish site sits on a CRC/ACK weight transfer into a live
+# replica: kill (replica dies mid-stage — torn-update fencing), delay
+# (slow rollout), drop (the transfer never lands — replica catches up
+# later) and corrupt (a flipped byte the CRC check must catch) are the
+# failures a rollout exhibits; dup is meaningless (staging is
+# idempotent per version) and rejected so a no-op plan fails CI
+_PUBLISH_KINDS = ("kill", "delay", "drop", "corrupt")
 
 
 @dataclass(frozen=True)
@@ -237,6 +250,11 @@ def parse_plan(spec: str) -> FaultPlan:
             raise ValueError(
                 f"kind {kind!r} is meaningless at the 'admit' site in "
                 f"{clause!r} (only {'/'.join(_ADMIT_KINDS)} fire there)")
+        if site == "publish" and kind not in _PUBLISH_KINDS:
+            raise ValueError(
+                f"kind {kind!r} is meaningless at the 'publish' site "
+                f"in {clause!r} (only {'/'.join(_PUBLISH_KINDS)} fire "
+                f"there)")
         for opt in opts:
             k, _, v = opt.partition("=")
             if k == "rank":

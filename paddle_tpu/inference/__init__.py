@@ -366,6 +366,12 @@ _FLEET_EXPORTS = {
     "FleetSupervisor": "fleet_supervisor",
     "FleetSupervisorConfig": "fleet_supervisor",
     "LoopbackTransport": "fleet_supervisor",
+    "WeightPublisher": "weight_publish",
+    "PublishPolicy": "weight_publish",
+    "PublishReport": "weight_publish",
+    "build_weight_set": "weight_publish",
+    "send_weight_set": "weight_publish",
+    "receive_weight_set": "weight_publish",
     "FleetGateway": "gateway", "GatewayConfig": "gateway",
     "SLOClassConfig": "gateway", "TenantConfig": "gateway",
     "BrownoutConfig": "gateway", "BrownoutController": "gateway",

@@ -102,6 +102,10 @@ def migrate_request(engine: ServingEngine, rid: int, transport,
                          else r.salt_seed),
         "quant": engine._ks is not None,
         "n_pages": int(pages.size),
+        # the stream's pinned weight version travels with its KV: the
+        # receiver resumes under the SAME version (its pages were
+        # produced by those params) — version-bitwise hand-off identity
+        "weight_version": int(getattr(r, "weight_version", 0) or 0),
     }
     if mig_ctx is not None:
         _tracing.inject(meta, mig_ctx)
@@ -162,6 +166,20 @@ def receive_request(engine: ServingEngine, transport, src: int,
     req.pages = pages
     req.salt_rid = int(meta["salt_rid"])
     req.salt_seed = int(meta["salt_seed"])
+    # resume under the pinned origin version ("weight_version" absent
+    # in pre-publish senders: the build-time set). The decode engine
+    # must be able to serve it — a version it neither serves nor
+    # retains would silently decode the shipped KV under the WRONG
+    # params, so fail the hand-off loudly instead.
+    wv = int(meta.get("weight_version", 0) or 0)
+    if hasattr(engine, "has_weight_version") \
+            and not engine.has_weight_version(wv):
+        engine._release(req)
+        raise ValueError(
+            f"migrated request pinned to weight version {wv}, but "
+            f"decode engine {getattr(engine, 'name', '?')} serves "
+            f"{engine.active_weight_version} and does not retain it")
+    req.weight_version = wv
     # TTFT was observed on the prefill worker (the first token samples
     # there); suppress a second observation on this engine
     req.first_tok_t = req.submit_t

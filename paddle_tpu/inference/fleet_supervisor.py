@@ -58,7 +58,8 @@ from ..distributed.resilience import backoff as _backoff
 from ..distributed.resilience.errors import (EngineDeadError,
                                              PeerUnreachableError,
                                              TransportClosedError,
-                                             TransportError)
+                                             TransportError,
+                                             WeightTransferError)
 from ..profiler import metrics as _metrics
 from ..profiler import tracing as _tracing
 from .router import ReplicaRouter
@@ -138,6 +139,13 @@ class FleetSupervisor:
         # handles drained (migrated or requeued) across this
         # supervisor's lifetime — the observable idempotency record
         self.drained_handles: set = set()
+        # live weight publishing: a WeightPublisher installs its
+        # catch_up here so a replica rebuilt by restart() (which comes
+        # back at the factory's build-time version) is brought to the
+        # fleet's committed version epoch BEFORE it rejoins rotation —
+        # a replica offline during a rollout converges on restart
+        self.weight_catchup: Optional[Callable[[ServingEngine],
+                                               None]] = None
         router.failure_hook = self.on_failure
 
     # -- failure entry points --------------------------------------------
@@ -201,6 +209,14 @@ class FleetSupervisor:
             dst = self.router.replicas[dst_idx].engine
             if self._capacity(dst) < len(r.pages):
                 continue
+            # check the peer can serve this stream's pinned version
+            # BEFORE shipping: migrate_request finishes the source copy
+            # as its last act, so a version refusal at the receiver
+            # would orphan the request
+            if hasattr(dst, "has_weight_version") \
+                    and not dst.has_weight_version(
+                        int(getattr(r, "weight_version", 0) or 0)):
+                continue
             if self.handoff_factory is not None:
                 send_tp, recv_tp, dst_rank, src_rank = \
                     self.handoff_factory(src_idx, dst_idx)
@@ -239,8 +255,14 @@ class FleetSupervisor:
         if gate is not None and not gate("drain"):
             return False
         origin_seed = src.seed if r.salt_seed is None else r.salt_seed
+        wv = int(getattr(r, "weight_version", 0) or 0)
         for dst_idx in targets:
             dst = self.router.replicas[dst_idx].engine
+            # version-bitwise identity across the drain: the peer must
+            # serve (or retain) the version this stream started on
+            if hasattr(dst, "has_weight_version") \
+                    and not dst.has_weight_version(wv):
+                continue
             try:
                 new_rid = dst.add_request(
                     list(r.prompt), max_new_tokens=r.max_new,
@@ -248,6 +270,8 @@ class FleetSupervisor:
                     tenant=r.tenant)
             except (EngineOverloadedError, EngineDeadError):
                 continue
+            if hasattr(dst, "pin_weight_version"):
+                dst.pin_weight_version(new_rid, wv)
             req = dst._requests[new_rid]
             req.salt_rid = r.salt_rid
             req.salt_seed = int(origin_seed)
@@ -336,6 +360,17 @@ class FleetSupervisor:
         if hasattr(new, "set_metrics_namespace"):
             new.set_metrics_namespace(
                 getattr(old, "metrics_namespace", None) or rep.name)
+        # weight catch-up: the factory rebuilt the engine at its
+        # build-time weight version — replay the fleet's committed
+        # version onto it before it takes traffic, so a replica that
+        # missed a rollout (offline, drop@publish) converges here
+        if self.weight_catchup is not None:
+            try:
+                self.weight_catchup(new)
+            except (TransportError, EngineDeadError,
+                    WeightTransferError, ValueError, KeyError):
+                _tracing.flight_note("weight_catchup_failed",
+                                     replica=rep.name)
         rep.engine = new
         _m_restarts.inc()
         _tracing.flight_note("replica_restart", replica=rep.name,

@@ -67,10 +67,10 @@ _m_snapshots = _metrics.counter("serving/cache_snapshots")
 
 class _Node:
     __slots__ = ("page", "refs", "lru", "parent", "children", "restored",
-                 "ns")
+                 "ns", "wv")
 
     def __init__(self, page: int, parent: Optional[bytes], lru: int,
-                 ns: Optional[str] = None):
+                 ns: Optional[str] = None, wv: int = 0):
         self.page = page
         self.refs = 1          # created on behalf of the inserting request
         self.lru = lru
@@ -78,6 +78,11 @@ class _Node:
         self.children = 0
         self.restored = False  # re-materialized from a disk snapshot
         self.ns = ns           # tenant namespace (None = shared default)
+        # weight version whose params produced this block's KV: folded
+        # into the digest chain, so a request pinned to another version
+        # can never match this node — after a live weight publish, old-
+        # version nodes go cold and drain through normal LRU eviction
+        self.wv = wv
 
 
 class PrefixCache:
@@ -126,13 +131,18 @@ class PrefixCache:
 
     # -- keys --------------------------------------------------------------
     def _chain(self, tokens, n_blocks: int,
-               namespace: Optional[str] = None) -> List[bytes]:
+               namespace: Optional[str] = None,
+               version: int = 0) -> List[bytes]:
         """Chained digests for the first ``n_blocks`` full blocks: digest
         of block i commits to all tokens of blocks 0..i (and to the
-        namespace, via the seeded root)."""
+        namespace and weight version, via the seeded root).  Version 0
+        (the build-time weight set) keeps the historical root so pre-
+        publish snapshots stay restorable."""
         bs = self.block_size
         key = b"\x00prefix-root" if namespace is None \
             else b"\x00prefix-root:" + str(namespace).encode()
+        if version:
+            key += b"\x00wv:" + str(int(version)).encode()
         out = []
         for i in range(n_blocks):
             h = hashlib.blake2b(key, digest_size=16)
@@ -143,18 +153,21 @@ class PrefixCache:
         return out
 
     # -- read path ---------------------------------------------------------
-    def match(self, prompt, namespace: Optional[str] = None
+    def match(self, prompt, namespace: Optional[str] = None,
+              version: int = 0
               ) -> Tuple[List[int], List[bytes], int]:
         """Longest cached block chain covering a STRICT prefix of
         ``prompt`` (the tip token is always recomputed so its logits can
-        be sampled).  Acquires one ref on every matched node.  Returns
+        be sampled).  Acquires one ref on every matched node.  Only
+        nodes whose KV was produced under ``version``'s weights can
+        match (the version seeds the digest chain).  Returns
         ``(pages, node_keys, n_tokens)``; the caller must eventually
         ``release(node_keys)``."""
         self.lookups += 1
         n_max = max(len(prompt) - 1, 0) // self.block_size
         pages: List[int] = []
         held: List[bytes] = []
-        for k in self._chain(prompt, n_max, namespace):
+        for k in self._chain(prompt, n_max, namespace, version):
             node = self._nodes.get(k)
             if node is None:
                 break
@@ -171,7 +184,8 @@ class PrefixCache:
             self.hits += 1
         return pages, held, len(held) * self.block_size
 
-    def probe(self, prompt, namespace: Optional[str] = None) -> int:
+    def probe(self, prompt, namespace: Optional[str] = None,
+              version: int = 0) -> int:
         """How many leading tokens of ``prompt`` a ``match`` would serve
         from cache RIGHT NOW — without acquiring refs, touching LRU
         ticks, or counting a lookup.  The gateway's affinity signal:
@@ -179,7 +193,7 @@ class PrefixCache:
         turn, then ``match`` only on the replica actually chosen."""
         n_max = max(len(prompt) - 1, 0) // self.block_size
         n = 0
-        for k in self._chain(prompt, n_max, namespace):
+        for k in self._chain(prompt, n_max, namespace, version):
             if k not in self._nodes:
                 break
             n += 1
@@ -195,7 +209,8 @@ class PrefixCache:
 
     # -- write path --------------------------------------------------------
     def insert(self, prompt, pages,
-               namespace: Optional[str] = None) -> List[bytes]:
+               namespace: Optional[str] = None,
+               version: int = 0) -> List[bytes]:
         """Register the FULL prompt blocks backed by ``pages`` (the
         request's block list, block i at ``pages[i]``).  Pages of blocks
         not yet cached transfer ownership to the cache; the caller holds
@@ -206,7 +221,7 @@ class PrefixCache:
         past it stay the request's private pages (correctness is
         untouched; only reuse is bounded)."""
         n = min(len(prompt) // self.block_size, len(pages))
-        keys = self._chain(prompt, n, namespace)
+        keys = self._chain(prompt, n, namespace, version)
         quota = self._quota(namespace)
         new: List[bytes] = []
         parent: Optional[bytes] = None
@@ -225,7 +240,7 @@ class PrefixCache:
                 break                      # gap in the chain: unreachable
             self._tick += 1
             self._nodes[k] = _Node(page, parent, self._tick,
-                                   ns=namespace)
+                                   ns=namespace, wv=version)
             self._page_owner[page] = k
             self._ns_pages[namespace] = \
                 self._ns_pages.get(namespace, 0) + 1
@@ -394,7 +409,8 @@ def save_snapshot(engine, root: str,
                    "parent": (node.parent.hex()
                               if node.parent is not None else None),
                    "slab": key_index[k],
-                   "ns": node.ns}
+                   "ns": node.ns,
+                   "wv": node.wv}
                   for k, node in order],
     })
     _m_snapshots.inc()
@@ -473,8 +489,10 @@ def restore_snapshot(engine, root: str, sweep: bool = True) -> int:
         key = bytes.fromhex(rec["key"])
         parent = bytes.fromhex(rec["parent"]) if rec["parent"] else None
         cache._tick += 1
-        # "ns" absent in pre-namespace snapshots: default namespace
-        node = _Node(int(page), parent, cache._tick, ns=rec.get("ns"))
+        # "ns"/"wv" absent in older snapshots: default namespace and
+        # the build-time weight version
+        node = _Node(int(page), parent, cache._tick, ns=rec.get("ns"),
+                     wv=int(rec.get("wv", 0)))
         node.refs = 0          # no live request holds restored blocks
         node.restored = True
         cache._nodes[key] = node

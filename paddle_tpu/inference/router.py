@@ -257,9 +257,18 @@ class ReplicaRouter:
                 if handle is not None:
                     self._by_engine[(src_idx, info["rid"])] = handle
                 return
+            wv = int(info.get("weight_version", 0) or 0)
             for idx in self._ordered(exclude=src_idx):
+                eng = self.replicas[idx].engine
+                # version-bitwise identity across the requeue: the
+                # retry must resume under the version its stream
+                # STARTED on, so replicas not serving (or retaining)
+                # that version are skipped mid-rollout
+                if hasattr(eng, "has_weight_version") \
+                        and not eng.has_weight_version(wv):
+                    continue
                 try:
-                    rid = self.replicas[idx].engine.add_request(
+                    rid = eng.add_request(
                         info["prompt"],
                         max_new_tokens=info["max_new"],
                         sampling=info["sampling"],
@@ -269,6 +278,8 @@ class ReplicaRouter:
                 except EngineOverloadedError:
                     _m_reroutes.inc()
                     continue
+                if hasattr(eng, "pin_weight_version"):
+                    eng.pin_weight_version(rid, wv)
                 retry_req = self.replicas[idx].engine._requests[rid]
                 retry_req.requeues = n_prior + 1
                 # carry the sampling-salt identity: the retry

@@ -58,7 +58,8 @@ class _EngineMetrics:
                  "preempt", "occupancy", "kv_util", "deadline", "shed",
                  "prefix_rate", "prefix_pages", "spec_steps",
                  "spec_drafted", "spec_accepted", "spec_accept_rate",
-                 "spec_tokens_per_step", "fused_regions")
+                 "spec_tokens_per_step", "fused_regions",
+                 "weight_version", "weight_swaps", "weight_rollbacks")
 
     def __init__(self, reg):
         self.ttft = reg.histogram("serving/ttft_ms")
@@ -83,6 +84,12 @@ class _EngineMetrics:
         # distinct whole-iteration decode executables this engine built
         # (decode windows + speculative verify shapes)
         self.fused_regions = reg.counter("compiler/fused_decode_regions")
+        # live weight publishing (inference/weight_publish.py): the
+        # version this engine currently serves, atomic swaps taken, and
+        # rollbacks to the retained previous buffer
+        self.weight_version = reg.gauge("serving/weight_version")
+        self.weight_swaps = reg.counter("serving/weight_swaps")
+        self.weight_rollbacks = reg.counter("serving/weight_rollbacks")
 
 __all__ = ["PagedServingConfig", "PagedCausalLM", "ServingEngine",
            "SamplingParams", "save_paged_model", "sampling_salt",
@@ -515,7 +522,7 @@ class _Request:
                  "submit_t", "first_tok_t", "deadline_t", "timed_out",
                  "shared_keys", "prefix_registered", "salt_rid",
                  "salt_seed", "trace", "sched_t0", "requeues", "tenant",
-                 "spec_observed")
+                 "spec_observed", "weight_version")
 
     def __init__(self, rid, prompt, max_new, sampling, eos_token_id,
                  deadline_s=None):
@@ -560,6 +567,12 @@ class _Request:
         # engine's drafter has already observed (0 on any new engine —
         # a migrated/requeued request re-teaches the peer's drafter)
         self.spec_observed = 0
+        # live weight publishing: the version this stream is PINNED to.
+        # KV depends on params, so the whole stream runs under exactly
+        # one version — pinned at admission, carried across requeue /
+        # drain / migrate hand-offs, and a step only batches rows that
+        # share one version (see _schedule)
+        self.weight_version = 0
 
     @property
     def length(self):
@@ -600,6 +613,10 @@ class ServingEngine:
             self._fixed_token_len = None
         self._compiled_fresh = None   # set by from_model (jit engines)
         self._compiled_verify = None  # all-positions logits (from_model)
+        # the from_model weight_stream mode this engine's flat params
+        # were built under — a weight publisher must replicate the SAME
+        # cast/quantize/flatten pipeline for its arrays to slot in
+        self._weight_stream_mode = None
         # speculative decoding (inference/speculative.py): attached via
         # set_drafter; while set, _step diverts pure decode-tip batches
         # through _spec_step (draft k, verify in one paged step)
@@ -657,6 +674,17 @@ class ServingEngine:
         # rank the chaos injector sees for this engine's fault sites, so
         # PT_FAULT_PLAN ":rank=R" clauses target one replica of a fleet
         self.fault_rank = 0
+        # live weight publishing (inference/weight_publish.py):
+        # _active_wv is the version NEW requests pin to; _weight_sets
+        # retains the flat param list per still-referenced version (the
+        # active one, the previous one for bitwise rollback, and any
+        # older version an in-flight stream is still pinned to);
+        # _staged_weights holds fully-verified-but-uncommitted sets —
+        # the double buffer a commit swaps in at a step boundary
+        self._active_wv = 0
+        self._prev_wv = None
+        self._weight_sets = {}
+        self._staged_weights = {}
         from ..distributed.resilience import faults as _faults
 
         _faults.maybe_arm_from_env()
@@ -696,6 +724,7 @@ class ServingEngine:
                 f"weight_stream={weight_stream!r}: expected None, "
                 f"'int8', 'int8-noprefetch' or 'int4'")
         eng = cls(None, cfg, seed=seed)
+        eng._weight_stream_mode = weight_stream
         share_key = (cfg.dtype, cfg.cache_quant, weight_stream)
         cached = getattr(model, "_serving_shared", None)
         if cached is not None and cached[0] == share_key:
@@ -800,6 +829,10 @@ class ServingEngine:
         req = _Request(rid, prompt_tokens, max_new_tokens,
                        sampling, eos_token_id, deadline_s=deadline_s)
         req.tenant = tenant
+        # pin the whole stream to the version serving at admission: KV
+        # depends on params, so a mid-stream swap would mix versions —
+        # pinned streams drain under their version instead
+        req.weight_version = self._active_wv
         self._requests[rid] = req
         self._try_prefix_match(req)
         # root (or ambient-parented) span of this request's trace; the
@@ -864,7 +897,8 @@ class ServingEngine:
         if cache is None or req.pages:
             return
         pages, keys, n_tok = cache.match(req.prompt,
-                                         namespace=req.tenant)
+                                         namespace=req.tenant,
+                                         version=req.weight_version)
         if n_tok:
             req.pages = list(pages)
             req.shared_keys = keys
@@ -882,7 +916,8 @@ class ServingEngine:
             return
         req.prefix_registered = True
         req.shared_keys.extend(cache.insert(req.prompt, req.pages,
-                                            namespace=req.tenant))
+                                            namespace=req.tenant,
+                                            version=req.weight_version))
 
     def _evict_expired(self):
         """Deadline sweep, run before scheduling: requests past their
@@ -913,6 +948,7 @@ class ServingEngine:
                 "timed_out": True, "requeues": r.requeues,
                 "tenant": r.tenant, "salt_rid": r.salt_rid,
                 "salt_seed": r.salt_seed,
+                "weight_version": r.weight_version,
                 "trace": r.trace.to_dict() if r.trace is not None
                 else None}
 
@@ -971,6 +1007,254 @@ class ServingEngine:
             raise ValueError("no snapshot root: pass root= or set "
                              "cfg.prefix_snapshot_root")
         return restore_snapshot(self, root)
+
+    # -- live weight publishing (double-buffered versioned hot swap) -----
+    @property
+    def active_weight_version(self):
+        """The version NEW admissions pin to (0 = the build-time set)."""
+        return self._active_wv
+
+    def has_weight_version(self, version):
+        """True when `version` is SERVABLE here: active, or retained in
+        the double buffer (an in-flight pinned stream can run under it).
+        Staged-but-uncommitted sets do not count — they serve nothing."""
+        return version == self._active_wv or version in self._weight_sets
+
+    def _params_for(self, version):
+        """Flat param list for a pinned version. Every dispatch site
+        routes through this instead of touching ``_params`` directly, so
+        a step binds exactly the version its rows are pinned to."""
+        if version == self._active_wv:
+            return self._params
+        try:
+            return self._weight_sets[version]
+        except KeyError:
+            raise KeyError(
+                f"weight version {version} is not resident on engine "
+                f"{self.name} (active={self._active_wv}, retained="
+                f"{sorted(self._weight_sets)})") from None
+
+    def pin_weight_version(self, rid, version):
+        """Re-pin a just-admitted request to the version its stream
+        STARTED under (the requeue / drain / migrate hand-off path:
+        admission pinned it to this engine's active version, but the
+        stream's KV-and-sampling identity belongs to its origin
+        version).  Any prefix match taken under the admission version
+        is released and re-taken under the pin — a pinned stream must
+        never attend over another version's KV.  Raises KeyError when
+        `version` is not servable here (callers skip this replica)."""
+        r = self._requests[rid]
+        if version == r.weight_version:
+            return r
+        if not self.has_weight_version(version):
+            raise KeyError(
+                f"engine {self.name} cannot serve weight version "
+                f"{version} (active={self._active_wv})")
+        self._release(r)
+        r.cached = 0
+        r.prefix_registered = False
+        r.weight_version = version
+        self._try_prefix_match(r)
+        return r
+
+    def stage_weight_set(self, version, arrays, crcs=None):
+        """Stage version `version` into the double buffer WITHOUT
+        serving it: validate the tensor count/shapes/dtypes against the
+        live flat param list, verify per-tensor CRCs when given (end-to-
+        end integrity on top of the transport's frame CRCs), and
+        device_put the set. The ``publish`` chaos site is consulted
+        between receiving the bytes and installing the staged entry —
+        manifest-last, so a ``kill@publish`` here leaves the engine dead
+        with version N fully intact and nothing half-staged, a ``drop``
+        makes the transfer vanish (the replica catches up later) and a
+        ``corrupt`` flips a staged byte the CRC check must catch.
+        Raises WeightTransferError on any integrity failure (the staged
+        buffer is discarded; the engine keeps serving its version)."""
+        from ..distributed.resilience.errors import WeightTransferError
+
+        self._check_alive()
+        cur = self._params
+        host = [np.asarray(a) for a in arrays]
+        if len(host) != len(cur):
+            raise WeightTransferError(
+                version, self.name,
+                f"tensor count {len(host)} != expected {len(cur)}")
+        for i, a in enumerate(host):
+            ref = cur[i]
+            if tuple(a.shape) != tuple(ref.shape) \
+                    or a.dtype != ref.dtype:
+                raise WeightTransferError(
+                    version, self.name,
+                    f"tensor {i}: got {a.dtype}{tuple(a.shape)}, "
+                    f"expected {ref.dtype}{tuple(ref.shape)}")
+        from ..distributed.resilience import faults as _faults
+        from ..distributed.resilience.errors import (EngineDeadError,
+                                                     PeerUnreachableError)
+
+        act = _faults.injector.on_event("publish", self.fault_rank)
+        if act is not None:
+            if act.kind == "kill":
+                self.dead = True
+                raise EngineDeadError(self.name, "publish")
+            if act.kind == "delay":
+                time.sleep(act.delay_ms / 1e3)
+            elif act.kind == "drop":
+                raise PeerUnreachableError(self.fault_rank, self.name, 1)
+            elif act.kind == "corrupt":
+                big = max(range(len(host)),
+                          key=lambda i: host[i].nbytes)
+                buf = bytearray(host[big].tobytes())
+                buf[len(buf) // 2] ^= 0xFF
+                host[big] = np.frombuffer(
+                    bytes(buf), host[big].dtype).reshape(host[big].shape)
+        if crcs is not None:
+            import zlib
+
+            if len(crcs) != len(host):
+                raise WeightTransferError(
+                    version, self.name,
+                    f"crc count {len(crcs)} != tensor count {len(host)}")
+            for i, a in enumerate(host):
+                got = zlib.crc32(a.tobytes()) & 0xFFFFFFFF
+                if got != (crcs[i] & 0xFFFFFFFF):
+                    raise WeightTransferError(
+                        version, self.name,
+                        f"tensor {i} CRC mismatch (got {got:#010x}, "
+                        f"manifest {crcs[i] & 0xFFFFFFFF:#010x})")
+        self._staged_weights[version] = jax.device_put(host)
+        return version
+
+    def commit_weight_set(self, version):
+        """Atomically swap a STAGED version in at a step boundary: the
+        current flat list is retained (bitwise rollback buffer + the
+        params in-flight pinned streams keep draining under) and
+        ``version`` becomes what new admissions pin to. Rebinding the
+        flat list costs no retrace — shapes/dtypes are identical across
+        versions, and the compiled step takes the params as an
+        argument. Raises PublishRejectedError when `version` was never
+        staged or does not advance the active version (stale publish)."""
+        from ..distributed.resilience.errors import PublishRejectedError
+
+        self._check_alive()
+        if version <= self._active_wv:
+            raise PublishRejectedError(
+                "stale_version", version, fence_version=self._active_wv)
+        staged = self._staged_weights.pop(version, None)
+        if staged is None:
+            raise PublishRejectedError(
+                "not_staged", version,
+                detail=f"stage_weight_set({version}, ...) never "
+                       f"completed on engine {self.name}")
+        old = self._active_wv
+        self._weight_sets[old] = self._params
+        self._weight_sets[version] = staged
+        self._params = staged
+        self._prev_wv = old
+        self._active_wv = version
+        self._gc_weight_sets()
+        self._m.weight_swaps.inc()
+        self._m.weight_version.set(version)
+        return old
+
+    def discard_staged(self, version=None):
+        """Drop staged-but-uncommitted buffers (all, or one version) —
+        the canary-rejection path: a refused candidate must not linger
+        in device memory."""
+        if version is None:
+            self._staged_weights.clear()
+        else:
+            self._staged_weights.pop(version, None)
+
+    def rollback_weight_set(self):
+        """Roll back to the retained previous version, bitwise-equal to
+        never having promoted: the previous flat list (retained at
+        commit, never copied or rebuilt) becomes active again, and any
+        in-flight stream pinned to the dropped version is RESET — pages
+        released, generated tokens discarded — and re-pinned, so its
+        re-generation under the schedule-independent salts reproduces
+        exactly the stream a never-promoted engine would have emitted.
+        Returns the version rolled back to."""
+        from ..distributed.resilience.errors import PublishRejectedError
+
+        self._check_alive()
+        if self._prev_wv is None or self._prev_wv not in self._weight_sets:
+            raise PublishRejectedError(
+                "no_previous", self._active_wv,
+                detail="nothing retained to roll back to")
+        bad, prev = self._active_wv, self._prev_wv
+        self._params = self._weight_sets[prev]
+        self._active_wv = prev
+        self._prev_wv = None          # a rollback cannot be rolled back
+        for r in self.pending():
+            if r.weight_version == bad:
+                self._release(r)
+                r.generated = []
+                r.cached = 0
+                r.prefix_registered = False
+                r.spec_observed = 0
+                r.weight_version = prev
+                self._try_prefix_match(r)
+        self._weight_sets.pop(bad, None)
+        self._staged_weights.pop(bad, None)
+        self._m.weight_rollbacks.inc()
+        self._m.weight_version.set(prev)
+        return prev
+
+    def _gc_weight_sets(self):
+        """Free retained flat lists no stream can reach: keep the
+        active version, the rollback buffer, and every version an
+        in-flight stream is still pinned to."""
+        keep = {self._active_wv}
+        if self._prev_wv is not None:
+            keep.add(self._prev_wv)
+        keep.update(r.weight_version for r in self.pending())
+        for v in [v for v in self._weight_sets if v not in keep]:
+            del self._weight_sets[v]
+
+    def probe_logits(self, prompt, version=None):
+        """Stateless canary probe: next-token logits of `prompt`'s last
+        position under `version` (default: active), WITHOUT touching
+        the KV pool, the scheduler, or any request state — the packed
+        row runs through the fresh-prefill executable against the trash
+        page and the returned caches are discarded. The probe can score
+        a STAGED version before it is committed anywhere, which is how
+        a poisoned candidate is rejected without ever serving a token.
+        Returns a float32 vector of vocab logits."""
+        self._check_alive()
+        if self._compiled_fresh is None:
+            raise ValueError(
+                "probe_logits needs a from_model engine: the exported "
+                "serving artifact has no fresh-prefill entry")
+        cfg = self.cfg
+        n = len(prompt)
+        if not 0 < n <= cfg.token_budget:
+            raise ValueError(
+                f"probe prompt length {n} must be in [1, "
+                f"{cfg.token_budget}] (one fresh-prefill shot)")
+        wv = self._active_wv if version is None else version
+        if wv == self._active_wv:
+            fp = self._params
+        elif wv in self._staged_weights:
+            fp = self._staged_weights[wv]
+        else:
+            fp = self._params_for(wv)
+        B1 = cfg.max_batch + 1
+        enc = np.zeros(B1, np.int32)
+        dec = np.zeros(B1, np.int32)
+        this = np.zeros(B1, np.int32)
+        this[0] = n
+        n_pad = cfg.token_budget - n
+        this[B1 - 1] = n_pad
+        enc[B1 - 1] = n_pad
+        tokens = np.asarray(list(prompt) + [0] * n_pad, np.int32)
+        cu = np.zeros(B1 + 1, np.int32)
+        cu[1:] = np.cumsum(this)
+        bt = np.zeros((B1, cfg.max_blocks_per_seq), np.int32)
+        extra = (self._ks, self._vs) if self._ks is not None else ()
+        out = self._compiled_fresh(fp, self._buffers, tokens, enc, dec,
+                                   this, cu, bt, self._kc, self._vc,
+                                   *extra)
+        return np.asarray(out[0], np.float32)[0]
 
     def _salt(self, r, n_generated):
         """Sampling salt under the request's ORIGIN identity: a request
@@ -1057,9 +1341,17 @@ class ServingEngine:
         if self._prefix_cache is not None:
             # zero-ref cache pages are reclaimable on demand
             avail += self._prefix_cache.evictable_count()
+        # one weight version per step: every scheduled row must share
+        # the version the dispatch will bind, so after a hot swap the
+        # step serves the OLDEST pending stream's version first (pre-
+        # publish streams drain under N while new admissions wait one
+        # scheduling round under N+1)
+        step_wv = None
         for r in self.pending():
             if len(rows) == cfg.max_batch or budget == 0:
                 break
+            if step_wv is not None and r.weight_version != step_wv:
+                continue
             chunk = min(r.length - r.cached, budget)
             cap = (len(r.pages) + avail) * cfg.block_size  # page-limited
             chunk = min(chunk, cap - r.cached)
@@ -1071,6 +1363,7 @@ class ServingEngine:
             budget -= chunk
             avail -= pages_needed
             rows.append((r, chunk))
+            step_wv = r.weight_version
         return rows
 
     def step(self):
@@ -1174,7 +1467,11 @@ class ServingEngine:
             and all(r.cached == 0 for r, _ in rows)
         compiled = self._compiled_fresh if fresh else self._compiled
         extra = (self._ks, self._vs) if self._ks is not None else ()
-        out = compiled(self._params, self._buffers, tokens,
+        # bind the step's pinned weight version (_schedule guarantees
+        # every scheduled row shares it); shapes/dtypes are identical
+        # across versions so no retrace happens on a swap
+        fp = self._params_for(rows[0][0].weight_version)
+        out = compiled(fp, self._buffers, tokens,
                        enc, dec, this, cu, bt, self._kc, self._vc,
                        *extra)
         logits = out[0]
@@ -1319,7 +1616,8 @@ class ServingEngine:
 
         extra = (self._ks, self._vs) if self._ks is not None else ()
         out = self._compiled_verify(
-            self._params, self._buffers, tokens, enc, dec, this, cu,
+            self._params_for(plans[0][0].weight_version),
+            self._buffers, tokens, enc, dec, this, cu,
             bt, self._kc, self._vc, *extra)
         logits = out[0]                                # [tok_len, V]
         self._set_caches(out[1], out[2])
@@ -1496,7 +1794,13 @@ class ServingEngine:
         self._check_alive()
         self._evict_expired()
         rows = [r for r in self.pending()
-                if r.length - r.cached == 1][:cfg.max_batch]
+                if r.length - r.cached == 1]
+        if rows:
+            # one weight version per window, oldest tip row's first —
+            # same single-version dispatch contract as _schedule
+            wv = rows[0].weight_version
+            rows = [r for r in rows
+                    if r.weight_version == wv][:cfg.max_batch]
         if not rows:
             return []
         # same pre-mutation contract as _step: every selected row is at
@@ -1578,7 +1882,8 @@ class ServingEngine:
         window = self._decode_window_fn(Bb, n, sample_mode)
         scales = (self._ks, self._vs) if self._ks is not None else ()
         samples, kc, vc, scales = window(
-            self._params, self._buffers, tokens, enc, dec, this, cu, bt,
+            self._params_for(rows[0].weight_version), self._buffers,
+            tokens, enc, dec, this, cu, bt,
             self._kc, self._vc, scales, temps, topks, topps, salts)
         self._kc, self._vc = kc, vc
         if self._ks is not None:

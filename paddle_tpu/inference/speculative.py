@@ -155,6 +155,24 @@ class DraftModelDrafter(Drafter):
         self._vocab = int(model.cfg.vocab_size) \
             if hasattr(model, "cfg") else None
 
+    def refresh(self, params) -> None:
+        """Install republished draft weights in place (``params`` maps
+        the draft model's ``named_parameters`` names to arrays).
+
+        Live weight publishing swaps the TARGET model under the fleet;
+        a draft model frozen at the old version keeps proposing the old
+        distribution and acceptance collapses — the publisher either
+        republishes draft weights through here alongside the target set
+        or swaps speculation down to an ``NGramDrafter``.  Speculative
+        output stays bitwise-correct either way (verify samples under
+        the target); only the accept rate is at stake."""
+        import jax.numpy as jnp
+
+        from ..jit import functional as FB
+
+        FB.write_back(self.model,
+                      {k: jnp.asarray(v) for k, v in params.items()})
+
     def propose(self, tokens, k: int) -> List[int]:
         import jax.numpy as jnp
 

@@ -1,0 +1,679 @@
+"""Live weight publishing: versioned hot-swap into a serving fleet.
+
+The trainer keeps producing better weights while the fleet serves; this
+module moves them into live engines WITHOUT draining — the rollout
+discipline of production serving control planes (vLLM sleep/wake update
+loops, SageMaker/KServe canary rollouts) rebuilt on this repo's own
+primitives:
+
+1. **Build** — ``build_weight_set`` replicates ``ServingEngine
+   .from_model``'s exact cast/quantize/flatten pipeline (bf16 cast,
+   optional int8/int4 ``WeightStreamer`` quantization, tree-flatten +
+   streamed-tail append) over a NEW param tree, so the produced flat
+   host arrays slot into an engine's ``_params`` position-for-position.
+   ``publish_from_checkpoint`` feeds it from a ``distributed.checkpoint``
+   directory — shards are reassembled whatever mesh the trainer saved
+   under (reshard-on-load), then cast to the serving layout.
+2. **Ship** — ``send_weight_set``/``receive_weight_set`` frame the set
+   over the CRC/ACK ``TensorTransport`` surface (JSON meta frame with
+   per-tensor dtype/shape/crc32, then raw byte frames).  The receiving
+   engine re-verifies every CRC before staging (``WeightTransferError``
+   discards a torn set) and double-buffers the staged version N+1 next
+   to serving N.
+3. **Canary** — the first healthy replica stages N+1 and is probed over
+   a golden prompt set via ``probe_logits`` — against the STAGED,
+   uncommitted buffer, so a poisoned version never serves a token
+   anywhere.  StepGuard-style checks: any nonfinite logit rejects
+   (``canary_nonfinite``); the candidate's NLL of the active version's
+   greedy token drifting past policy bounds rejects (``canary_drift``).
+4. **Promote** — on canary pass the fleet commits replica-by-replica.
+   The swap is atomic at a step boundary and manifest-last: every
+   request streams under the ONE version pinned at its admission
+   (token-bitwise-identical to a single-version run), and a replica
+   killed mid-transfer (``kill@publish``) leaves N fully intact —
+   nothing half-staged ever becomes visible.  Rollout epochs are fenced
+   through the store (``fenced_set``): a stale controller's publish is
+   refused with ``PublishRejectedError('stale_version')``, and a
+   replica offline during the rollout catches up on restart through
+   ``FleetSupervisor.weight_catchup``.
+5. **Rollback** — post-promote anomaly rolls every engine back to the
+   retained N buffer (``rollback_weight_set``), bitwise-equal to never
+   having promoted: in-flight streams pinned to the bad version restart
+   under N with their original sampling salts, so they regenerate the
+   exact pre-publish tokens.
+
+Speculative decoding rides along: a ``DraftModelDrafter`` frozen at the
+old target version silently collapses the accept rate after a swap, so
+``publish(draft_params=...)`` republishes draft weights in place
+(``DraftModelDrafter.refresh``) or, absent fresh draft weights, swaps
+speculation down to an ``NGramDrafter`` (``spec_drafter_fallbacks``).
+``check_spec_health`` alarms (``serving/spec_accept_alarms``) when a
+post-swap accept rate collapses versus its pre-swap baseline.
+
+Chaos surface: the ``publish`` fault site
+(``PT_FAULT_PLAN="kill@publish:..."``) fires inside the receiving
+engine's staging path — kill fells the engine with N intact, drop loses
+the transfer (replica catches up later), corrupt flips a byte that the
+CRC re-verify catches, delay stalls the stage.
+"""
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..distributed.resilience.errors import (EngineDeadError,
+                                             PeerUnreachableError,
+                                             PublishRejectedError,
+                                             StaleGenerationError,
+                                             TransportError,
+                                             WeightTransferError)
+from ..profiler import metrics as _metrics
+from ..profiler import tracing as _tracing
+
+__all__ = ["PublishPolicy", "PublishReport", "WeightPublisher",
+           "build_weight_set", "send_weight_set", "receive_weight_set",
+           "PUBLISH_CHANNEL"]
+
+PUBLISH_CHANNEL = "publish"
+
+_m_publishes = _metrics.counter("serving/weight_publishes")
+_m_rejected = _metrics.counter("serving/publish_rejected")
+_m_canary_fail = _metrics.counter("serving/canary_failures")
+_m_bytes = _metrics.counter("serving/publish_bytes")
+_m_ms = _metrics.histogram("serving/publish_ms")
+_m_catchups = _metrics.counter("serving/publish_catchups")
+_m_missed = _metrics.counter("serving/publish_missed")
+_m_drafter_repub = _metrics.counter("serving/spec_drafter_republished")
+_m_drafter_fb = _metrics.counter("serving/spec_drafter_fallbacks")
+_m_accept_alarm = _metrics.counter("serving/spec_accept_alarms")
+
+
+def _np_dtype(name: str):
+    """dtype-by-name including the ml_dtypes family (``np.dtype`` does
+    not resolve 'bfloat16' from the string)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+# ---------------------------------------------------------------------------
+# build: new params -> the engine's flat _params layout
+# ---------------------------------------------------------------------------
+
+def build_weight_set(model, params, cfg, weight_stream=None
+                     ) -> Tuple[List[np.ndarray], List[int]]:
+    """Run a param tree through ``from_model``'s serving pipeline:
+    floating leaves cast to ``cfg.dtype``, the decoder Linear stacks
+    quantized out under ``weight_stream`` (int8 per-channel / int4
+    grouped, leaf replaced by the scalar placeholder), tree-flattened
+    with the streamed tail appended.  Returns ``(host_arrays, crcs)``
+    in exactly the target engine's ``_params`` order — an engine built
+    with the same ``(cfg.dtype, weight_stream)`` accepts them
+    position-for-position via ``stage_weight_set``."""
+    from ..jit import functional as FB
+    from .weight_stream import WeightStreamer
+
+    if params is None:
+        params = FB.current_params(model)
+    tgt = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    cast = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a).astype(tgt)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else jnp.asarray(a),
+        dict(params))
+    if weight_stream is not None:
+        streamer = WeightStreamer.build(
+            model, cast, tgt,
+            prefetch=weight_stream != "int8-noprefetch",
+            mode="int4" if weight_stream == "int4" else "int8")
+    else:
+        streamer = None
+    flat, _ = jax.tree_util.tree_flatten(cast)
+    if streamer is not None:
+        flat = flat + streamer.flat()
+    host = [np.asarray(jax.device_get(a)) for a in flat]
+    crcs = [zlib.crc32(a.tobytes()) & 0xFFFFFFFF for a in host]
+    return host, crcs
+
+
+# ---------------------------------------------------------------------------
+# wire format: meta frame + per-tensor byte frames
+# ---------------------------------------------------------------------------
+
+def send_weight_set(transport, dst: int, version: int,
+                    arrays: Sequence[np.ndarray], crcs: Sequence[int],
+                    channel: str = PUBLISH_CHANNEL) -> int:
+    """Ship one versioned weight set: a JSON meta frame (version,
+    per-tensor dtype/shape/crc32), then each tensor's raw bytes as a
+    uint8 frame.  Returns the payload bytes shipped."""
+    meta = {"version": int(version), "n": len(arrays),
+            "dtypes": [str(a.dtype) for a in arrays],
+            "shapes": [list(a.shape) for a in arrays],
+            "crcs": [int(c) for c in crcs]}
+    transport.send(np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                   dst, channel)
+    total = 0
+    for a in arrays:
+        b = np.frombuffer(a.tobytes(), np.uint8)
+        transport.send(b, dst, channel)
+        total += int(b.size)
+    _m_bytes.inc(total)
+    return total
+
+
+def receive_weight_set(engine, transport, src: int,
+                       channel: str = PUBLISH_CHANNEL) -> int:
+    """Receive one weight set and stage it (double-buffered, NOT
+    serving) into ``engine``.  The engine re-verifies every CRC against
+    the meta frame before staging — a byte torn anywhere between the
+    builder and the buffer raises ``WeightTransferError`` and leaves
+    the active version untouched.  Returns the staged version."""
+    meta = json.loads(bytes(transport.recv(src, channel)).decode())
+    arrays = []
+    for dt, shape in zip(meta["dtypes"], meta["shapes"]):
+        raw = bytes(transport.recv(src, channel))
+        arrays.append(np.frombuffer(raw, _np_dtype(dt)).reshape(shape))
+    engine.stage_weight_set(int(meta["version"]), arrays,
+                            crcs=[int(c) for c in meta["crcs"]])
+    return int(meta["version"])
+
+
+# ---------------------------------------------------------------------------
+# policy + report
+# ---------------------------------------------------------------------------
+
+def _default_golden_prompts(vocab_size: int
+                            ) -> Tuple[Tuple[int, ...], ...]:
+    hi = max(int(vocab_size) - 1, 2)
+    raw = ((1, 2, 3, 4, 5, 6), (5, 3, 2, 7), (11, 4, 9, 2, 6, 1))
+    return tuple(tuple(1 + (t % (hi - 1)) for t in p) for p in raw)
+
+
+def _nll(logits: np.ndarray, tok: int) -> float:
+    x = np.asarray(logits, np.float64)
+    m = float(x.max())
+    return m + float(np.log(np.sum(np.exp(x - m)))) - float(x[tok])
+
+
+@dataclass
+class PublishPolicy:
+    """Canary gate + drafter-health knobs.
+
+    ``golden_prompts`` is the probe set (defaults to a fixed small set
+    folded into the model's vocab); ``drift_nll_factor``/
+    ``drift_nll_slack`` bound how much worse (in nats) the candidate
+    may score the active version's greedy continuation before the
+    publish is refused; ``accept_alarm_factor`` is the post-swap
+    speculative accept-rate floor, as a fraction of the pre-swap
+    baseline, below which ``check_spec_health`` alarms."""
+
+    golden_prompts: Optional[Sequence[Sequence[int]]] = None
+    drift_nll_factor: float = 4.0
+    drift_nll_slack: float = 2.0
+    accept_alarm_factor: float = 0.5
+
+
+@dataclass
+class PublishReport:
+    """What one publish actually did, replica by replica."""
+
+    version: int
+    canary: Optional[str]
+    committed: List[str]
+    missed: List[str]
+    publish_s: float
+    bytes_shipped: int
+
+
+# ---------------------------------------------------------------------------
+# the publisher
+# ---------------------------------------------------------------------------
+
+class WeightPublisher:
+    """Rollout controller for one serving fleet.
+
+    Owns the version counter, the fenced store epoch, the per-mode
+    payload cache (for restart catch-up), and the canary policy.
+    ``publish`` is the whole rollout — build, canary, promote — and
+    either commits fleet-wide or raises ``PublishRejectedError``
+    leaving the fleet serving exactly what it served before.
+
+    Wired into the recovery path: constructing with ``supervisor=``
+    installs ``catch_up`` as the supervisor's ``weight_catchup`` hook,
+    so a replica restarted after a crash (including ``kill@publish``)
+    is brought to the committed version before re-entering rotation.
+    """
+
+    def __init__(self, router, model, store=None, domain: str = "weights",
+                 supervisor=None, policy: Optional[PublishPolicy] = None,
+                 transport_factory: Optional[Callable] = None):
+        self.router = router
+        self.model = model
+        self.store = store
+        self.domain = domain
+        self.supervisor = supervisor
+        self.policy = policy or PublishPolicy()
+        self._transport_factory = transport_factory
+        self.version = 0          # last fleet-committed epoch
+        self._next = 1            # next epoch a publish will claim
+        # per-version source params (host) + per-(version, mode) payload
+        # cache: catch_up rebuilds any mode a late replica needs, and
+        # rollback re-anchors on the PREVIOUS version's source — so two
+        # generations of source are retained
+        self._history: Dict[int, Dict[str, np.ndarray]] = {}
+        self._payloads: Dict[Tuple[int, Optional[str]],
+                             Tuple[List[np.ndarray], List[int]]] = {}
+        self._draft_state = None
+        self._accept_baseline: Dict[str, float] = {}
+        if store is not None:
+            # a fresh controller (restarted, or a second one taking
+            # over) resumes AFTER the last epoch the store has seen —
+            # it must never re-claim a consumed epoch number
+            try:
+                cur = json.loads(bytes(store.get_nowait(
+                    f"publish/{domain}/manifest")).decode())
+                self._next = int(cur.get("version", 0)) + 1
+            except (KeyError, ValueError):
+                pass
+        if supervisor is not None:
+            supervisor.weight_catchup = self.catch_up
+
+    # -- transport ---------------------------------------------------------
+    def _transport(self):
+        if self._transport_factory is not None:
+            return self._transport_factory()
+        from .fleet_supervisor import LoopbackTransport
+
+        return LoopbackTransport()
+
+    def _ship(self, engine, version: int,
+              payload: Tuple[List[np.ndarray], List[int]]) -> int:
+        arrays, crcs = payload
+        tp = self._transport()
+        n = send_weight_set(tp, 0, version, arrays, crcs)
+        receive_weight_set(engine, tp, 0)
+        return n
+
+    # -- store fencing -----------------------------------------------------
+    def _fence(self, version: int, state: str, **extra) -> None:
+        """Claim rollout epoch ``version`` in the store.  The fenced
+        write IS the split-brain guard: a second controller (or a
+        zombie that slept through a newer rollout) loses here with
+        ``stale_version`` before any replica stages a byte."""
+        if self.store is None:
+            return
+        key = f"publish/{self.domain}/manifest"
+        if state == "staging":
+            # same-epoch exclusivity on top of the generation fence:
+            # fenced_set admits EQUAL generations (two writes within one
+            # epoch are legitimate — staging then committed), so a
+            # second controller re-claiming an already-claimed epoch
+            # must be refused by reading the manifest it would clobber
+            try:
+                cur = json.loads(bytes(self.store.get_nowait(key)
+                                       ).decode())
+            except (KeyError, ValueError):
+                cur = None
+            if cur is not None and int(cur.get("version", -1)) \
+                    >= int(version):
+                _m_rejected.inc()
+                raise PublishRejectedError(
+                    "stale_version", int(version),
+                    fence_version=int(cur["version"]),
+                    detail=f"epoch {cur['version']} already "
+                           f"{cur.get('state', 'claimed')}")
+        payload = json.dumps({"version": int(version), "state": state,
+                              "domain": self.domain,
+                              "t": time.time(), **extra})
+        try:
+            self.store.fenced_set(f"publish/{self.domain}/manifest",
+                                  payload, self.domain, gen=int(version))
+        except StaleGenerationError as e:
+            _m_rejected.inc()
+            raise PublishRejectedError(
+                "stale_version", int(version),
+                fence_version=e.fence_gen, detail=str(e)) from e
+
+    # -- canary ------------------------------------------------------------
+    def _canary_check(self, engine, version: int) -> None:
+        """Golden-prompt probe of the STAGED (uncommitted) version on
+        one replica.  Rejection discards the staged buffer — the bad
+        version never became active anywhere, so 'never serves a
+        token' holds by construction."""
+        pol = self.policy
+        prompts = pol.golden_prompts
+        if prompts is None:
+            prompts = _default_golden_prompts(
+                getattr(engine.cfg, "vocab_size", 0)
+                or self.model.cfg.vocab_size)
+        for prompt in prompts:
+            base = engine.probe_logits(prompt)
+            cand = engine.probe_logits(prompt, version=version)
+            if not np.all(np.isfinite(cand)):
+                self._canary_fail(engine, version, "canary_nonfinite",
+                                  f"nonfinite logits on golden prompt "
+                                  f"{list(prompt)}")
+            tok = int(np.argmax(base))
+            b_nll = _nll(base, tok)
+            c_nll = _nll(cand, tok)
+            bound = pol.drift_nll_factor * max(b_nll, 0.05) \
+                + pol.drift_nll_slack
+            if c_nll > bound:
+                self._canary_fail(
+                    engine, version, "canary_drift",
+                    f"candidate NLL {c_nll:.3f} of active greedy token "
+                    f"{tok} exceeds bound {bound:.3f} "
+                    f"(baseline {b_nll:.3f}) on {list(prompt)}")
+
+    def _canary_fail(self, engine, version: int, reason: str,
+                     detail: str) -> None:
+        engine.discard_staged(version)
+        _m_canary_fail.inc()
+        _m_rejected.inc()
+        _tracing.flight_note("publish_canary_rejected", version=version,
+                             reason=reason,
+                             replica=getattr(engine, "name", "?"))
+        self._fence(version, "rejected")
+        self._next = version + 1
+        raise PublishRejectedError(reason, version, detail=detail)
+
+    # -- drafter hand-off (speculative decoding across a swap) -------------
+    def _refresh_drafter(self, engine) -> None:
+        from .speculative import DraftModelDrafter, NGramDrafter
+
+        d = getattr(engine, "_drafter", None)
+        if d is None or not isinstance(d, DraftModelDrafter):
+            return
+        if self._draft_state is not None:
+            d.refresh(self._draft_state)
+            _m_drafter_repub.inc()
+        else:
+            # no fresh draft weights: a stale draft model proposes the
+            # OLD distribution and acceptance collapses — degrade to the
+            # model-free n-gram drafter instead (bitwise-safe either
+            # way; only throughput is at stake)
+            engine.set_drafter(
+                NGramDrafter(block_size=engine.cfg.block_size),
+                k=max(engine._spec_k, 1))
+            _m_drafter_fb.inc()
+            _tracing.flight_note("spec_drafter_fallback",
+                                 engine=getattr(engine, "name", "?"))
+        self._accept_baseline[getattr(engine, "name", "?")] = float(
+            engine._m.spec_accept_rate.value)
+
+    def check_spec_health(self) -> List[str]:
+        """Post-swap speculative health: alarm every engine whose
+        accept rate collapsed below ``accept_alarm_factor`` of its
+        pre-swap baseline (``serving/spec_accept_alarms``).  Call after
+        the fleet has decoded under the new version for a while."""
+        alarmed: List[str] = []
+        for rep in self.router.replicas:
+            eng = rep.engine
+            name = getattr(eng, "name", "?")
+            base = self._accept_baseline.get(name)
+            if base is None or base <= 0.0 \
+                    or getattr(eng, "_drafter", None) is None:
+                continue
+            rate = float(eng._m.spec_accept_rate.value)
+            if rate < self.policy.accept_alarm_factor * base:
+                _m_accept_alarm.inc()
+                _tracing.flight_note("spec_accept_collapse", engine=name,
+                                     baseline=base, rate=rate)
+                alarmed.append(name)
+        return alarmed
+
+    # -- payload bookkeeping ----------------------------------------------
+    def _payload_for(self, version: int, mode: Optional[str], cfg
+                     ) -> Tuple[List[np.ndarray], List[int]]:
+        key = (int(version), mode)
+        hit = self._payloads.get(key)
+        if hit is None:
+            src = self._history.get(int(version))
+            if src is None:
+                raise KeyError(
+                    f"no retained source for version {version} "
+                    f"(committed is {self.version})")
+            hit = build_weight_set(self.model, dict(src), cfg,
+                                   weight_stream=mode)
+            self._payloads[key] = hit
+        return hit
+
+    # -- the rollout -------------------------------------------------------
+    def publish(self, params=None, version: Optional[int] = None,
+                draft_params=None) -> PublishReport:
+        """One full rollout: build per-mode weight sets, canary on the
+        first healthy replica, promote fleet-wide, converge stragglers.
+
+        ``params`` (name -> array, serving-model layout) defaults to
+        the live model's current parameters — the trainer snapshot.
+        ``draft_params`` optionally republishes the speculative draft
+        model alongside (satellite: a stale drafter collapses accept
+        rates).  Raises ``PublishRejectedError`` on fence or canary
+        refusal; the fleet then serves exactly what it served before.
+        """
+        from ..jit import functional as FB
+
+        t0 = time.perf_counter()
+        live = [(i, rep) for i, rep in enumerate(self.router.replicas)
+                if rep.healthy()]
+        if not live:
+            _m_rejected.inc()
+            raise PublishRejectedError("no_replicas", self._next)
+        v = int(version) if version is not None else self._next
+        if v <= self.version:
+            _m_rejected.inc()
+            raise PublishRejectedError("stale_version", v,
+                                       fence_version=self.version)
+        # epoch claim precedes any byte hitting any replica
+        self._fence(v, "staging")
+        src = params if params is not None \
+            else FB.current_params(self.model)
+        src = {k: np.asarray(jax.device_get(a)) for k, a in src.items()}
+        payloads: Dict[Optional[str],
+                       Tuple[List[np.ndarray], List[int]]] = {}
+        for _, rep in live:
+            mode = getattr(rep.engine, "_weight_stream_mode", None)
+            if mode not in payloads:
+                payloads[mode] = build_weight_set(
+                    self.model, dict(src), rep.engine.cfg,
+                    weight_stream=mode)
+        if draft_params is not None:
+            self._draft_state = {
+                k: np.asarray(jax.device_get(a))
+                for k, a in draft_params.items()}
+        else:
+            self._draft_state = None
+
+        bytes_shipped = 0
+        missed: List[str] = []
+        committed: List[str] = []
+        canary_name: Optional[str] = None
+
+        # canary: stage + probe on ONE replica before anything commits.
+        # A canary replica dying mid-stage is a replica fault, not a
+        # verdict on the weights — the next healthy replica canaries.
+        remaining = list(live)
+        while remaining:
+            idx, rep = remaining[0]
+            eng = rep.engine
+            mode = getattr(eng, "_weight_stream_mode", None)
+            try:
+                bytes_shipped += self._ship(eng, v, payloads[mode])
+            except (EngineDeadError, PeerUnreachableError,
+                    TransportError, WeightTransferError) as e:
+                remaining.pop(0)
+                missed.append(rep.name)
+                self._note_replica_fault(idx, rep, e)
+                continue
+            canary_name = rep.name
+            self._canary_check(eng, v)      # raises on rejection
+            eng.commit_weight_set(v)
+            self._refresh_drafter(eng)
+            committed.append(rep.name)
+            remaining.pop(0)
+            break
+        if canary_name is None:
+            _m_rejected.inc()
+            self._fence(v, "rejected")
+            self._next = v + 1
+            raise PublishRejectedError(
+                "no_replicas", v,
+                detail="every replica failed to stage the canary set")
+
+        # fleet promote: replica-by-replica; a replica lost here misses
+        # the rollout (catches up via restart hook / reconcile), it
+        # does not abort the fleet
+        for idx, rep in remaining:
+            eng = rep.engine
+            mode = getattr(eng, "_weight_stream_mode", None)
+            try:
+                bytes_shipped += self._ship(eng, v, payloads[mode])
+                eng.commit_weight_set(v)
+            except (EngineDeadError, PeerUnreachableError,
+                    TransportError, WeightTransferError,
+                    PublishRejectedError) as e:
+                missed.append(rep.name)
+                self._note_replica_fault(idx, rep, e)
+                continue
+            self._refresh_drafter(eng)
+            committed.append(rep.name)
+
+        prev_committed = self.version
+        self.version = v
+        self._next = v + 1
+        self._history = {ver: s for ver, s in self._history.items()
+                         if ver == prev_committed}
+        self._history[v] = src
+        self._payloads = {(v, mode): p for mode, p in payloads.items()}
+        self._fence(v, "committed")
+        _m_publishes.inc()
+        dt = time.perf_counter() - t0
+        _m_ms.observe(dt * 1e3)
+        _tracing.flight_note("weight_publish", version=v,
+                             canary=canary_name, committed=committed,
+                             missed=missed)
+        return PublishReport(version=v, canary=canary_name,
+                             committed=committed, missed=missed,
+                             publish_s=dt, bytes_shipped=bytes_shipped)
+
+    def publish_from_checkpoint(self, path: str, **kw) -> PublishReport:
+        """Publish a trainer checkpoint (``distributed.checkpoint``
+        layout): shards saved under ANY trainer mesh are reassembled to
+        full tensors (reshard-on-load), matched to the serving model's
+        parameter names, and pushed through the normal rollout."""
+        from ..distributed.checkpoint import load_state_dict
+        from ..jit import functional as FB
+
+        current = FB.current_params(self.model)
+        sd = {k: None for k in current}
+        load_state_dict(sd, path)
+        params = {}
+        for k, cur in current.items():
+            v = sd[k]
+            if v is None:
+                raise KeyError(
+                    f"checkpoint at {path!r} is missing parameter {k!r}")
+            arr = getattr(v, "_value", v)
+            params[k] = np.asarray(jax.device_get(arr)).astype(
+                np.asarray(jax.device_get(cur)).dtype)
+        return self.publish(params=params, **kw)
+
+    def _note_replica_fault(self, idx: int, rep, err) -> None:
+        _m_missed.inc()
+        _tracing.flight_note("publish_replica_missed", replica=rep.name,
+                             error=type(err).__name__)
+        if getattr(rep.engine, "dead", False):
+            # dead engine: take it out of rotation now; the normal
+            # supervisor pump restarts it and the weight_catchup hook
+            # converges its version before it serves again
+            rep.mark_unhealthy()
+
+    # -- convergence -------------------------------------------------------
+    def catch_up(self, engine) -> bool:
+        """Bring one engine to the committed fleet version (restart
+        hook: ``FleetSupervisor.restart`` calls this on the fresh
+        engine before it re-enters rotation).  No-op when the engine
+        already serves (or outruns) the committed epoch."""
+        if self.version <= 0:
+            return False
+        if engine.active_weight_version >= self.version:
+            return False
+        mode = getattr(engine, "_weight_stream_mode", None)
+        payload = self._payload_for(self.version, mode, engine.cfg)
+        self._ship(engine, self.version, payload)
+        engine.commit_weight_set(self.version)
+        self._refresh_drafter(engine)
+        _m_catchups.inc()
+        _tracing.flight_note("publish_catchup",
+                             engine=getattr(engine, "name", "?"),
+                             version=self.version)
+        return True
+
+    def reconcile(self) -> List[str]:
+        """Converge every live replica onto the committed epoch —
+        replicas that missed the rollout (drop@publish, offline window)
+        and were not restarted through the supervisor hook."""
+        updated: List[str] = []
+        for rep in self.router.replicas:
+            eng = rep.engine
+            if getattr(eng, "dead", False):
+                continue
+            try:
+                if self.catch_up(eng):
+                    updated.append(rep.name)
+            except (EngineDeadError, PeerUnreachableError,
+                    TransportError, WeightTransferError):
+                continue
+        return updated
+
+    # -- rollback ----------------------------------------------------------
+    def rollback(self, reason: str = "anomaly") -> int:
+        """Fleet-wide revert to the retained previous buffer.  Every
+        engine still on the anomalous version swaps back bitwise (its
+        in-flight streams pinned to the bad version restart under the
+        previous params with their original salts — the regenerated
+        tokens equal a run where the promote never happened).  Returns
+        the version now serving."""
+        bad = self.version
+        prev: Optional[int] = None
+        rolled: List[str] = []
+        for rep in self.router.replicas:
+            eng = rep.engine
+            if getattr(eng, "dead", False):
+                continue
+            if eng.active_weight_version != bad:
+                continue
+            prev = eng.rollback_weight_set()
+            rolled.append(rep.name)
+        if prev is None:
+            raise PublishRejectedError(
+                "no_previous", bad,
+                detail="no live replica had a retained previous buffer")
+        self.version = prev
+        self._next = max(self._next, bad + 1)
+        self._history.pop(bad, None)
+        self._payloads = {}
+        self._draft_state = None
+        # the fence stays at the highest CONSUMED epoch, which may be
+        # past ``bad`` — a candidate rejected after the promote already
+        # advanced the store's generation high-water, and an equal
+        # generation is the most a fenced write may reuse.  The NEXT
+        # publish claims past it, so a zombie re-push of the
+        # rolled-back version is refused as stale.
+        self._fence(max(bad, self._next - 1), "rolled_back",
+                    bad_version=bad, now_serving=prev)
+        _tracing.flight_note("weight_rollback", bad_version=bad,
+                             now_serving=prev, reason=reason,
+                             replicas=rolled)
+        return prev

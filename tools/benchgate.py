@@ -170,15 +170,26 @@ _GATEWAY_GATES = {"interactive_completed": True, "goodput_rps": True,
 # step latency must not rise.
 _SPEC_GATES = {"tokens_per_sec": True, "accept_rate": True,
                "speedup": True, "bitwise_match": True, "step_ms": False}
+# weight_publish: a live versioned rollout lands mid-wave.
+# requests_completed and bitwise_match are zero-slack — a publish may
+# never drop a request, and every stream must match the regenerated
+# reference of the version it was PINNED to (old streams finish under
+# N, new streams under N+1); publish wall time must not rise and
+# goodput under the rollout must not sag past the normal threshold.
+_PUBLISH_GATES = {"requests_completed": True, "bitwise_match": True,
+                  "goodput_rps": True, "publish_s": False}
 _CHAOS_ROWS = (
     # fleet_recovery: one replica killed mid-decode; host_recovery: a
     # whole host's replicas felled at once; gateway_storm: every
     # arrival multiplied 4x at the admit site; spec_decode: draft k /
-    # verify-in-one-step decoding vs the plain step loop
+    # verify-in-one-step decoding vs the plain step loop;
+    # weight_publish: canary-gated hot swap under live traffic
     ("fleet_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("host_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("gateway_storm", _GATEWAY_GATES, ("interactive_completed",)),
     ("spec_decode", _SPEC_GATES, ("bitwise_match",)),
+    ("weight_publish", _PUBLISH_GATES,
+     ("requests_completed", "bitwise_match")),
 )
 _RECOVERY_ROWS = tuple(r for r, _, _ in _CHAOS_ROWS)
 

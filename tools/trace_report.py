@@ -117,6 +117,19 @@ KNOWN_METRICS = (
     # int8/int4 double-buffered weight streaming
     # (inference/weight_stream.py)
     "weights/stream_prefetch_ms",
+    # live weight publishing (inference/weight_publish.py): per-engine
+    # swap state + fleet rollout funnel (publishes / refusals / canary
+    # verdicts / shipped bytes + wall time / restart catch-ups /
+    # replicas that missed a rollout) and the speculative-drafter
+    # hand-off across a swap (republish vs n-gram fallback, post-swap
+    # accept-rate collapse alarms)
+    "serving/weight_version", "serving/weight_swaps",
+    "serving/weight_rollbacks", "serving/weight_publishes",
+    "serving/publish_rejected", "serving/canary_failures",
+    "serving/publish_bytes", "serving/publish_ms",
+    "serving/publish_catchups", "serving/publish_missed",
+    "serving/spec_drafter_republished", "serving/spec_drafter_fallbacks",
+    "serving/spec_accept_alarms",
     # Executor-tier auto_fuse fallback (static/__init__.py)
     "compiler/executor_fuse_reverts",
     # IR-level program analyzer (paddle_tpu/analysis/program/)
