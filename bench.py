@@ -992,6 +992,10 @@ def bench_gateway_storm(on_tpu):
                                               SamplingParams,
                                               ServingEngine)
     from paddle_tpu.profiler import metrics as _pmetrics
+    from paddle_tpu.profiler import timeline as _ptimeline
+    from paddle_tpu.profiler import tracing as _ptracing
+    from paddle_tpu.profiler.headroom import ScaleAdvisor
+    from paddle_tpu.profiler.slo import SLOObjective, SLOTracker
 
     n_int, n_batch, prompt_len, max_new = 6, 4, 12, 6
     cfg = PagedServingConfig(
@@ -1067,12 +1071,106 @@ def bench_gateway_storm(on_tpu):
     defer0 = _pmetrics.counter("gateway/deferrals").value
     requeue0 = _pmetrics.counter("serving/requeues").value
     exhausted0 = _pmetrics.counter("serving/requeue_exhausted").value
-    faults.arm("overload@admit%1.0:x=4")
+
+    # -- SLO engine (ISSUE 16): timeline + burn alerts + headroom over
+    # the storm.  Everything runs on a synthetic step-counter clock
+    # (one tick per gateway step) so window math is deterministic on
+    # any host — wall-clock never enters the alert logic.
+    import tempfile
+    step_count = [0]
+    spill_dir = tempfile.mkdtemp(prefix="pt_timeline_")
+    flight_dir = tempfile.mkdtemp(prefix="pt_flight_")
+    tl = _ptimeline.Timeline(clock=lambda: float(step_count[0]),
+                             spill_dir=spill_dir)
+    tracker = SLOTracker(
+        class_objectives={"interactive": SLOObjective(target=0.999),
+                          "batch": SLOObjective(target=0.99),
+                          "best_effort": SLOObjective(target=0.99)},
+        clock=lambda: float(step_count[0]),
+        fast_window_s=40.0, slow_window_s=4000.0,
+        burn_threshold=10.0, clear_after=3)
+    advisor = ScaleAdvisor(tl, tracker, window_s=40.0, min_windows=3)
+    prev_flight_dir = _ptracing.flight._dir
+    _ptimeline.install(tl)
+    tl.attach_flight(n=400)
+    _ptracing.set_flight_dir(flight_dir)
+
     gw = build()
-    t0 = time.perf_counter()
-    t_int, t_batch, out = drive(gw)
-    total_s = time.perf_counter() - t0
-    faults.disarm()
+    tracker.attach(gw)
+
+    def tick(every: int = 5):
+        step_count[0] += 1
+        if step_count[0] % every == 0:
+            tl.sample()
+            tracker.evaluate()
+
+    advice_during = None
+    dump_path = None
+    try:
+        for _ in range(15):                  # pre-storm calm windows
+            gw.step()
+            tick()
+        prestorm_seq = tl.windows()[-1]["seq"]
+
+        faults.arm("overload@admit%1.0:x=4")
+        t0 = time.perf_counter()
+        for i, p in enumerate(int_prompts):
+            t_int[i] = gw.submit(p, max_new_tokens=max_new,
+                                 sampling=sp, tenant="alpha",
+                                 slo="interactive", stream_key=1000 + i)
+        for i, p in enumerate(batch_prompts):
+            t_batch[i] = gw.submit(p, max_new_tokens=max_new,
+                                   sampling=sp, tenant="beta",
+                                   slo="batch", stream_key=2000 + i)
+        for _ in range(4000):
+            gw.step()
+            tick()
+            if advice_during is None and gw.brownout.level >= 1 \
+                    and len(tl.windows()) >= 2:
+                advice_during = advisor.recommend()
+            if not gw.queued() and not gw.router._live_pending():
+                break
+        out = gw.results()
+        total_s = time.perf_counter() - t0
+        faults.disarm()
+
+        # recovery: idle ticks age the storm out of the fast window so
+        # the burn alert clears (hysteresis: 3 calm evals) and the
+        # brownout ladder unwinds out of the advisor's horizon; the
+        # post-recovery advisory is taken 20 virtual steps after the
+        # clear — late enough that the ladder's last engaged window
+        # left the horizon, soon enough that the cleared-alert edge is
+        # still inside it (recent judgment vetoes a scale_down)
+        cleared_at = None
+        advice_after = None
+        for _ in range(120):
+            gw.step()
+            tick()
+            if cleared_at is None and tracker.alerts \
+                    and not tracker.active_alerts():
+                cleared_at = step_count[0]
+            if advice_after is None and cleared_at is not None \
+                    and step_count[0] >= cleared_at + 20:
+                advice_after = advisor.recommend()
+        if advice_after is None:
+            advice_after = advisor.recommend()
+        dump_path = _ptracing.flight_dump("gateway_storm_postmortem",
+                                          storm_factor=4)
+    finally:
+        faults.disarm()
+        _ptimeline.uninstall(tl)
+        _ptracing.flight.detach("timeline")
+        _ptracing.set_flight_dir(prev_flight_dir)
+
+    slo_report = tracker.report()
+    flight_prestorm = False
+    if dump_path:
+        with open(dump_path) as f:
+            flight_windows = json.load(f).get("timeline", [])
+        flight_prestorm = any(w.get("seq", 1 << 30) <= prestorm_seq
+                              for w in flight_windows)
+    alerts_raised = len(tracker.alerts)
+    alerts_cleared = sum(1 for a in tracker.alerts if not a.active)
 
     # bitwise discipline: under 4x overload every completed REAL
     # stream must be a bitwise prefix of its unloaded reference, and
@@ -1122,6 +1220,31 @@ def bench_gateway_storm(on_tpu):
         "brownout_max_level": BROWNOUT_LEVELS[gw.brownout.max_level],
         "brownout_transitions": len(gw.brownout.transitions),
         "bitwise_match": bitwise,
+        # SLO engine signals (ISSUE 16): attainment per class, the
+        # burn-alert census (resolved = every raised alert cleared by
+        # run end), the advisor's verdicts, and the postmortem evidence
+        "interactive_slo_attainment":
+            (slo_report["per_class"].get("interactive") or {})
+            .get("attainment"),
+        "slo_attainment_by_class":
+            {c: r.get("attainment")
+             for c, r in slo_report["per_class"].items()},
+        "slo_attainment_by_tenant":
+            {k: r.get("attainment")
+             for k, r in slo_report["per_tenant"].items()},
+        "burn_alerts_raised": alerts_raised,
+        "burn_alerts_cleared": alerts_cleared,
+        "burn_alerts_resolved":
+            (alerts_cleared / alerts_raised) if alerts_raised else 0.0,
+        "burn_alert_keys": sorted({f"{a.tenant}/{a.slo_class}"
+                                   for a in tracker.alerts}),
+        "scale_advice_storm":
+            advice_during.action if advice_during else None,
+        "scale_advice_after": advice_after.action,
+        "headroom_after": advice_after.headroom,
+        "timeline_windows": len(tl.windows()),
+        "timeline_spilled": len(_ptimeline.load_spill(spill_dir)),
+        "flight_prestorm_windows": flight_prestorm,
     }}
 
 

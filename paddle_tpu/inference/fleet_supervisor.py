@@ -61,6 +61,7 @@ from ..distributed.resilience.errors import (EngineDeadError,
                                              TransportError,
                                              WeightTransferError)
 from ..profiler import metrics as _metrics
+from ..profiler import timeline as _timeline
 from ..profiler import tracing as _tracing
 from .router import ReplicaRouter
 from .serving import EngineOverloadedError, ServingEngine
@@ -158,6 +159,8 @@ class FleetSupervisor:
             "engine_dead", replica=rep.name,
             engine=getattr(rep.engine, "name", "?"),
             host=rep.host_id, replica_idx=idx)
+        _timeline.emit_event("replica_failed", replica=rep.name,
+                             host=rep.host_id)
         self.drain(idx)
         if self.cfg.restart:
             self.restart(idx)
@@ -191,6 +194,7 @@ class FleetSupervisor:
         self.router._handles[handle] = (dst_idx, dst_rid)
         self.router._by_engine[(dst_idx, dst_rid)] = handle
         self.drained_handles.add(handle)
+        self.router.moved_handles.add(handle)
 
     def _off_host(self, src_idx: int, dst_idx: int) -> bool:
         src_h = self.router.replicas[src_idx].host_id

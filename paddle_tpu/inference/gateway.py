@@ -78,6 +78,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..distributed.resilience import faults as _faults
 from ..distributed.resilience.errors import GatewayRejectedError
 from ..profiler import metrics as _metrics
+from ..profiler import timeline as _timeline
 from ..profiler import tracing as _tracing
 from .router import ReplicaRouter
 from .serving import EngineOverloadedError
@@ -104,6 +105,17 @@ _m_storm = _metrics.counter("gateway/storm_injected")
 _m_level = _metrics.gauge("gateway/brownout_level")
 _m_transitions = _metrics.counter("gateway/brownout_transitions")
 _m_depth = _metrics.gauge("gateway/queue_depth")
+_m_load = _metrics.gauge("gateway/load_score")
+
+# reason-coded terminal outcomes: every request the gateway touches
+# resolves to EXACTLY ONE of these (the SLO engine's attainment input)
+_OUTCOME_COUNTERS = {
+    "completed": _metrics.counter("gateway/outcome/completed"),
+    "deadline_missed": _metrics.counter("gateway/outcome/deadline_missed"),
+    "shed": _metrics.counter("gateway/outcome/shed"),
+    "rejected": _metrics.counter("gateway/outcome/rejected"),
+    "drained": _metrics.counter("gateway/outcome/drained"),
+}
 
 
 @dataclass
@@ -161,6 +173,11 @@ class BrownoutConfig:
     hysteresis: int = 3
     clamp_max_new: int = 4
     retry_after_s: float = 1.0
+    # sustained-overload postmortem trigger: after this many
+    # CONSECUTIVE evaluations holding the reject rung, the flight
+    # recorder dumps once per episode (symmetric with the engine-death
+    # and quorum-loss triggers)
+    reject_dump_after: int = 3
 
 
 @dataclass
@@ -266,6 +283,8 @@ class BrownoutController:
         self.max_level = L_NORMAL
         self.transitions: List[Tuple[int, int]] = []
         self._calm = 0
+        self._reject_held = 0     # consecutive evals AT the reject rung
+        self._reject_dumped = False
 
     def observe(self, load: float,
                 ttft_p95_ms: Optional[float] = None) -> int:
@@ -286,6 +305,25 @@ class BrownoutController:
                 self._move(self.level - 1)
         else:
             self._calm = 0
+        if self.level >= L_REJECT:
+            # reaching AND HOLDING the reject rung is the sustained-
+            # overload incident worth a black box: dump once per
+            # episode with the pre-storm timeline windows attached
+            self._reject_held += 1
+            if self._reject_held >= cfg.reject_dump_after \
+                    and not self._reject_dumped:
+                self._reject_dumped = True
+                _tracing.flight_dump(
+                    "brownout_reject_sustained",
+                    held_evals=self._reject_held, load=load,
+                    ttft_p95_ms=ttft_p95_ms)
+        else:
+            self._reject_held = 0
+            self._reject_dumped = False
+        # refresh every observe, not just on transitions: the gauge is
+        # module-global and a fresh controller must not inherit a
+        # previous gateway's last level
+        _m_level.set(self.level)
         return self.level
 
     def _move(self, to: int):
@@ -297,6 +335,9 @@ class BrownoutController:
             args={"from": BROWNOUT_LEVELS[self.level],
                   "to": BROWNOUT_LEVELS[to]})
         self.transitions.append((self.level, to))
+        _timeline.emit_event("gateway_brownout",
+                             frm=BROWNOUT_LEVELS[self.level],
+                             to=BROWNOUT_LEVELS[to])
         self.level = to
         self.max_level = max(self.max_level, to)
         _m_transitions.inc()
@@ -327,7 +368,7 @@ class _Pending:
 class _Ticket:
     __slots__ = ("tenant", "slo", "handle", "stream_key", "session",
                  "rejected", "clamped", "deferred", "submit_t",
-                 "first_tok_t", "synthetic")
+                 "first_tok_t", "synthetic", "outcome", "outcome_reason")
 
     def __init__(self, tenant, slo, stream_key, session, synthetic):
         self.tenant = tenant
@@ -341,6 +382,9 @@ class _Ticket:
         self.submit_t = time.perf_counter()
         self.first_tok_t = None
         self.synthetic = synthetic
+        # exactly-once terminal outcome (the SLO engine's input)
+        self.outcome: Optional[str] = None
+        self.outcome_reason: Optional[str] = None
 
 
 class FleetGateway:
@@ -378,6 +422,10 @@ class FleetGateway:
         # (tenant, session) -> replica idx of the session's last turn
         self._sessions: Dict[Tuple[str, Optional[str]], int] = {}
         self.shed_by_class: Dict[str, int] = {}
+        # outcome listeners: called with one reason-coded event dict
+        # per terminal outcome (profiler.slo.SLOTracker.attach
+        # subscribes here); pre-queue rejections carry ticket=None
+        self.outcome_listeners: List[Callable[[dict], None]] = []
         self._apply_page_quotas()
 
     # -- config plumbing ---------------------------------------------------
@@ -443,6 +491,8 @@ class FleetGateway:
             elif act.kind == "drop":
                 # the client vanished between SYN and request body
                 self._count_reject(tenant, slo)
+                self._emit_outcome("rejected", tenant, slo,
+                                   reason="injected_drop")
                 raise GatewayRejectedError("injected_drop",
                                            tenant=tenant, slo_class=slo)
             elif act.kind == "overload":
@@ -475,11 +525,17 @@ class FleetGateway:
         if not cls.protected:
             if cls.sheddable and lvl >= L_SHED:
                 self._count_reject(tenant, slo, shed=True)
+                self._emit_outcome("shed", tenant, slo,
+                                   reason="brownout_shed",
+                                   synthetic=synthetic)
                 raise GatewayRejectedError(
                     "brownout_shed", tenant=tenant, slo_class=slo,
                     retry_after_s=retry_after)
             if lvl >= L_REJECT:
                 self._count_reject(tenant, slo, shed=True)
+                self._emit_outcome("rejected", tenant, slo,
+                                   reason="brownout_reject",
+                                   synthetic=synthetic)
                 raise GatewayRejectedError(
                     "brownout_reject", tenant=tenant, slo_class=slo,
                     retry_after_s=retry_after)
@@ -487,6 +543,8 @@ class FleetGateway:
         if not bucket.try_take():
             _m_throttled.inc()
             self._count_reject(tenant, slo)
+            self._emit_outcome("rejected", tenant, slo,
+                               reason="tenant_rate", synthetic=synthetic)
             raise GatewayRejectedError(
                 "tenant_rate", tenant=tenant, slo_class=slo,
                 retry_after_s=bucket.time_to())
@@ -495,6 +553,9 @@ class FleetGateway:
         tc = self._tenant_cfg(tenant)
         if sum(len(q) for q in queues.values()) >= tc.max_queued:
             self._count_reject(tenant, slo)
+            self._emit_outcome("rejected", tenant, slo,
+                               reason="tenant_queue_full",
+                               synthetic=synthetic)
             raise GatewayRejectedError(
                 "tenant_queue_full", tenant=tenant, slo_class=slo,
                 retry_after_s=retry_after)
@@ -526,6 +587,34 @@ class FleetGateway:
             args={"tenant": tenant, "class": slo,
                   "brownout": BROWNOUT_LEVELS[self.brownout.level]})
 
+    # -- terminal outcomes -------------------------------------------------
+    def _emit_outcome(self, outcome: str, tenant: str, slo: str,
+                      reason: Optional[str] = None,
+                      ticket: Optional[int] = None, tk=None,
+                      synthetic: bool = False):
+        """Resolve one request's reason-coded terminal outcome exactly
+        once (completed / deadline_missed / shed / rejected(reason) /
+        drained) and publish it to the outcome listeners.  Pre-queue
+        rejections have no ticket; everything else resolves through its
+        `_Ticket`, which latches so double emission is impossible."""
+        ttft_ms = None
+        if tk is not None:
+            if tk.outcome is not None:
+                return
+            tk.outcome = outcome
+            tk.outcome_reason = reason
+            synthetic = tk.synthetic
+            if tk.first_tok_t is not None:
+                ttft_ms = (tk.first_tok_t - tk.submit_t) * 1e3
+        _OUTCOME_COUNTERS[outcome].inc()
+        if not self.outcome_listeners:
+            return
+        ev = {"outcome": outcome, "reason": reason, "tenant": tenant,
+              "slo": slo, "ticket": ticket, "synthetic": synthetic,
+              "ttft_ms": ttft_ms}
+        for fn in list(self.outcome_listeners):
+            fn(ev)
+
     # -- pressure + ladder -------------------------------------------------
     def _pressure(self) -> Tuple[float, Optional[float]]:
         """(mean healthy-replica load_score, max digest p95 TTFT ms)."""
@@ -541,6 +630,7 @@ class FleetGateway:
                 "serving/ttft_ms").quantile(0.95)
             if q is not None and (ttft is None or q > ttft):
                 ttft = q
+        _m_load.set(load)
         return load, ttft
 
     # -- dispatch ----------------------------------------------------------
@@ -627,6 +717,9 @@ class FleetGateway:
                 retry_after_s=self.cfg.brownout.retry_after_s)
             tk.rejected = err
             self._count_reject(entry.tenant, entry.slo)
+            self._emit_outcome("rejected", entry.tenant, entry.slo,
+                               reason="retry_budget",
+                               ticket=entry.ticket, tk=tk)
             return True
         cls = self._class_cfg(entry.slo)
         max_new = entry.max_new
@@ -690,6 +783,9 @@ class FleetGateway:
                         "brownout_shed", tenant=tenant, slo_class=slo,
                         retry_after_s=self.cfg.brownout.retry_after_s)
                     self._count_reject(tenant, slo, shed=True)
+                    self._emit_outcome("shed", tenant, slo,
+                                       reason="brownout_shed",
+                                       ticket=entry.ticket, tk=tk)
 
     def queued(self) -> int:
         return sum(len(q) for queues in self._queues.values()
@@ -743,7 +839,32 @@ class FleetGateway:
             if toks and tk.first_tok_t is None:
                 tk.first_tok_t = now
             out[t] = toks
+        self._finalize_outcomes()
         return out
+
+    def _finalize_outcomes(self):
+        """Latch terminal outcomes for every placed ticket whose engine
+        request has resolved: timed out -> deadline_missed, finished on
+        the original replica -> completed, finished after a requeue
+        hop -> drained."""
+        moved = getattr(self.router, "moved_handles", set())
+        for ticket, tk in self._tickets.items():
+            if tk.outcome is not None or tk.handle is None:
+                continue
+            placed = self.router._handles.get(tk.handle)
+            if placed is None:
+                continue
+            idx, rid = placed
+            req = self.router.replicas[idx].engine._requests.get(rid)
+            if req is None:
+                continue
+            if req.timed_out:
+                self._emit_outcome("deadline_missed", tk.tenant, tk.slo,
+                                   ticket=ticket, tk=tk)
+            elif req.done:
+                self._emit_outcome(
+                    "drained" if tk.handle in moved else "completed",
+                    tk.tenant, tk.slo, ticket=ticket, tk=tk)
 
     def run_to_completion(self, max_steps: int = 2000):
         for _ in range(max_steps):

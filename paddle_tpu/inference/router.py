@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..distributed.resilience import faults as _faults
 from ..profiler import metrics as _metrics
+from ..profiler import timeline as _timeline
 from ..profiler import tracing as _tracing
 from .serving import EngineOverloadedError, ServingEngine
 
@@ -132,6 +133,7 @@ class Replica:
                 self._demoted = False
                 self._streak = 0
                 _m_restored.inc()
+                _timeline.emit_event("replica_restored", replica=self.name)
         else:
             self._streak = 0
         return ok
@@ -139,6 +141,7 @@ class Replica:
     def mark_unhealthy(self):
         self._demoted = True
         self._streak = 0
+        _timeline.emit_event("replica_demoted", replica=self.name)
 
     def mark_healthy(self):
         self._demoted = False
@@ -176,6 +179,9 @@ class ReplicaRouter:
         self.max_requeues = max(int(max_requeues), 0)
         self._handles: Dict[int, Tuple[int, int]] = {}   # h -> (idx, rid)
         self._by_engine: Dict[Tuple[int, int], int] = {}
+        # handles that hopped replicas (requeue/drain): the gateway
+        # reason-codes their completion "drained", not "completed"
+        self.moved_handles: set = set()
         self._next_handle = 0
         # called with the replica index when an engine dies mid-step
         # (EngineDeadError): the fleet supervisor installs its drain +
@@ -307,6 +313,7 @@ class ReplicaRouter:
                 if handle is not None:
                     self._handles[handle] = (idx, rid)
                     self._by_engine[(idx, rid)] = handle
+                    self.moved_handles.add(handle)
                 return
             # nowhere to retry: the handle keeps pointing at the
             # timed-out request so results() reports it honestly

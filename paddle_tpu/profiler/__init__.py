@@ -23,7 +23,7 @@ __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
            "make_scheduler", "export_chrome_tracing", "export_protobuf",
            "load_profiler_result", "SummaryView", "metrics",
            "host_tracing_active", "tracing", "digest", "aggregate",
-           "TraceContext"]
+           "timeline", "slo", "headroom", "TraceContext"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -266,4 +266,14 @@ class Profiler:
 from . import digest           # noqa: E402
 from . import tracing          # noqa: E402
 from . import aggregate        # noqa: E402
+# the SLO engine (ISSUE 16): timeline = the time dimension over the
+# registry, slo = objectives/attainment/burn alerts over gateway
+# outcomes, headroom = the AutoScaler advisory interface
+from . import timeline         # noqa: E402
+from . import slo              # noqa: E402
+from . import headroom         # noqa: E402
 from .tracing import TraceContext  # noqa: E402
+from .aggregate import FleetAggregator  # noqa: E402
+from .timeline import Timeline, load_spill  # noqa: E402
+from .slo import SLOAlert, SLOObjective, SLOTracker  # noqa: E402
+from .headroom import ScaleAdvice, ScaleAdvisor  # noqa: E402

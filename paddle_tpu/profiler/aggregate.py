@@ -43,6 +43,7 @@ CLOCK_CHANNEL = "clock"
 _m_published = _metrics.counter("fleet/snapshots_published")
 _m_ingested = _metrics.counter("fleet/snapshots_ingested")
 _m_replicas = _metrics.gauge("fleet/replicas")
+_m_stale = _metrics.counter("fleet/stale_evictions")
 
 
 def _encode(doc: dict) -> np.ndarray:
@@ -106,10 +107,19 @@ def _merge_hist_snaps(snaps: List[dict]) -> dict:
 
 
 class FleetAggregator:
-    """Keyed store of per-replica snapshots + digest-merging rollup."""
+    """Keyed store of per-replica snapshots + digest-merging rollup.
 
-    def __init__(self):
+    Snapshots are last-write-wins per (host_id, replica) and carry the
+    ingest timestamp, so a retired or renamed replica that stops
+    publishing can be EVICTED (`evict_stale`) instead of polluting
+    fleet percentiles forever with its final digest.  Pass
+    ``stale_after_s`` to evict automatically on every fleet read."""
+
+    def __init__(self, clock=time.time,
+                 stale_after_s: Optional[float] = None):
         self._snaps: Dict[Tuple[str, str], dict] = {}
+        self._clock = clock
+        self.stale_after_s = stale_after_s
 
     # -- ingestion --------------------------------------------------------
     def ingest(self, snap: dict) -> Tuple[str, str]:
@@ -117,11 +127,30 @@ class FleetAggregator:
                str(snap.get("replica") or snap.get("namespace")
                    or f"pid{snap.get('pid')}"))
         snap = dict(snap)
-        snap["ingest_ts"] = time.time()
+        snap["ingest_ts"] = self._clock()
         self._snaps[key] = snap
         _m_ingested.inc()
         _m_replicas.set(len(self._snaps))
         return key
+
+    def evict_stale(self, max_age_s: Optional[float] = None,
+                    now: Optional[float] = None) -> List[Tuple[str, str]]:
+        """Drop every snapshot not re-ingested within ``max_age_s``
+        (default: the constructor's ``stale_after_s``); returns the
+        evicted keys and counts ``fleet/stale_evictions``."""
+        max_age = max_age_s if max_age_s is not None else self.stale_after_s
+        if max_age is None:
+            return []
+        if now is None:
+            now = self._clock()
+        stale = sorted(k for k, s in self._snaps.items()
+                       if now - s.get("ingest_ts", now) > max_age)
+        for k in stale:
+            del self._snaps[k]
+        if stale:
+            _m_stale.inc(len(stale))
+            _m_replicas.set(len(self._snaps))
+        return stale
 
     def poll(self, transport, src: int,
              channel: str = METRICS_CHANNEL) -> Tuple[str, str]:
@@ -139,6 +168,8 @@ class FleetAggregator:
                    replica=None) -> Optional[float]:
         """Digest percentile for one replica, or fleet-merged when no
         identity is given."""
+        if self.stale_after_s is not None:
+            self.evict_stale()
         if host_id is not None or replica is not None:
             snap = self.replica_snapshot(host_id, replica)
             if snap is None:
@@ -160,6 +191,8 @@ class FleetAggregator:
     def fleet_snapshot(self) -> dict:
         """Everything a gateway needs in one dict: per-replica series
         plus the digest-merged fleet rollup."""
+        if self.stale_after_s is not None:
+            self.evict_stale()
         replicas = {}
         counters: Dict[str, float] = {}
         gauges: Dict[str, List[float]] = {}

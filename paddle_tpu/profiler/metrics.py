@@ -113,7 +113,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "buckets", "_counts", "_count", "_sum",
-                 "_min", "_max", "_digest", "_lock")
+                 "_min", "_max", "_digest", "_win_digest", "_lock")
     kind = "histogram"
 
     def __init__(self, name: str, buckets: Tuple[float, ...] = None):
@@ -125,6 +125,12 @@ class Histogram:
         self._min = None
         self._max = None
         self._digest = QuantileDigest()
+        # second, drainable digest over the observations since the last
+        # drain_window() — t-digests merge but do NOT subtract, so a
+        # trailing-window quantile can only be honest if each window
+        # keeps its own sketch (profiler/timeline.py drains one per
+        # sampling tick and merges window sketches on query)
+        self._win_digest = QuantileDigest()
         self._lock = threading.Lock()
 
     def observe(self, v):
@@ -143,6 +149,17 @@ class Histogram:
             if self._max is None or v > self._max:
                 self._max = v
             self._digest.observe(v)
+            self._win_digest.observe(v)
+
+    def drain_window(self) -> QuantileDigest:
+        """Hand over (and reset) the digest of observations since the
+        previous drain — single-consumer semantics: whoever samples the
+        registry owns the window boundaries.  The cumulative digest is
+        untouched."""
+        with self._lock:
+            wd = self._win_digest
+            self._win_digest = QuantileDigest()
+        return wd
 
     @property
     def count(self):
@@ -166,6 +183,7 @@ class Histogram:
             self._min = None
             self._max = None
             self._digest._reset()
+            self._win_digest._reset()
 
     def _snap(self):
         with self._lock:

@@ -268,9 +268,24 @@ class FlightRecorder:
         self._dir: Optional[str] = None
         self._seq = 0
         self._base_counters = {}
+        # named section providers: zero-arg callables evaluated at dump
+        # time whose JSON-safe return value is embedded in the doc
+        # (profiler/timeline.py attaches its last-N-windows view here,
+        # so every black box carries the minutes before the incident)
+        self._sections = {}
 
     def configure(self, directory: Optional[str]) -> None:
         self._dir = directory
+
+    def attach(self, name: str, provider) -> None:
+        """Register `provider` (zero-arg, JSON-safe return) to be
+        evaluated and embedded as ``doc[name]`` in every future dump."""
+        with self._lock:
+            self._sections[name] = provider
+
+    def detach(self, name: str) -> None:
+        with self._lock:
+            self._sections.pop(name, None)
 
     def note(self, kind: str, **payload) -> None:
         with self._lock:
@@ -318,6 +333,15 @@ class FlightRecorder:
                 "counter_deltas": self._counter_deltas(snap),
                 "metrics": snap,
             }
+            with self._lock:
+                sections = dict(self._sections)
+            for name, provider in sections.items():
+                if name in doc:
+                    continue
+                try:
+                    doc[name] = provider()
+                except Exception:
+                    doc[name] = {"error": "section provider failed"}
             if path is None:
                 os.makedirs(directory, exist_ok=True)
                 path = os.path.join(

@@ -264,7 +264,8 @@ def test_benchgate_host_recovery_row_gated_like_fleet(tmp_path):
     assert _gate(tmp_path, _host_recovery_result(), _result()) == 0
 
 
-def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022, **kw):
+def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022,
+                    attainment=1.0, resolved=1.0, **kw):
     out = _result(**kw)
     out["extra"]["gateway_storm"] = {
         "gateway_storm": {"n_interactive": 6, "n_batch": 4,
@@ -273,6 +274,8 @@ def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022, **kw):
                           "goodput_rps": goodput,
                           "interactive_ttft_p95_s": ttft,
                           "interactive_deadline_misses": 0,
+                          "interactive_slo_attainment": attainment,
+                          "burn_alerts_resolved": resolved,
                           "shed": 26, "bitwise_match": True},
     }
     return out
@@ -280,9 +283,10 @@ def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022, **kw):
 
 def test_benchgate_gateway_storm_row_gated(tmp_path):
     """gateway_storm (4x admit-site overload): zero slack on
-    interactive_completed — the brownout ladder must keep every
-    protected interactive request completing — threshold slack on
-    goodput and interactive p95 TTFT."""
+    interactive_completed and interactive_slo_attainment — the
+    brownout ladder must keep every protected interactive request
+    completing within objective — threshold slack on goodput,
+    interactive p95 TTFT, and the burn-alert resolution ratio."""
     assert _gate(tmp_path, _gateway_result(goodput=225.0, ttft=0.0225),
                  _gateway_result()) == 0
     # losing even one of six interactive requests fails, no slack
@@ -292,8 +296,22 @@ def test_benchgate_gateway_storm_row_gated(tmp_path):
                  _gateway_result()) == 1
     assert _gate(tmp_path, _gateway_result(ttft=0.030),
                  _gateway_result()) == 1
+    # SLO attainment is zero-slack: 0.999 vs 1.0 baseline fails
+    assert _gate(tmp_path, _gateway_result(attainment=0.999),
+                 _gateway_result()) == 1
+    # an alert that raised but never cleared is a regression
+    assert _gate(tmp_path, _gateway_result(resolved=0.5),
+                 _gateway_result()) == 1
     # a baseline predating the gateway row gates only the rest
     assert _gate(tmp_path, _gateway_result(), _result()) == 0
+    # a baseline predating the SLO-engine metrics gates only the rest
+    old = _gateway_result()
+    del old["extra"]["gateway_storm"]["gateway_storm"][
+        "interactive_slo_attainment"]
+    del old["extra"]["gateway_storm"]["gateway_storm"][
+        "burn_alerts_resolved"]
+    assert _gate(tmp_path, _gateway_result(attainment=0.9, resolved=0.0),
+                 old) == 0
 
 
 def _spec_result(tps=11000.0, accept=0.63, speedup=4.3, match=1.0,

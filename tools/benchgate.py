@@ -161,7 +161,15 @@ def _fleet_metrics(result):
 # under the 4x storm (gateway_storm).
 _RECOVERY_GATES = {"requests_completed": True, "recovery_s": False}
 _GATEWAY_GATES = {"interactive_completed": True, "goodput_rps": True,
-                  "interactive_ttft_p95_s": False}
+                  "interactive_ttft_p95_s": False,
+                  # SLO engine (ISSUE 16): interactive attainment is
+                  # zero-slack — the storm may not push good-fraction
+                  # below the baseline; burn_alerts_resolved (1.0 =
+                  # every raised alert cleared by run end) gates at the
+                  # normal threshold.  Old baselines without the keys
+                  # skip them (set intersection), so both phase in.
+                  "interactive_slo_attainment": True,
+                  "burn_alerts_resolved": True}
 # spec_decode: speculative decoding on the draftable shared-prompt
 # workload. bitwise_match is the exactness contract — speculative
 # streams must equal the non-speculative baseline's, so ANY drop from
@@ -186,7 +194,8 @@ _CHAOS_ROWS = (
     # weight_publish: canary-gated hot swap under live traffic
     ("fleet_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("host_recovery", _RECOVERY_GATES, ("requests_completed",)),
-    ("gateway_storm", _GATEWAY_GATES, ("interactive_completed",)),
+    ("gateway_storm", _GATEWAY_GATES,
+     ("interactive_completed", "interactive_slo_attainment")),
     ("spec_decode", _SPEC_GATES, ("bitwise_match",)),
     ("weight_publish", _PUBLISH_GATES,
      ("requests_completed", "bitwise_match")),

@@ -1,0 +1,247 @@
+"""Fleet SLO dashboard: one terminal (or JSON) view of the serving
+fleet's health — snapshot, per-tenant SLO attainment, active burn
+alerts, capacity advice, and timeline sparklines.
+
+Inputs (all optional; the board renders what it is given):
+  --spill DIR    timeline spill directory (windows.jsonl + MANIFEST.json
+                 written by profiler.timeline.Timeline) — manifest-gated
+                 replay, torn tails ignored
+  --slo FILE     SLOTracker.report() JSON (attainment + burn + alerts)
+  --fleet FILE   FleetAggregator.fleet_snapshot() JSON
+  --advice FILE  ScaleAdvisor recommend().to_dict() JSON
+  --metric NAME  extra sparkline rows (repeatable; gauges plot the
+                 sampled value, counters plot the per-window rate)
+  --json         emit the merged machine-readable document instead
+
+Deliberately importable without jax: the quantile sketch is loaded
+straight from profiler/digest.py (dependency-free by design) so the
+board runs on an ops box with no accelerator stack installed.
+
+Usage:
+  python tools/fleetboard.py --spill /var/pt/timeline --slo slo.json
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_digest_module():
+    """profiler/digest.py without importing the jax-backed package."""
+    path = os.path.join(_REPO, "paddle_tpu", "profiler", "digest.py")
+    spec = importlib.util.spec_from_file_location("_pt_digest", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- spill replay (mirrors profiler.timeline.load_spill, jax-free) -----
+
+def load_spill(path: str) -> List[dict]:
+    """The complete prefix of windows the manifest published; [] for a
+    spill with no manifest, torn tail lines ignored."""
+    try:
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return []
+    published = int(man.get("windows", 0))
+    out: List[dict] = []
+    try:
+        f = open(os.path.join(path, "windows.jsonl"))
+    except OSError:
+        return []
+    with f:
+        for line in f:
+            if len(out) >= published:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                break
+    return out
+
+
+# -- rendering ---------------------------------------------------------
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[Optional[float]], width: int = 48) -> str:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return "(no data)"
+    if len(values) > width:
+        values = values[-width:]
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(SPARK[0])
+        else:
+            idx = int((v - lo) / span * (len(SPARK) - 1))
+            out.append(SPARK[idx])
+    return "".join(out) + f"  [{lo:.3g} .. {hi:.3g}]"
+
+
+def _series(windows: List[dict], name: str) -> List[Optional[float]]:
+    """Gauge series, falling back to the counter's per-window rate."""
+    if any(name in w.get("gauges", {}) for w in windows):
+        return [w.get("gauges", {}).get(name) for w in windows]
+    out: List[Optional[float]] = []
+    for a, b in zip(windows, windows[1:]):
+        dt = b["t"] - a["t"]
+        if dt <= 0:
+            out.append(None)
+            continue
+        out.append((b.get("counters", {}).get(name, 0)
+                    - a.get("counters", {}).get(name, 0)) / dt)
+    return out
+
+
+def _window_p95(windows: List[dict], name: str, digest_mod) -> List[
+        Optional[float]]:
+    out: List[Optional[float]] = []
+    for w in windows:
+        d = w.get("digests", {}).get(name)
+        if not d:
+            out.append(None)
+        elif "p95" in d:                    # recent()-style summary
+            out.append(d["p95"])
+        else:
+            out.append(digest_mod.QuantileDigest.from_dict(d)
+                       .quantile(0.95))
+    return out
+
+
+DEFAULT_METRICS = ("gateway/load_score", "gateway/brownout_level",
+                   "gateway/outcome/completed")
+
+
+def render(windows: List[dict], slo: Optional[dict] = None,
+           fleet: Optional[dict] = None, advice: Optional[dict] = None,
+           metrics: Tuple[str, ...] = ()) -> str:
+    digest_mod = _load_digest_module()
+    lines: List[str] = ["== fleetboard =="]
+
+    if fleet:
+        lines.append(f"fleet: {fleet.get('n_replicas', '?')} replicas")
+        for key, rep in sorted(fleet.get("replicas", {}).items()):
+            gauges = rep.get("gauges", {})
+            load = gauges.get("gateway/load_score") \
+                or gauges.get("serving/load_score")
+            lines.append(f"  {key:<28} load="
+                         f"{load if load is not None else '-'}")
+
+    if windows:
+        span = windows[-1]["t"] - windows[0]["t"]
+        lines.append(f"timeline: {len(windows)} windows over "
+                     f"{span:.1f}s (seq {windows[0]['seq']}.."
+                     f"{windows[-1]['seq']})")
+        names = list(DEFAULT_METRICS) + [m for m in metrics
+                                         if m not in DEFAULT_METRICS]
+        for name in names:
+            vals = _series(windows, name)
+            if any(v is not None for v in vals):
+                lines.append(f"  {name:<32} {sparkline(vals)}")
+        hist_names = sorted({n for w in windows
+                             for n in w.get("digests", {})})
+        for name in hist_names:
+            vals = _window_p95(windows, name, digest_mod)
+            if any(v is not None for v in vals):
+                lines.append(f"  {name + ' p95':<32} {sparkline(vals)}")
+        evs = [ev for w in windows for ev in w.get("events", ())]
+        if evs:
+            lines.append(f"  events: {len(evs)} "
+                         f"(last: {evs[-1].get('kind')})")
+
+    if slo:
+        lines.append("slo attainment (tenant/class  att  target  "
+                     "fast-burn  alert):")
+        for key, row in sorted(slo.get("per_tenant", {}).items()):
+            att = row.get("attainment")
+            lines.append(
+                f"  {key:<28} "
+                f"{att if att is not None else '-':<8} "
+                f"{row.get('target', '-'):<8} "
+                f"{row.get('fast_burn', '-'):<10} "
+                f"{'ACTIVE' if row.get('alert_active') else '-'}")
+        al = slo.get("alerts", {})
+        lines.append(f"alerts: raised={al.get('raised', 0)} "
+                     f"active={al.get('active', 0)} "
+                     f"cleared={al.get('cleared', 0)}")
+        for a in al.get("log", ()):
+            state = "ACTIVE" if a.get("active") else "cleared"
+            lines.append(f"  [{state}] {a.get('tenant')}/"
+                         f"{a.get('slo_class')} fast_burn="
+                         f"{a.get('fast_burn')} raised_t="
+                         f"{a.get('raised_t')}")
+
+    if advice:
+        lines.append(f"advice: {advice.get('action', '?').upper()} — "
+                     f"{advice.get('reason', '')}")
+        lines.append(f"  load={advice.get('current_load')} "
+                     f"headroom={advice.get('headroom')} "
+                     f"knee={advice.get('saturation_load')}")
+        if advice.get("drain_candidates"):
+            lines.append("  drain: "
+                         + ", ".join(advice["drain_candidates"]))
+
+    if len(lines) == 1:
+        lines.append("(no inputs — pass --spill/--slo/--fleet/--advice)")
+    return "\n".join(lines)
+
+
+def _read_json(path: Optional[str]) -> Optional[dict]:
+    if not path:
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--spill", help="timeline spill directory")
+    ap.add_argument("--slo", help="SLOTracker.report() JSON file")
+    ap.add_argument("--fleet", help="fleet_snapshot() JSON file")
+    ap.add_argument("--advice", help="ScaleAdvice JSON file")
+    ap.add_argument("--metric", action="append", default=[],
+                    help="extra sparkline metric (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged document as JSON")
+    ap.add_argument("-o", "--output", help="write to file instead")
+    args = ap.parse_args(argv)
+
+    windows = load_spill(args.spill) if args.spill else []
+    slo = _read_json(args.slo)
+    fleet = _read_json(args.fleet)
+    advice = _read_json(args.advice)
+
+    if args.json:
+        text = json.dumps({"windows": windows, "slo": slo,
+                           "fleet": fleet, "advice": advice}, indent=2)
+    else:
+        text = render(windows, slo, fleet, advice,
+                      metrics=tuple(args.metric))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text + "\n")
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

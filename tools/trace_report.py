@@ -140,7 +140,12 @@ KNOWN_METRICS = (
     "trace/*",
     # fleet metrics aggregation plane (profiler/aggregate.py):
     # snapshot shipping, replica census, clock-offset estimation
-    "fleet/*",
+    "fleet/*", "fleet/stale_evictions",
+    # SLO engine (profiler/timeline.py, slo.py, headroom.py): sampling
+    # ring + spill, outcome accounting, burn alerts, scale advisories
+    "timeline/*", "slo/*",
+    # reason-coded gateway terminal outcomes (inference/gateway.py)
+    "gateway/outcome/*",
 )
 
 
