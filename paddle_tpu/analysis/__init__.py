@@ -22,6 +22,18 @@ structurally cannot catch:
   from ``tools/trace_report.py``'s ``KNOWN_METRICS``.
 - **PT5xx error surfacing** — swallowed exceptions in distributed/.
 
+**ptrace** (source level, jax-free, ``--conc`` / ``tools/ptrace.py``):
+the concurrency families over the class threading model built in
+``paddle_tpu.analysis.concurrency``:
+
+- **PT7xx race detection** — lock-consistency (RacerD-style inferred
+  guard maps) for attributes shared with service threads, lock-order
+  deadlock cycles, thread join discipline, Condition usage.
+- **PT8xx fleet-protocol invariants** — manifest-last persistence,
+  hand-off payload identity (salt/version/trace), generation-fenced
+  store writes, atomic metrics updates (scoped to distributed/,
+  inference/, profiler/).
+
 **ptprog** (IR level, ``paddle_tpu.analysis.program``): the PT6xx
 passes over a *recorded* ``static.Program`` op list — shape/dtype
 dataflow via ``jax.eval_shape`` (the infermeta analog), liveness-based

@@ -1,0 +1,21 @@
+"""ptrace — concurrency + fleet-protocol static analysis (PT7xx/PT8xx).
+
+Two rule families on top of the ptlint engine:
+
+- **PT7xx lock-consistency races** (``race_rules``): infers each
+  class's *guard map* — which attributes are written under which
+  ``with self._lock:`` scope — then flags accesses that skip the
+  guard, lock-order cycles, never-joined service threads, and
+  condition ops outside the condition's lock.  The model
+  (``threadmodel``) is RacerD-shaped: lock *consistency* proven from
+  source, no happens-before runtime needed.
+- **PT8xx fleet-protocol invariants** (``protocol_rules``): the
+  hand-maintained conventions the fleet tier's correctness rests on —
+  manifest-last persistence, hand-off payload identity keys
+  (salt/trace/weight-version), generation-fenced store writes, atomic
+  metrics updates from threads.
+
+Run with ``python -m paddle_tpu.analysis --conc`` or the jax-free
+``tools/ptrace.py``; both share the ptlint baseline/SARIF/CI
+machinery.
+"""

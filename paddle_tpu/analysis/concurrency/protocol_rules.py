@@ -1,0 +1,277 @@
+"""PT8xx — fleet-protocol invariant checks (distributed/, inference/,
+profiler/).
+
+These encode the hand-maintained conventions the fleet tier's
+correctness rests on — each one retrofitted by hand at least once
+before it became a rule:
+
+- PT801  manifest-last discipline: payload files must be durable
+  BEFORE ``publish_manifest`` republishes the completeness marker; a
+  write after the publish re-opens the torn-state window recovery.py
+  closed.
+- PT802  hand-off payload completeness: a request/weight-set dict that
+  crosses a process boundary must carry its identity — ``salt_rid`` /
+  ``salt_seed`` (bitwise replay), a weight-version pin, and a trace
+  context (``tracing.inject`` or a ``trace`` key).  PRs 10/11/15 each
+  had to retrofit one of these.
+- PT803  ``fenced_set`` without a generation derived from the
+  supervisor epoch: a literal (or missing) ``gen`` defeats the fence —
+  a zombie from generation N-1 could still win the write.
+- PT804  read-modify-write on a metrics instrument
+  (``g.set(g.value + d)``) from thread-reachable code: ``.set`` is
+  last-write-wins, so concurrent increments are lost; ``.inc(d)`` is
+  the atomic form.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from ..engine import call_name, dotted_name, rule
+from .threadmodel import class_models, module_thread_reachable
+
+_SCOPED_DIRS = ("distributed/", "inference/", "profiler/")
+
+
+def _in_scope(mod) -> bool:
+    path = mod.relpath.replace("\\", "/")
+    return any(d in path for d in _SCOPED_DIRS)
+
+
+def _body_walk(fn):
+    """Walk a function body without descending into nested defs —
+    the enclosing function's own control flow only."""
+    stack = list(fn.body)
+    while stack:
+        n = stack.pop(0)
+        yield n
+        for c in ast.iter_child_nodes(n):
+            if not isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                stack.append(c)
+
+
+def _functions(mod):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# PT801 — manifest-last discipline
+# ---------------------------------------------------------------------------
+
+_MANIFEST_CALLS = {"publish_manifest", "write_manifest"}
+_WRITE_CALLS = {"savez", "savez_compressed", "tofile",
+                "copyfile", "copy2", "copytree"}
+
+
+def _is_payload_write(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name == "open":
+        mode: Optional[str] = None
+        if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+                and isinstance(node.args[1].value, str):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                mode = kw.value.value
+        return mode is not None and any(c in mode for c in "wax")
+    if name in _WRITE_CALLS:
+        return True
+    if name == "save":
+        dn = dotted_name(node.func)
+        return dn in ("np.save", "numpy.save")
+    return False
+
+
+@rule("PT801", "error",
+      "payload file written AFTER the manifest publish (manifest-last "
+      "discipline violated)")
+def check_manifest_last(mod):
+    if not _in_scope(mod):
+        return
+    for fn in _functions(mod):
+        manifests = [n for n in _body_walk(fn)
+                     if isinstance(n, ast.Call) and
+                     call_name(n) in _MANIFEST_CALLS]
+        if not manifests:
+            continue
+        for n in _body_walk(fn):
+            if not (isinstance(n, ast.Call) and _is_payload_write(n)):
+                continue
+            prior = [m for m in manifests if m.lineno < n.lineno]
+            if not prior:
+                continue
+            m = prior[-1]
+            yield (n.lineno, n.col_offset,
+                   f"payload write after the manifest publish (line "
+                   f"{m.lineno}) in '{fn.name}()' — a crash between "
+                   f"them leaves a manifest that claims data that "
+                   f"isn't durable; write payloads first, publish the "
+                   f"manifest last",
+                   ((mod.relpath, m.lineno,
+                     f"manifest published here in '{fn.name}()'"),))
+
+
+# ---------------------------------------------------------------------------
+# PT802 — hand-off payload completeness
+# ---------------------------------------------------------------------------
+
+_HANDOFF_FN_RE = re.compile(
+    r"migrate|requeue|hand_?off|receive|publish|send", re.IGNORECASE)
+_TRANSPORT_CALLS = {"send", "sendall", "dumps"}
+
+
+def _str_keys(d: ast.Dict):
+    keys = set()
+    for k in d.keys:
+        if k is None:
+            return None          # **spread: completeness unknowable
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+    return keys
+
+
+@rule("PT802", "error",
+      "cross-process hand-off payload is missing required identity keys")
+def check_handoff_payload(mod):
+    if not _in_scope(mod):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = _str_keys(node)
+        if keys is None:
+            continue
+        fn = mod.enclosing_function(node)
+        fname = fn.name if fn is not None else ""
+        handoffy = bool(_HANDOFF_FN_RE.search(fname)) or (
+            fn is not None and any(
+                isinstance(n, ast.Call) and
+                call_name(n) in _TRANSPORT_CALLS
+                for n in ast.walk(fn)))
+        if not handoffy:
+            continue
+        missing = []
+        if "prompt" in keys and keys & {"sampling", "generated",
+                                        "max_new"}:
+            # request hand-off dict (migration / drain-requeue)
+            for req in ("salt_rid", "salt_seed"):
+                if req not in keys:
+                    missing.append(req)
+            if not any("version" in k for k in keys):
+                missing.append("weight_version (pin)")
+            has_inject = fn is not None and any(
+                isinstance(n, ast.Call) and call_name(n) == "inject"
+                for n in ast.walk(fn))
+            if "trace" not in keys and not has_inject:
+                missing.append("trace (tracing.inject)")
+            kind = "request hand-off"
+        elif "dtypes" in keys and "shapes" in keys:
+            # weight-set meta (live weight publishing)
+            missing = [k for k in ("version", "crcs") if k not in keys]
+            kind = "weight-set meta"
+        else:
+            continue
+        if missing:
+            yield (node.lineno, node.col_offset,
+                   f"{kind} payload in '{fname}()' is missing "
+                   f"{', '.join(missing)} — the receiving side can't "
+                   f"reproduce identity (salted sampling / weight "
+                   f"pin / trace join) without them")
+
+
+# ---------------------------------------------------------------------------
+# PT803 — generation-fenced store writes
+# ---------------------------------------------------------------------------
+
+@rule("PT803", "error",
+      "fenced_set without a generation derived from the supervisor epoch")
+def check_fenced_generation(mod):
+    if not _in_scope(mod):
+        return
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and
+                call_name(node) == "fenced_set"):
+            continue
+        fn = mod.enclosing_function(node)
+        if fn is not None and fn.name == "fenced_set":
+            continue             # the definition/forwarder itself
+        gen = node.args[3] if len(node.args) >= 4 else None
+        for kw in node.keywords:
+            if kw.arg == "gen":
+                gen = kw.value
+        if gen is None:
+            yield (node.lineno, node.col_offset,
+                   "fenced_set called without a generation argument — "
+                   "the write bypasses the fence entirely")
+        elif isinstance(gen, ast.Constant) and \
+                isinstance(gen.value, (int, float)) and \
+                not isinstance(gen.value, bool):
+            yield (node.lineno, node.col_offset,
+                   f"fenced_set generation is the literal "
+                   f"{gen.value!r} — derive it from the supervisor "
+                   f"epoch (generation()/reserve gen) or a zombie "
+                   f"from an older generation can still win the write")
+
+
+# ---------------------------------------------------------------------------
+# PT804 — atomic metrics updates from threads
+# ---------------------------------------------------------------------------
+
+def _rmw_set_sites(fn_node):
+    for node in _body_walk(fn_node):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "set"):
+            continue
+        recv = dotted_name(node.func.value)
+        if recv is None:
+            continue
+        for arg in node.args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr == "value" and \
+                        dotted_name(sub.value) == recv:
+                    yield node, recv
+                    break
+
+
+@rule("PT804", "warning",
+      "non-atomic read-modify-write on a metrics instrument from "
+      "thread-reachable code")
+def check_atomic_metrics(mod):
+    if not _in_scope(mod):
+        return
+    emitted = set()
+
+    def emit(node, recv, where):
+        if id(node) in emitted:
+            return None
+        emitted.add(id(node))
+        return (node.lineno, node.col_offset,
+                f"'{recv}.set({recv}.value + ...)' in {where} is "
+                f"last-write-wins: concurrent updates are lost — use "
+                f"the atomic '{recv}.inc(delta)' instead")
+
+    for cm in class_models(mod):
+        for mname in sorted(cm.thread_reachable):
+            mm = cm.methods.get(mname)
+            if mm is None:
+                continue
+            for node, recv in _rmw_set_sites(mm.node):
+                out = emit(node, recv,
+                           f"thread-reachable '{cm.name}.{mname}()'")
+                if out:
+                    yield out
+    for fname in sorted(module_thread_reachable(mod)):
+        fn = mod.functions.get(fname)
+        if fn is None:
+            continue
+        for node, recv in _rmw_set_sites(fn):
+            out = emit(node, recv, f"thread-target '{fname}()'")
+            if out:
+                yield out

@@ -1,0 +1,177 @@
+"""PT7xx — lock-consistency race detection over the class threading
+model (threadmodel.py).
+
+The guard map is the inferred synchronization discipline: if a class
+writes ``self._msgs`` under ``with self._cond:`` in one method, every
+other read/write of ``_msgs`` is held to that discipline.  This is the
+RacerD framing — prove lock *consistency* from source, don't wait for
+a happens-before violation at runtime; PR 5's "dup-frame counter race"
+(``_seen_fseq`` mutated from recv threads without ``_seen_lock``) is
+exactly the shape PT701 flags.
+
+- PT701  guarded attribute accessed without its guard
+- PT702  lock-order cycle across methods (potential deadlock)
+- PT703  service thread started but never joined from close()/stop()
+- PT704  Condition notify/wait outside the condition's lock
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..engine import rule
+from .threadmodel import (_CONSTRUCTION, _LIFECYCLE_STEMS, _STARTER_STEMS,
+                          class_models)
+
+
+@rule("PT701", "error",
+      "attribute accessed without the lock that guards its writes")
+def check_lock_consistency(mod):
+    for cm in class_models(mod):
+        for attr, guards in sorted(cm.guard_map.items()):
+            accs = list(cm.accesses(attr))
+            threaded = bool(cm.entries)
+            shared = any(a.method in cm.thread_reachable for a in accs)
+            if threaded and not shared:
+                # visible threads never touch this attr: the guard is
+                # protecting against something we can't see — leave it
+                # to the consistency tier below only when lock-only
+                continue
+            # double-checked-locking allowance: a method that also
+            # takes the guard for this attr re-validates its unguarded
+            # read under the lock (MetricsRegistry._get pattern)
+            guarded_methods = {
+                a.method for a in accs
+                if cm.effective_held(a, a.method) & guards}
+            site = cm.guard_sites[attr]
+            guard_name = "/".join(f"self.{g}" for g in sorted(guards))
+            for a in accs:
+                if a.method.split(".")[0] in _CONSTRUCTION:
+                    continue
+                if cm.effective_held(a, a.method) & guards:
+                    continue
+                if a.method in guarded_methods:
+                    continue
+                via = ""
+                if a.method in cm.thread_reachable and cm.entries:
+                    ent = sorted(cm.entries)[0]
+                    via = (f"; '{a.method}()' is reachable from thread "
+                           f"entry '{ent}()'")
+                verb = "written" if a.write else "read"
+                yield (a.line, a.col,
+                       f"'{cm.name}.{attr}' is written under "
+                       f"{guard_name} ('{site.method}()' line "
+                       f"{site.line}) but {verb} here without it{via}",
+                       ((mod.relpath, site.line,
+                         f"guarded write of '{attr}' in "
+                         f"'{site.method}()'"),))
+
+
+def _find_cycles(edges: Dict[str, Dict[str, Tuple[int, int, str]]]
+                 ) -> List[List[str]]:
+    """Elementary cycles (length <= 4) in the acquisition graph,
+    deduplicated by lock set."""
+    seen = set()
+    out: List[List[str]] = []
+    for start in sorted(edges):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(path)
+                elif nxt not in path and len(path) < 4:
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+@rule("PT702", "warning",
+      "lock-order cycle across methods (potential deadlock)")
+def check_lock_order(mod):
+    for cm in class_models(mod):
+        edges: Dict[str, Dict[str, Tuple[int, int, str]]] = {}
+        for mname, mm in cm.methods.items():
+            for lock, held, line, col in mm.acquisitions:
+                for h in cm.effective_held(held, mname):
+                    if h == lock:
+                        continue
+                    # a Condition and the lock it wraps are one lock
+                    if cm.cond_wraps.get(h) == lock or \
+                            cm.cond_wraps.get(lock) == h:
+                        continue
+                    edges.setdefault(h, {}).setdefault(
+                        lock, (line, col, mname))
+        for cycle in _find_cycles(edges):
+            sites = []
+            for i, lk in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                line, col, mname = edges[lk][nxt]
+                sites.append((lk, nxt, line, col, mname))
+            order = " -> ".join(cycle + [cycle[0]])
+            related = tuple(
+                (mod.relpath, s[2],
+                 f"acquires 'self.{s[1]}' while holding 'self.{s[0]}' "
+                 f"in '{s[4]}()'") for s in sites)
+            yield (sites[0][2], sites[0][3],
+                   f"lock-order cycle in class '{cm.name}': {order} — "
+                   f"two threads taking these locks in different "
+                   f"orders deadlock", related)
+
+
+@rule("PT703", "warning",
+      "service thread started but never joined from a lifecycle method")
+def check_thread_join(mod):
+    for cm in class_models(mod):
+        stored: Dict[str, Tuple[int, int]] = {}
+        for mm in cm.methods.values():
+            for attr, lc in mm.thread_attrs.items():
+                stored.setdefault(attr, lc)
+        if not stored:
+            continue
+        lifecycle = cm.lifecycle_methods()
+        joined = set()
+        for m in lifecycle:
+            joined |= cm.methods[m].join_attrs
+        has_lifecycle = any(
+            m.split(".")[0].startswith(_LIFECYCLE_STEMS)
+            for m in cm.methods)
+        start_sites: Dict[str, str] = {}
+        for mname, mm in cm.methods.items():
+            for attr in mm.started_attrs:
+                start_sites.setdefault(attr, mname)
+        for attr, smethod in sorted(start_sites.items()):
+            if attr not in stored:
+                continue          # fire-and-forget local, not stored
+            if not smethod.split(".")[0].startswith(_STARTER_STEMS):
+                continue
+            if attr in joined:
+                continue
+            line, col = stored[attr]
+            hint = ("no close()/stop()/abort() method exists to join "
+                    "it from" if not has_lifecycle else
+                    "no join() (or delegated stop()/close()) on it is "
+                    "reachable from close()/stop()/abort()")
+            yield (line, col,
+                   f"thread '{cm.name}.{attr}' is started in "
+                   f"'{smethod}()' but {hint} — the thread outlives "
+                   f"the object and shutdown is nondeterministic")
+
+
+@rule("PT704", "error",
+      "Condition notify/wait outside the condition's lock")
+def check_condition_discipline(mod):
+    for cm in class_models(mod):
+        for mname, mm in cm.methods.items():
+            for cond, op, held, line, col in mm.cond_ops:
+                eff = cm.effective_held(held, mname)
+                if cond in eff:
+                    continue
+                wrapped = cm.cond_wraps.get(cond)
+                if wrapped and wrapped in eff:
+                    continue
+                yield (line, col,
+                       f"'self.{cond}.{op}()' called without holding "
+                       f"'with self.{cond}:' — raises RuntimeError at "
+                       f"runtime and loses wakeups")

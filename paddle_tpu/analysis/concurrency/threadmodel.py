@@ -1,0 +1,551 @@
+"""Class-level threading model shared by the PT7xx/PT8xx rules.
+
+For every class in a module this builds a ``ClassModel``:
+
+- **lock inventory** — attributes assigned ``threading.Lock()`` /
+  ``RLock()`` / ``Condition()`` / ``Semaphore()`` (instance or class
+  level), dict-of-locks attrs (``self._x[k] = Lock()`` /
+  ``setdefault(k, Lock())``), and which lock a Condition wraps;
+- **per-access held-lock sets** — every ``self.<attr>`` read/write in
+  every method, annotated with the set of locks lexically held
+  (``with self._lock:`` scopes, multi-item ``with`` included);
+- **intra-class lock propagation** — a private helper whose in-class
+  call sites ALL hold lock L is analyzed as running under L (the
+  "called with self.cond held" docstring convention, made checkable);
+- **thread entry points** — ``run()`` of ``threading.Thread``
+  subclasses and any method passed as ``Thread(target=self.m)`` or a
+  nested ``def`` passed as a target (tracked as pseudo-method
+  ``outer.inner``), plus transitive reachability over ``self.m()``
+  calls;
+- **guard map** — attr -> the locks under which it is written outside
+  ``__init__``: the inferred synchronization discipline the PT701
+  checker holds every other access to;
+- **acquisition graph** — lock -> lock edges for nested acquisitions
+  (PT702 deadlock cycles), thread store/start/join events (PT703),
+  and condition notify/wait sites (PT704).
+
+Everything is module-local and stdlib-``ast`` only, matching the rest
+of the ptlint engine: the analyzer never imports the code it checks.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..engine import call_name, dotted_name
+
+__all__ = ["Access", "MethodModel", "ClassModel", "class_models",
+           "module_thread_reachable"]
+
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore"}
+_COND_CTORS = {"Condition"}
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+}
+# mutators whose return value is routinely used; the rest only count
+# as writes in statement position (`rows = self.rule.update(...)` is an
+# optimizer step, not a dict mutation)
+_VALUE_MUTATORS = {"pop", "popleft", "popitem", "setdefault"}
+_COND_OPS = {"notify", "notify_all", "wait", "wait_for"}
+# join evidence: a literal join(), or delegating shutdown to the thread
+# object itself (TCPStore.close() -> self._server.stop() which joins)
+_JOINERS = {"join", "stop", "close", "shutdown", "disable", "terminate"}
+# methods where unguarded writes are construction, not sharing
+_CONSTRUCTION = {"__init__", "__new__", "__post_init__"}
+# lifecycle roots from which a service thread's join() must be reachable
+_LIFECYCLE_STEMS = ("close", "stop", "shutdown", "abort", "disable",
+                    "drain", "terminate", "join", "__exit__", "__del__")
+# methods whose start() of a stored thread demands join-on-close
+_STARTER_STEMS = ("__init__", "start", "open", "enable", "run_forever")
+
+
+class Access:
+    """One ``self.<attr>`` read or write with its held-lock set."""
+
+    __slots__ = ("attr", "write", "method", "line", "col", "held")
+
+    def __init__(self, attr: str, write: bool, method: str,
+                 line: int, col: int, held: FrozenSet[str]):
+        self.attr = attr
+        self.write = write
+        self.method = method
+        self.line = line
+        self.col = col
+        self.held = held
+
+
+class MethodModel:
+    """Per-method event log the class-level passes aggregate."""
+
+    def __init__(self, name: str, node):
+        self.name = name
+        self.node = node
+        self.accesses: List[Access] = []
+        # (callee, held, line, col) for self.callee(...) calls
+        self.calls: List[Tuple[str, FrozenSet[str], int, int]] = []
+        # (lock, held_before, line, col) for each with-acquisition
+        self.acquisitions: List[Tuple[str, FrozenSet[str], int, int]] = []
+        # (cond_attr, op, held, line, col)
+        self.cond_ops: List[Tuple[str, str, FrozenSet[str], int, int]] = []
+        # thread lifecycle facts
+        self.thread_attrs: Dict[str, Tuple[int, int]] = {}  # stored+line
+        self.started_attrs: Set[str] = set()
+        self.join_attrs: Set[str] = set()
+        # nested defs passed as Thread targets resolve to pseudo-methods
+        self.local_targets: Set[str] = set()
+
+
+class ClassModel:
+    def __init__(self, name: str, node: ast.ClassDef):
+        self.name = name
+        self.node = node
+        self.lock_attrs: Set[str] = set()
+        self.cond_attrs: Set[str] = set()
+        self.lockdict_attrs: Set[str] = set()
+        self.cond_wraps: Dict[str, str] = {}
+        self.method_names: Set[str] = set()
+        self.methods: Dict[str, MethodModel] = {}
+        self.is_thread_subclass = False
+        self.entries: Set[str] = set()
+        self.thread_reachable: Set[str] = set()
+        self.ctx_locks: Dict[str, FrozenSet[str]] = {}
+        # attr -> guard locks / representative guarded write
+        self.guard_map: Dict[str, FrozenSet[str]] = {}
+        self.guard_sites: Dict[str, Access] = {}
+
+    # -- derived views -----------------------------------------------------
+    def effective_held(self, acc_or_held, method: str) -> FrozenSet[str]:
+        held = acc_or_held.held if isinstance(acc_or_held, Access) \
+            else acc_or_held
+        return held | self.ctx_locks.get(method, frozenset())
+
+    def accesses(self, attr: Optional[str] = None):
+        for mm in self.methods.values():
+            for a in mm.accesses:
+                if attr is None or a.attr == attr:
+                    yield a
+
+    def lifecycle_methods(self) -> Set[str]:
+        roots = {m for m in self.methods
+                 if m.split(".")[0].startswith(_LIFECYCLE_STEMS)}
+        return self._closure(roots)
+
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen = set(roots)
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            mm = self.methods.get(m)
+            if mm is None:
+                continue
+            for callee, _, _, _ in mm.calls:
+                if callee in self.methods and callee not in seen:
+                    seen.add(callee)
+                    frontier.append(callee)
+            # a nested def belongs to its container's flow
+            for sub in self.methods:
+                if sub.startswith(m + ".") and sub not in seen:
+                    seen.add(sub)
+                    frontier.append(sub)
+        return seen
+
+
+def _is_lock_ctor(node) -> Optional[str]:
+    """'lock' / 'cond' when `node` constructs a threading primitive."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name in _COND_CTORS:
+        return "cond"
+    if name in _LOCK_CTORS:
+        dn = dotted_name(node.func)
+        if dn is None or dn == name or "." in dn:
+            return "lock"
+    return None
+
+
+def _is_thread_ctor(node) -> bool:
+    if not isinstance(node, ast.Call) or call_name(node) != "Thread":
+        return False
+    dn = dotted_name(node.func)
+    return dn in ("Thread", "threading.Thread") or \
+        (dn is not None and dn.endswith(".Thread"))
+
+
+def _thread_target(node: ast.Call):
+    for kw in node.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """attr name for a `self.<attr>` Attribute node."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _MethodWalker:
+    """Recursive walker threading the lexically-held lock set."""
+
+    def __init__(self, cm: ClassModel, mm: MethodModel,
+                 register, thread_classes: Set[str]):
+        self.cm = cm
+        self.mm = mm
+        self.register = register          # registers pseudo-methods
+        self.thread_classes = thread_classes
+        self.local_threads: Set[str] = set()
+        self.var_attr_alias: Dict[str, str] = {}   # v = self.T / loop var
+        self.nested: Dict[str, str] = {}  # local def name -> pseudo name
+
+    def _is_thread(self, node) -> bool:
+        """threading.Thread(...) or a module-local Thread subclass."""
+        if _is_thread_ctor(node):
+            return True
+        return isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Name) and \
+            node.func.id in self.thread_classes
+
+    # -- lock-token matching ----------------------------------------------
+    def _lock_tokens(self, expr) -> Optional[Tuple[str, FrozenSet[str]]]:
+        """(primary_token, all_tokens) acquired by `with expr:`."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if attr in self.cm.cond_attrs:
+                toks = {attr}
+                wrapped = self.cm.cond_wraps.get(attr)
+                if wrapped:
+                    toks.add(wrapped)
+                return attr, frozenset(toks)
+            if attr in self.cm.lock_attrs:
+                return attr, frozenset({attr})
+        if isinstance(expr, ast.Subscript):
+            attr = _self_attr(expr.value)
+            if attr is not None and attr in self.cm.lockdict_attrs:
+                tok = attr + "[]"
+                return tok, frozenset({tok})
+        return None
+
+    # -- the walk ----------------------------------------------------------
+    def walk(self, node, held: FrozenSet[str]):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            added: Set[str] = set()
+            for item in node.items:
+                toks = self._lock_tokens(item.context_expr)
+                if toks is not None:
+                    primary, all_toks = toks
+                    self.mm.acquisitions.append(
+                        (primary, held | frozenset(added),
+                         item.context_expr.lineno,
+                         item.context_expr.col_offset))
+                    added |= all_toks
+                else:
+                    self.walk(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self.walk(item.optional_vars, held)
+            for stmt in node.body:
+                self.walk(stmt, held | frozenset(added))
+            return
+
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, with no lock held at entry
+            pseudo = f"{self.mm.name}.{node.name}"
+            self.nested[node.name] = pseudo
+            self.register(pseudo, node)
+            return
+
+        if isinstance(node, ast.For):
+            # `for t in self._threads:` aliases t -> _threads for join()
+            it_attr = next((a for a in ast.walk(node.iter)
+                            if _self_attr(a) is not None), None)
+            if it_attr is not None and isinstance(node.target, ast.Name):
+                self.var_attr_alias[node.target.id] = _self_attr(it_attr)
+
+        if isinstance(node, ast.Assign):
+            self._handle_assign(node, held)
+        elif isinstance(node, ast.Call):
+            self._handle_call(node, held)
+        elif isinstance(node, ast.Attribute):
+            self._handle_attribute(node, held)
+
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+    # -- node handlers -----------------------------------------------------
+    def _handle_assign(self, node: ast.Assign, held):
+        is_thread = self._is_thread(node.value)
+        src_name = node.value.id if isinstance(node.value, ast.Name) \
+            else None
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is not None:
+                if is_thread or (src_name in self.local_threads):
+                    self.mm.thread_attrs[attr] = (node.lineno,
+                                                  node.col_offset)
+                    if src_name in self.local_threads:
+                        self.var_attr_alias[src_name] = attr
+                        if src_name in self.mm.started_attrs:
+                            self.mm.started_attrs.add(attr)
+                continue
+            if isinstance(tgt, ast.Name):
+                if is_thread:
+                    self.local_threads.add(tgt.id)
+                src_attr = _self_attr(node.value)
+                if src_attr is not None:
+                    self.var_attr_alias[tgt.id] = src_attr
+
+    def _handle_call(self, node: ast.Call, held):
+        fn = node.func
+        name = call_name(node)
+
+        if self._is_thread(node):
+            target = _thread_target(node)
+            t_attr = _self_attr(target) if target is not None else None
+            if t_attr is not None:
+                self.cm.entries.add(t_attr)
+            elif isinstance(target, ast.Name):
+                if target.id in self.nested:
+                    self.cm.entries.add(self.nested[target.id])
+                else:
+                    self.mm.local_targets.add(target.id)
+            return
+
+        if isinstance(fn, ast.Attribute):
+            # self.helper(...) — intra-class call (the callee is the
+            # attribute of `fn` itself, not of its receiver)
+            callee = _self_attr(fn)
+            if callee is not None and callee in self.cm.method_names:
+                self.mm.calls.append((callee, held, node.lineno,
+                                      node.col_offset))
+            recv = fn.value
+            recv_attr = _self_attr(recv)
+            # condition ops
+            if name in _COND_OPS and recv_attr in self.cm.cond_attrs:
+                self.mm.cond_ops.append(
+                    (recv_attr, name, held, node.lineno, node.col_offset))
+            # thread start/join bookkeeping
+            if name == "start":
+                if recv_attr is not None:
+                    self.mm.started_attrs.add(recv_attr)
+                elif isinstance(recv, ast.Name):
+                    if recv.id in self.local_threads:
+                        self.mm.started_attrs.add(
+                            self.var_attr_alias.get(recv.id, recv.id))
+            elif name in _JOINERS:
+                if recv_attr is not None:
+                    self.mm.join_attrs.add(recv_attr)
+                elif isinstance(recv, ast.Name) and \
+                        recv.id in self.var_attr_alias:
+                    self.mm.join_attrs.add(self.var_attr_alias[recv.id])
+            # self._threads.append(t) with t a local Thread
+            elif name in ("append", "add"):
+                holder = _self_attr(recv)
+                if holder is not None and any(
+                        isinstance(a, ast.Name) and
+                        a.id in self.local_threads for a in node.args):
+                    self.mm.thread_attrs.setdefault(
+                        holder, (node.lineno, node.col_offset))
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and \
+                                a.id in self.local_threads:
+                            self.var_attr_alias[a.id] = holder
+                            if a.id in self.mm.started_attrs:
+                                self.mm.started_attrs.add(holder)
+
+    def _handle_attribute(self, node: ast.Attribute, held):
+        attr = _self_attr(node)
+        if attr is None:
+            return
+        cm = self.cm
+        if attr in cm.lock_attrs or attr in cm.cond_attrs or \
+                attr in cm.lockdict_attrs or attr in cm.method_names:
+            return
+        parent = getattr(node, "_pt_parent", None)
+        write = isinstance(node.ctx, (ast.Store, ast.Del))
+        if not write and isinstance(parent, ast.Subscript) and \
+                parent.value is node and \
+                isinstance(parent.ctx, (ast.Store, ast.Del)):
+            write = True
+        if not write and isinstance(parent, ast.Attribute) and \
+                parent.value is node and parent.attr in _MUTATORS:
+            gp = getattr(parent, "_pt_parent", None)
+            if isinstance(gp, ast.Call) and gp.func is parent:
+                ggp = getattr(gp, "_pt_parent", None)
+                if parent.attr in _VALUE_MUTATORS or \
+                        isinstance(ggp, ast.Expr):
+                    write = True
+        self.mm.accesses.append(Access(
+            attr, write, self.mm.name, node.lineno, node.col_offset, held))
+
+
+def _scan_primitives(cm: ClassModel, cls: ast.ClassDef):
+    """Find lock/cond/lock-dict attributes anywhere in the class."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            kind = _is_lock_ctor(node.value)
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None and isinstance(tgt, ast.Name):
+                    attr = tgt.id      # class-level `_lock = Lock()`
+                if attr is not None and kind is not None:
+                    (cm.cond_attrs if kind == "cond"
+                     else cm.lock_attrs).add(attr)
+                    if kind == "cond" and isinstance(node.value, ast.Call) \
+                            and node.value.args:
+                        wrapped = _self_attr(node.value.args[0])
+                        if wrapped:
+                            cm.cond_wraps[attr] = wrapped
+                # dict-of-locks: self._x[k] = Lock()
+                if kind == "lock" and isinstance(tgt, ast.Subscript):
+                    holder = _self_attr(tgt.value)
+                    if holder:
+                        cm.lockdict_attrs.add(holder)
+        elif isinstance(node, ast.Call) and \
+                call_name(node) == "setdefault" and node.args:
+            if len(node.args) >= 2 and _is_lock_ctor(node.args[1]):
+                holder = _self_attr(node.func.value) \
+                    if isinstance(node.func, ast.Attribute) else None
+                if holder:
+                    cm.lockdict_attrs.add(holder)
+    # locks are not shared state; neither are the dict holders
+    cm.lockdict_attrs -= cm.lock_attrs | cm.cond_attrs
+
+
+def _build_class(mod, cls: ast.ClassDef,
+                 thread_classes: Set[str]) -> ClassModel:
+    cm = ClassModel(cls.name, cls)
+    for base in cls.bases:
+        dn = dotted_name(base)
+        if dn and dn.split(".")[-1] == "Thread":
+            cm.is_thread_subclass = True
+    cm.method_names = {n.name for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+    _scan_primitives(cm, cls)
+
+    pending = [(n.name, n) for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    while pending:
+        mname, fnode = pending.pop(0)
+        mm = MethodModel(mname, fnode)
+        cm.methods[mname] = mm
+        walker = _MethodWalker(
+            cm, mm, lambda pname, pnode: pending.append((pname, pnode)),
+            thread_classes)
+        for stmt in fnode.body:
+            walker.walk(stmt, frozenset())
+
+    if cm.is_thread_subclass and "run" in cm.methods:
+        cm.entries.add("run")
+
+    _propagate_ctx(cm)
+    cm.thread_reachable = cm._closure(set(cm.entries))
+    _infer_guard_map(cm)
+    return cm
+
+
+def _propagate_ctx(cm: ClassModel):
+    """Fixpoint: a private helper whose in-class call sites all hold L
+    runs under L.  Entries and public methods are callable from
+    anywhere, so their incoming context stays empty."""
+    sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+    for mname, mm in cm.methods.items():
+        for callee, held, _, _ in mm.calls:
+            sites.setdefault(callee, []).append((mname, held))
+    ctx = {m: frozenset() for m in cm.methods}
+    for _ in range(8):
+        changed = False
+        for m in cm.methods:
+            if not m.startswith("_") or m.startswith("__") or \
+                    m in cm.entries or "." in m:
+                continue
+            callers = sites.get(m)
+            if not callers:
+                continue
+            new: Optional[FrozenSet[str]] = None
+            for caller, held in callers:
+                eff = held | ctx.get(caller, frozenset())
+                new = eff if new is None else (new & eff)
+            new = new or frozenset()
+            if new != ctx[m]:
+                ctx[m] = new
+                changed = True
+        if not changed:
+            break
+    cm.ctx_locks = ctx
+
+
+def _infer_guard_map(cm: ClassModel):
+    by_attr: Dict[str, List[Access]] = {}
+    for a in cm.accesses():
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr, accs in by_attr.items():
+        guards: Set[str] = set()
+        site: Optional[Access] = None
+        for a in accs:
+            if not a.write or a.method.split(".")[0] in _CONSTRUCTION:
+                continue
+            eff = cm.effective_held(a, a.method)
+            if eff:
+                guards |= eff
+                if site is None:
+                    site = a
+        if guards and site is not None:
+            cm.guard_map[attr] = frozenset(guards)
+            cm.guard_sites[attr] = site
+
+
+def class_models(mod) -> List[ClassModel]:
+    """All ClassModels for a ModuleInfo, cached on the module."""
+    cached = getattr(mod, "_pt_class_models", None)
+    if cached is not None:
+        return cached
+    classes = [node for node in ast.walk(mod.tree)
+               if isinstance(node, ast.ClassDef)]
+    # module-local Thread subclasses count as thread ctors (transitive:
+    # a subclass of a local subclass is still a thread)
+    thread_classes: Set[str] = set()
+    for _ in range(3):
+        for cls in classes:
+            for base in cls.bases:
+                dn = dotted_name(base)
+                if dn and (dn.split(".")[-1] == "Thread" or
+                           dn in thread_classes):
+                    thread_classes.add(cls.name)
+    models = [_build_class(mod, node, thread_classes) for node in classes]
+    mod._pt_class_models = models
+    return models
+
+
+def module_thread_reachable(mod) -> Set[str]:
+    """Module-level functions reachable from a bare
+    ``Thread(target=fn)`` — the module-function analogue of a class's
+    thread-reachable set."""
+    cached = getattr(mod, "_pt_mod_thread_reachable", None)
+    if cached is not None:
+        return cached
+    roots: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if _is_thread_ctor(node):
+            target = _thread_target(node)
+            if isinstance(target, ast.Name):
+                roots.add(target.id)
+    seen = set(r for r in roots if r in mod.functions)
+    frontier = list(seen)
+    while frontier:
+        fn = mod.functions.get(frontier.pop())
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in mod.functions and \
+                    node.func.id not in seen:
+                seen.add(node.func.id)
+                frontier.append(node.func.id)
+    mod._pt_mod_thread_reachable = seen
+    return seen

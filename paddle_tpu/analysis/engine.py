@@ -15,7 +15,9 @@ ptlint moves all three — plus registry/metrics drift — into a CI check
 that fails in seconds.  This module is the engine: rule registry with
 stable IDs (PT1xx trace-safety, PT2xx SPMD-collective ordering, PT3xx
 Pallas kernel contracts, PT4xx registry consistency, PT5xx
-error-surfacing in distributed/), severities,
+error-surfacing in distributed/, PT7xx lock-consistency races, PT8xx
+fleet-protocol invariants — the last two are the ptrace surface,
+analysis/concurrency/), severities,
 ``# ptlint: disable=PTxxx`` line suppressions, text + JSON reporters, and
 a committed-baseline workflow for grandfathered findings.
 
@@ -54,6 +56,10 @@ class Finding:
     col: int
     message: str
     line_text: str = ""      # stripped source line (baseline fingerprint)
+    # optional (path, line, message) triples pointing at the sites that
+    # explain this finding (the guarded write a race skips, both edges
+    # of a lock cycle); rendered as SARIF relatedLocations
+    related: Tuple = ()
 
     def key(self) -> Tuple[str, str, str]:
         """Line-number-free identity used for baseline matching — stable
@@ -61,9 +67,13 @@ class Finding:
         return (self.rule_id, self.path, self.line_text)
 
     def to_dict(self) -> dict:
-        return {"id": self.rule_id, "severity": self.severity,
-                "path": self.path, "line": self.line, "col": self.col,
-                "message": self.message}
+        d = {"id": self.rule_id, "severity": self.severity,
+             "path": self.path, "line": self.line, "col": self.col,
+             "message": self.message}
+        if self.related:
+            d["related"] = [{"path": p, "line": ln, "message": m}
+                            for p, ln, m in self.related]
+        return d
 
 
 @dataclass
@@ -80,8 +90,9 @@ _RULES: Dict[str, Rule] = {}
 
 def rule(rule_id: str, severity: str, summary: str, scope: str = "file"):
     """Register a rule. File-scope rules receive one ModuleInfo and yield
-    (line, col, message); project-scope rules receive the Project and
-    yield (module, line, col, message)."""
+    (line, col, message[, related]); project-scope rules receive the
+    Project and yield (module, line, col, message[, related]), where the
+    optional `related` is a tuple of (path, line, message) triples."""
     assert severity in ("error", "warning"), severity
     assert scope in ("file", "project"), scope
 
@@ -104,6 +115,8 @@ def _load_rule_modules():
     from . import registry_rules    # noqa: F401
     from . import resilience_rules  # noqa: F401
     from . import trace_safety      # noqa: F401
+    from .concurrency import protocol_rules  # noqa: F401
+    from .concurrency import race_rules      # noqa: F401
 
 
 class ModuleInfo:
@@ -288,15 +301,19 @@ def run(paths: Iterable[str], baseline: Optional[str] = None,
             continue
         if r.scope == "file":
             for mod in modules:
-                for line, col, msg in r.fn(mod):
+                for out in r.fn(mod):
+                    line, col, msg = out[0], out[1], out[2]
+                    rel = tuple(out[3]) if len(out) > 3 and out[3] else ()
                     raw.append((mod, Finding(
                         r.rule_id, r.severity, mod.relpath, line, col, msg,
-                        mod.line_text(line))))
+                        mod.line_text(line), rel)))
         else:
-            for mod, line, col, msg in r.fn(project):
+            for out in r.fn(project):
+                mod, line, col, msg = out[0], out[1], out[2], out[3]
+                rel = tuple(out[4]) if len(out) > 4 and out[4] else ()
                 raw.append((mod, Finding(
                     r.rule_id, r.severity, mod.relpath, line, col, msg,
-                    mod.line_text(line))))
+                    mod.line_text(line), rel)))
 
     base_counts = load_baseline(baseline) if baseline else {}
     for mod, f in sorted(raw, key=lambda mf: (mf[1].path, mf[1].line,
@@ -422,6 +439,14 @@ def render_sarif(report: Report, tool_name: str = "ptlint") -> str:
                 }
             }],
         }
+        if f.related:
+            r["relatedLocations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": p.replace("\\", "/")},
+                    "region": {"startLine": max(int(ln), 1)},
+                },
+                "message": {"text": m},
+            } for p, ln, m in f.related]
         if suppressed:
             r["suppressions"] = [{"kind": "external",
                                   "justification": "baselined finding "

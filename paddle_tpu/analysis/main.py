@@ -26,7 +26,9 @@ def main(argv=None) -> int:
                     "(PT1xx trace-safety, PT2xx SPMD collectives, "
                     "PT3xx Pallas grid contracts, PT4xx registry "
                     "consistency, PT5xx error surfacing; "
-                    "--program: PT6xx IR-level Program analysis)")
+                    "--conc: PT7xx race detector + PT8xx fleet "
+                    "protocols; --program: PT6xx IR-level Program "
+                    "analysis)")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to lint "
                          "(default: paddle_tpu/)")
@@ -48,6 +50,13 @@ def main(argv=None) -> int:
                     metavar="RULE",
                     help="restrict to rule id(s); family form PT3xx ok "
                          "(repeatable)")
+    ap.add_argument("--families", default=None, metavar="FAMS",
+                    help="comma list of rule families, e.g. PT7,PT8 "
+                         "(shorthand for --select PT7xx --select PT8xx)")
+    ap.add_argument("--conc", action="store_true",
+                    help="concurrency mode (ptrace): only the PT7xx "
+                         "race-detector and PT8xx fleet-protocol "
+                         "families")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--program", default=None, metavar="TARGET",
                     help="IR mode: analyze a recorded static.Program "
@@ -72,6 +81,15 @@ def main(argv=None) -> int:
     if args.program is not None:
         return _run_program_mode(args)
 
+    select = list(args.select or [])
+    if args.families:
+        select += [f"{fam.strip()}xx" for fam in args.families.split(",")
+                   if fam.strip()]
+    if args.conc:
+        select += ["PT7xx", "PT8xx"]
+    args.select = select or None
+    tool = "ptrace" if args.conc else "ptlint"
+
     paths = args.paths or ["paddle_tpu"]
     for p in paths:
         if not os.path.exists(p):
@@ -87,6 +105,7 @@ def main(argv=None) -> int:
             return 2
 
     report = engine.run(paths, baseline=baseline, select=args.select)
+    _emit_conc_metrics(args, report)
 
     if args.write_baseline:
         target = args.baseline or os.path.join(
@@ -113,8 +132,24 @@ def main(argv=None) -> int:
               f"{pruned} stale")
         return 0
 
-    print(_render(report, args.format))
+    print(_render(report, args.format, tool=tool))
     return report.exit_code
+
+
+def _emit_conc_metrics(args, report) -> None:
+    """Count ptrace runs/findings when the metrics registry is
+    importable (full-framework invocation); the jax-free tools/ptrace.py
+    path stays import-light and just skips this."""
+    if not args.conc:
+        return
+    try:
+        from ..profiler import metrics as _metrics
+    except Exception:
+        return
+    _metrics.counter("analysis/conc_runs").inc()
+    if report.findings:
+        _metrics.counter("analysis/conc_findings").inc(
+            len(report.findings))
 
 
 def _render(report, fmt: str, tool: str = "ptlint") -> str:

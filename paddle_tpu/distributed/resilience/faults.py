@@ -314,6 +314,13 @@ class FaultInjector:
         with self._lock:
             return set(self._felled_hosts)
 
+    # The lock-free reads of self._plan below (is_armed, plan, the
+    # on_event fast path, counts) are by design and grandfathered in
+    # .ptlint-baseline.json: the injector sits on every transport event,
+    # and the disarmed case must cost one attribute read, not a lock
+    # round-trip. _plan is swapped atomically (a single rebind under
+    # _lock in arm/disarm), so a stale read only delays arming by one
+    # event — it never observes a half-built plan.
     def is_armed(self) -> bool:
         return self._plan is not None
 

@@ -118,7 +118,8 @@ class _StoreServer(threading.Thread):
                 conn, _ = self.sock.accept()
             except OSError:
                 break
-            self._conns.append(conn)
+            with self.cond:
+                self._conns.append(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -235,8 +236,12 @@ class _StoreServer(threading.Thread):
     def stop(self):
         self._stop = True
         # sever live client and tailer connections too, so "stop the
-        # server" means what a host death means: every peer sees EOF
-        for conn in self._conns + self._tailers:
+        # server" means what a host death means: every peer sees EOF.
+        # Snapshot under cond: _serve threads mutate both lists (tail
+        # registration, dead-tailer drops) while stop() iterates.
+        with self.cond:
+            conns = self._conns + self._tailers
+        for conn in conns:
             try:
                 conn.close()
             except OSError:
@@ -457,6 +462,10 @@ class StandbyStore:
             self._sock.close()
         except OSError:
             pass
+        # the closed socket unblocks _tail's recv; join so no tailer
+        # thread outlives the store (bounded: the thread is a daemon
+        # and its loop exits on the first post-close recv)
+        self._thread.join(timeout=2.0)
         self._server.stop()
 
 
@@ -528,11 +537,13 @@ class FailoverStore:
 
     @property
     def host(self) -> str:
-        return self._endpoints[self._idx][0]
+        with self._flock:
+            return self._endpoints[self._idx][0]
 
     @property
     def port(self) -> int:
-        return self._endpoints[self._idx][1]
+        with self._flock:
+            return self._endpoints[self._idx][1]
 
     @property
     def endpoint(self) -> str:
@@ -544,14 +555,21 @@ class FailoverStore:
 
     @property
     def _server(self):
-        return self._store._server
+        with self._flock:
+            return self._store._server
 
-    def _redial(self):
+    def _redial(self, failed=None):
         """Rotate through the endpoint list (next first, wrapping) until
         one accepts, consulting the chaos ``dial`` site like the
         transport does — a ``partition`` fault makes the dial fail the
         way a severed DCN link would."""
         with self._flock:
+            if failed is not None and self._store is not failed:
+                # another caller already swapped the client while we
+                # were failing; dialing again would close ITS fresh
+                # socket and the two threads would invalidate each
+                # other's stores until the retry budget ran out
+                return
             old_idx = self._idx
             try:
                 self._store._sock.close()
@@ -596,15 +614,20 @@ class FailoverStore:
     def _call(self, op, *args, **kwargs):
         attempts = 0
         while True:
+            # pin the current client under _flock so a concurrent
+            # _redial swap can't hand us a half-constructed store; the
+            # blocking op itself runs outside the lock
+            with self._flock:
+                store = self._store
             try:
-                return getattr(self._store, op)(*args, **kwargs)
+                return getattr(store, op)(*args, **kwargs)
             except (StoreTimeoutError, StaleGenerationError):
                 raise
             except OSError:
                 attempts += 1
                 if attempts > self._MAX_OP_RETRIES:
                     raise
-                self._redial()
+                self._redial(failed=store)
 
     def set(self, key: str, value):
         return self._call("set", key, value)
@@ -638,7 +661,9 @@ class FailoverStore:
         self.wait([f"__barrier__/{name}/done"], timeout)
 
     def close(self):
-        self._store.close()
+        with self._flock:
+            store = self._store
+        store.close()
 
 
 def _parse_endpoints(spec: str) -> List[Tuple[str, int]]:

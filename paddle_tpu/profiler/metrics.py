@@ -59,14 +59,16 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def _reset(self):
         with self._lock:
             self._value = 0
 
     def _snap(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Gauge:
@@ -90,14 +92,16 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def _reset(self):
         with self._lock:
             self._value = 0.0
 
     def _snap(self):
-        return self._value
+        with self._lock:
+            return self._value
 
 
 class Histogram:
@@ -163,11 +167,13 @@ class Histogram:
 
     @property
     def count(self):
-        return self._count
+        with self._lock:
+            return self._count
 
     @property
     def sum(self):
-        return self._sum
+        with self._lock:
+            return self._sum
 
     def quantile(self, q: float):
         """Digest-estimated quantile of the observed stream (honest

@@ -297,13 +297,17 @@ class FlightRecorder:
             return list(self._ring)
 
     def _counter_deltas(self, snap: dict) -> dict:
+        # read-modify-write on the delta baseline: two concurrent dumps
+        # (e.g. a crash handler racing a periodic dump) would otherwise
+        # double-count or drop deltas
         cur = snap.get("counters", {})
         deltas = {}
-        for name, v in cur.items():
-            d = v - self._base_counters.get(name, 0)
-            if d:
-                deltas[name] = d
-        self._base_counters = dict(cur)
+        with self._lock:
+            for name, v in cur.items():
+                d = v - self._base_counters.get(name, 0)
+                if d:
+                    deltas[name] = d
+            self._base_counters = dict(cur)
         return deltas
 
     def dump(self, reason: str, path: Optional[str] = None,

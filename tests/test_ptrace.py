@@ -1,0 +1,816 @@
+"""Fixture tests for the ptrace concurrency families (PT7xx/PT8xx).
+
+Every rule gets known-bad snippets proving true positives and
+known-good snippets proving the allowances hold (double-checked
+locking, construction writes, delegated thread shutdown, Condition
+wrapping its lock, ...).  The PR 5 dup-frame counter race
+(``_seen_fseq`` mutated from recv threads without ``_seen_lock``) is
+reconstructed as a must-flag PT701 fixture — the shape this family
+exists to catch before it ships.
+"""
+import json
+import textwrap
+
+from paddle_tpu.analysis import engine
+from paddle_tpu.analysis.main import main as cli
+
+CONC = ["PT7xx", "PT8xx"]
+_DEFAULT = object()
+
+
+def lint(tmp_path, src, name="mod.py", select=_DEFAULT):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return engine.run([str(p)],
+                      select=CONC if select is _DEFAULT else select)
+
+
+def lint_distributed(tmp_path, src, select=None):
+    """PT8xx is scoped to distributed// inference// profiler/ files."""
+    d = tmp_path / "distributed"
+    d.mkdir(exist_ok=True)
+    p = d / "mod.py"
+    p.write_text(textwrap.dedent(src))
+    return engine.run([str(p)], select=select or CONC)
+
+
+def ids(report):
+    return [f.rule_id for f in report.findings]
+
+
+def messages(report, rule_id):
+    return [f.message for f in report.findings if f.rule_id == rule_id]
+
+
+# ---------------------------------------------------------------------------
+# PT701 — lock-consistency races
+# ---------------------------------------------------------------------------
+
+def test_pt701_unguarded_read_flagged(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                return list(self._items)
+    """)
+    assert "PT701" in ids(rep)
+    msg = messages(rep, "PT701")[0]
+    assert "_items" in msg and "self._lock" in msg
+
+
+def test_pt701_all_accesses_guarded_clean(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                with self._lock:
+                    out = list(self._items)
+                    self._items.clear()
+                return out
+    """)
+    assert "PT701" not in ids(rep)
+
+
+def test_pt701_construction_writes_skipped(tmp_path):
+    # __init__ publishing the attr without the lock is construction,
+    # not sharing — the object isn't visible to other threads yet
+    rep = lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self, seed):
+                self._lock = threading.Lock()
+                self._items = list(seed)
+
+            def put(self, x):
+                with self._lock:
+                    self._items.append(x)
+    """)
+    assert "PT701" not in ids(rep)
+
+
+def test_pt701_double_checked_locking_clean(tmp_path):
+    # a method that re-validates its unguarded read under the lock is
+    # the MetricsRegistry._get pattern — allowed
+    rep = lint(tmp_path, """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}
+
+            def get(self, k):
+                v = self._cache.get(k)
+                if v is None:
+                    with self._lock:
+                        v = self._cache.setdefault(k, object())
+                return v
+    """)
+    assert "PT701" not in ids(rep)
+
+
+def test_pt701_thread_target_reachability(tmp_path):
+    # the unguarded access lives two calls below the Thread target;
+    # the finding must name the thread entry it is reachable from
+    rep = lint(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def submit(self, x):
+                with self._lock:
+                    self._pending.append(x)
+
+            def _loop(self):
+                while True:
+                    self._drain()
+
+            def _drain(self):
+                batch = list(self._pending)
+                self._pending.clear()
+
+            def close(self):
+                self._t.join()
+    """)
+    msgs = messages(rep, "PT701")
+    assert msgs, ids(rep)
+    assert any("reachable from thread entry '_loop()'" in m for m in msgs)
+
+
+def test_pt701_condition_as_guard(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class MailBox:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._msgs = []
+
+            def post(self, m):
+                with self._cond:
+                    self._msgs.append(m)
+                    self._cond.notify()
+
+            def peek(self):
+                return len(self._msgs)
+    """)
+    msgs = messages(rep, "PT701")
+    assert msgs and "self._cond" in msgs[0]
+
+
+def test_pt701_pr5_dup_frame_counter_race(tmp_path):
+    # reconstruction of the PR 5 bug: recv threads mutate the seen-set
+    # without _seen_lock while reset() takes it — must flag
+    rep = lint(tmp_path, """
+        import threading
+
+        class Receiver:
+            def __init__(self, sock):
+                self._sock = sock
+                self._seen_lock = threading.Lock()
+                self._seen_fseq = set()
+                self._t = threading.Thread(target=self._recv_loop,
+                                           daemon=True)
+                self._t.start()
+
+            def _recv_loop(self):
+                while True:
+                    fseq = self._sock.recv_frame()
+                    if fseq in self._seen_fseq:
+                        continue
+                    self._seen_fseq.add(fseq)
+
+            def reset(self):
+                with self._seen_lock:
+                    self._seen_fseq.clear()
+
+            def close(self):
+                self._t.join()
+    """)
+    msgs = messages(rep, "PT701")
+    assert any("_seen_fseq" in m and "self._seen_lock" in m for m in msgs)
+
+
+def test_pt701_threaded_class_unshared_attr_not_flagged(tmp_path):
+    # the class runs a thread, but its visible threads never touch the
+    # guarded attr — external callers own that discipline, stay quiet
+    rep = lint(tmp_path, """
+        import threading
+
+        class Srv(threading.Thread):
+            def __init__(self):
+                super().__init__()
+                self._lock = threading.Lock()
+                self._stats = {}
+
+            def run(self):
+                while True:
+                    self.tick()
+
+            def tick(self):
+                pass
+
+            def bump(self, k):
+                with self._lock:
+                    self._stats[k] = 1
+
+            def peek(self):
+                return dict(self._stats)
+    """)
+    assert "PT701" not in ids(rep)
+
+
+def test_pt701_ctx_lock_propagation_through_helper(tmp_path):
+    # _append has no `with` of its own, but every in-class call site
+    # holds _mu — the "called with lock held" convention, made checkable
+    rep = lint(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._q = []
+
+            def push(self, x):
+                with self._mu:
+                    self._append(x)
+
+            def pop(self):
+                with self._mu:
+                    return self._q.pop()
+
+            def _append(self, x):
+                self._q.append(x)
+    """)
+    assert "PT701" not in ids(rep)
+
+
+def test_pt701_related_location_names_guarded_write(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def read(self):
+                return self._n
+    """)
+    f = [f for f in rep.findings if f.rule_id == "PT701"][0]
+    assert f.related and "guarded write" in f.related[0][2]
+
+
+# ---------------------------------------------------------------------------
+# PT702 — lock-order cycles
+# ---------------------------------------------------------------------------
+
+def test_pt702_two_lock_cycle(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PT702" in ids(rep)
+    assert "deadlock" in messages(rep, "PT702")[0]
+
+
+def test_pt702_three_lock_cycle(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._c = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._b:
+                    with self._c:
+                        pass
+
+            def m3(self):
+                with self._c:
+                    with self._a:
+                        pass
+    """)
+    msgs = messages(rep, "PT702")
+    assert msgs and " -> " in msgs[0]
+
+
+def test_pt702_consistent_order_clean(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """)
+    assert "PT702" not in ids(rep)
+
+
+def test_pt702_condition_and_wrapped_lock_are_one(tmp_path):
+    # Condition(self._lk) shares _lk — nesting them is reentrant
+    # acquisition of one lock, not an ordering edge
+    rep = lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self._cv = threading.Condition(self._lk)
+
+            def m1(self):
+                with self._lk:
+                    with self._cv:
+                        pass
+    """)
+    assert "PT702" not in ids(rep)
+
+
+def test_pt702_related_lists_cycle_edges(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def m1(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def m2(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    f = [f for f in rep.findings if f.rule_id == "PT702"][0]
+    assert len(f.related) >= 2
+    assert all("acquires" in r[2] for r in f.related)
+
+
+# ---------------------------------------------------------------------------
+# PT703 — thread join discipline
+# ---------------------------------------------------------------------------
+
+def test_pt703_thread_started_never_joined(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                pass
+    """)
+    assert "PT703" in ids(rep)
+
+
+def test_pt703_join_in_close_clean(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._t.join(timeout=2.0)
+    """)
+    assert "PT703" not in ids(rep)
+
+
+def test_pt703_delegated_stop_counts_as_join(tmp_path):
+    # TCPStore.close() -> self._server.stop(): shutdown delegated to
+    # the (module-local) thread object itself is join evidence
+    rep = lint(tmp_path, """
+        import threading
+
+        class _Worker(threading.Thread):
+            def run(self):
+                pass
+
+            def stop(self):
+                self.join()
+
+        class Owner:
+            def __init__(self):
+                self._w = _Worker()
+                self._w.start()
+
+            def close(self):
+                self._w.stop()
+    """)
+    assert "PT703" not in ids(rep)
+
+
+def test_pt703_fire_and_forget_local_clean(tmp_path):
+    # an unstored thread can't be joined later by design — not flagged
+    rep = lint(tmp_path, """
+        import threading
+
+        def _notify():
+            pass
+
+        class F:
+            def ping(self):
+                t = threading.Thread(target=_notify, daemon=True)
+                t.start()
+    """)
+    assert "PT703" not in ids(rep)
+
+
+def test_pt703_no_lifecycle_method_hint(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                pass
+    """)
+    msgs = messages(rep, "PT703")
+    assert msgs and "no close()/stop()/abort() method exists" in msgs[0]
+
+
+# ---------------------------------------------------------------------------
+# PT704 — Condition discipline
+# ---------------------------------------------------------------------------
+
+def test_pt704_notify_outside_lock(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def kick(self):
+                self._cv.notify()
+    """)
+    assert "PT704" in ids(rep)
+
+
+def test_pt704_wait_inside_lock_clean(tmp_path):
+    rep = lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def get(self):
+                with self._cv:
+                    self._cv.wait(timeout=1.0)
+    """)
+    assert "PT704" not in ids(rep)
+
+
+def test_pt704_wrapped_lock_satisfies_condition(tmp_path):
+    # holding the Lock a Condition wraps IS holding the condition
+    rep = lint(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._lk = threading.Lock()
+                self._cv = threading.Condition(self._lk)
+
+            def kick(self):
+                with self._lk:
+                    self._cv.notify_all()
+    """)
+    assert "PT704" not in ids(rep)
+
+
+# ---------------------------------------------------------------------------
+# PT801 — manifest-last discipline
+# ---------------------------------------------------------------------------
+
+def test_pt801_payload_write_after_manifest(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import numpy as np
+
+        def checkpoint(path, arrs, publish_manifest):
+            publish_manifest(path, list(arrs))
+            np.save(path + "/extra.npy", arrs[0])
+    """)
+    assert "PT801" in ids(rep)
+    f = [f for f in rep.findings if f.rule_id == "PT801"][0]
+    assert f.related and "manifest published" in f.related[0][2]
+
+
+def test_pt801_manifest_last_clean(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import numpy as np
+
+        def checkpoint(path, arrs, publish_manifest):
+            np.save(path + "/extra.npy", arrs[0])
+            with open(path + "/meta.json", "w") as f:
+                f.write("{}")
+            publish_manifest(path, list(arrs))
+    """)
+    assert "PT801" not in ids(rep)
+
+
+# ---------------------------------------------------------------------------
+# PT802 — hand-off payload completeness
+# ---------------------------------------------------------------------------
+
+def test_pt802_migration_payload_missing_identity(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        def migrate_request(req, sock):
+            payload = {
+                "prompt": req.prompt,
+                "sampling": req.sampling,
+                "generated": req.generated,
+            }
+            sock.sendall(payload)
+    """)
+    msgs = messages(rep, "PT802")
+    assert msgs
+    assert "salt_rid" in msgs[0] and "salt_seed" in msgs[0]
+
+
+def test_pt802_complete_request_payload_clean(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        def migrate_request(req, sock, tracing):
+            payload = {
+                "prompt": req.prompt,
+                "sampling": req.sampling,
+                "generated": req.generated,
+                "salt_rid": req.rid,
+                "salt_seed": req.seed,
+                "weight_version": req.weight_version,
+            }
+            tracing.inject(payload)
+            sock.sendall(payload)
+    """)
+    assert "PT802" not in ids(rep)
+
+
+def test_pt802_weight_meta_missing_crcs(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import pickle
+
+        def publish_weights(store, meta):
+            doc = {"dtypes": meta.dtypes, "shapes": meta.shapes}
+            store.set("weights/meta", pickle.dumps(doc))
+    """)
+    msgs = messages(rep, "PT802")
+    assert msgs and "crcs" in msgs[0] and "version" in msgs[0]
+
+
+def test_pt802_spread_dict_not_judged(tmp_path):
+    # a **spread makes completeness unknowable — stay quiet
+    rep = lint_distributed(tmp_path, """
+        def migrate_request(req, sock, base):
+            payload = {"prompt": req.prompt, "sampling": req.sampling,
+                       **base}
+            sock.sendall(payload)
+    """)
+    assert "PT802" not in ids(rep)
+
+
+# ---------------------------------------------------------------------------
+# PT803 — generation-fenced writes
+# ---------------------------------------------------------------------------
+
+def test_pt803_literal_generation(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        def announce(store):
+            store.fenced_set("leader", b"1", "fleet", 0)
+    """)
+    msgs = messages(rep, "PT803")
+    assert msgs and "literal" in msgs[0]
+
+
+def test_pt803_missing_generation(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        def announce(store):
+            store.fenced_set("leader", b"1", "fleet")
+    """)
+    msgs = messages(rep, "PT803")
+    assert msgs and "without a generation" in msgs[0]
+
+
+def test_pt803_epoch_derived_generation_clean(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        def announce(store, sup):
+            store.fenced_set("leader", b"1", "fleet",
+                             gen=sup.generation())
+    """)
+    assert "PT803" not in ids(rep)
+
+
+# ---------------------------------------------------------------------------
+# PT804 — atomic metrics updates
+# ---------------------------------------------------------------------------
+
+def test_pt804_rmw_set_from_thread(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self, gauge):
+                self._gauge = gauge
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                g = self._gauge
+                g.set(g.value + 1)
+
+            def close(self):
+                self._t.join()
+    """)
+    msgs = messages(rep, "PT804")
+    assert msgs and "inc(delta)" in msgs[0]
+
+
+def test_pt804_inc_is_clean(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import threading
+
+        class Pump:
+            def __init__(self, gauge):
+                self._gauge = gauge
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                self._gauge.inc(1)
+
+            def close(self):
+                self._t.join()
+    """)
+    assert "PT804" not in ids(rep)
+
+
+def test_pt804_module_thread_target(tmp_path):
+    rep = lint_distributed(tmp_path, """
+        import threading
+
+        def _loop(gauge):
+            gauge.set(gauge.value + 1)
+
+        def start(gauge):
+            t = threading.Thread(target=_loop, args=(gauge,), daemon=True)
+            t.start()
+            return t
+    """)
+    assert "PT804" in ids(rep)
+
+
+# ---------------------------------------------------------------------------
+# scoping, selection, CLI, SARIF
+# ---------------------------------------------------------------------------
+
+PT801_SRC = """
+    import numpy as np
+
+    def checkpoint(path, arrs, publish_manifest):
+        publish_manifest(path, list(arrs))
+        np.save(path + "/extra.npy", arrs[0])
+"""
+
+
+def test_pt8xx_out_of_scope_path_clean(tmp_path):
+    # the same source outside distributed// inference// profiler/ is
+    # not held to the fleet protocols
+    rep = lint(tmp_path, PT801_SRC)
+    assert "PT801" not in ids(rep)
+
+
+RACE_SRC = """
+    import threading
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def step(x):
+        print("loss", x)
+        return x
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+
+        def bump(self):
+            with self._lock:
+                self._n += 1
+
+        def read(self):
+            return self._n
+"""
+
+
+def test_conc_select_excludes_other_families(tmp_path):
+    # the same file trips PT101 under the full suite but --conc style
+    # selection must only surface the concurrency families
+    full = lint(tmp_path, RACE_SRC, select=None)
+    conc = lint(tmp_path, RACE_SRC, select=CONC)
+    assert "PT101" in ids(full)
+    assert set(ids(conc)) == {"PT701"}
+
+
+def test_cli_conc_mode(tmp_path, capsys):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(RACE_SRC))
+    rc = cli(["--conc", "--no-baseline", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "ptrace:" in out
+    assert "PT701" in out and "PT101" not in out
+
+
+def test_cli_families_flag(tmp_path, capsys):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(RACE_SRC))
+    rc = cli(["--families", "PT7,PT8", "--no-baseline", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "PT701" in out and "PT101" not in out
+
+
+def test_sarif_related_locations(tmp_path):
+    rep = lint(tmp_path, RACE_SRC, select=CONC)
+    doc = json.loads(engine.render_sarif(rep, tool_name="ptrace"))
+    results = doc["runs"][0]["results"]
+    pt701 = [r for r in results if r["ruleId"] == "PT701"]
+    assert pt701 and pt701[0]["relatedLocations"]
+    loc = pt701[0]["relatedLocations"][0]
+    assert "guarded write" in loc["message"]["text"]

@@ -1,0 +1,58 @@
+"""CI gate: the concurrency families (PT7xx/PT8xx) over paddle_tpu/
+must be clean.
+
+The tier-1 enforcement of the race-detector contract, mirroring
+test_ptlint_clean.py: zero non-baselined PT7xx/PT8xx findings across
+the whole package. A new finding means either fix the synchronization
+(take the guard, join the thread, complete the payload) or — for
+intentionally lock-free designs only — grandfather it in
+``.ptlint-baseline.json`` with a comment in the code explaining why
+the unguarded access is safe (see FaultInjector._plan in
+distributed/resilience/faults.py for the canonical example).
+"""
+import os
+
+from paddle_tpu.analysis import engine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONC = ["PT7xx", "PT8xx"]
+_cache = {}
+
+
+def _scan():
+    """One package scan shared by both gates (a full-repo AST walk is
+    the expensive part; the two assertions read the same report)."""
+    if "report" not in _cache:
+        baseline = os.path.join(REPO, engine.BASELINE_NAME)
+        if not os.path.isfile(baseline):
+            baseline = None
+        _cache["baseline"] = baseline
+        _cache["report"] = engine.run(
+            [os.path.join(REPO, "paddle_tpu")], baseline=baseline,
+            select=CONC)
+    return _cache["baseline"], _cache["report"]
+
+
+def test_ptrace_clean_over_package():
+    _, report = _scan()
+    assert not report.parse_errors, report.parse_errors
+    assert not report.findings, \
+        "\n" + engine.render_text(report, tool_name="ptrace")
+    # the gate must actually have looked at the package
+    assert report.files > 100
+
+
+def test_conc_baseline_entries_still_real():
+    """Every grandfathered PT7xx/PT8xx entry must still match a live
+    finding — a stale entry means the code was fixed and the baseline
+    should shrink (delete the entry)."""
+    baseline, report = _scan()
+    if baseline is None:
+        return
+    entries = engine.load_baseline(baseline)
+    n_conc = sum(v for k, v in entries.items()
+                 if k[0].startswith(("PT7", "PT8")))
+    assert len(report.baselined) == n_conc, (
+        f"baseline has {n_conc} PT7xx/PT8xx entries but "
+        f"{len(report.baselined)} matched a live finding — remove the "
+        f"stale entries from {engine.BASELINE_NAME}")

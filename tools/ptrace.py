@@ -1,22 +1,22 @@
 #!/usr/bin/env python
-"""ptlint — standalone entry point for the paddle_tpu static-analysis
-suite (equivalent to ``python -m paddle_tpu.analysis``).
+"""ptrace — standalone entry point for the concurrency analysis
+families (equivalent to ``python -m paddle_tpu.analysis --conc``):
+
+- PT7xx: class-level lock-consistency race detection (guard-map
+  inference, lock-order cycles, join discipline, condition usage);
+- PT8xx: fleet-protocol invariants (manifest-last persistence,
+  hand-off payload identity keys, generation-fenced writes, atomic
+  metrics updates).
 
 Loads the analysis package directly from source files so it runs even
 when paddle_tpu isn't installed and without importing the framework
-(no jax import — the linter stays milliseconds-fast in CI).
+(no jax import — milliseconds-fast in CI, like tools/ptlint.py).
 
 Usage:
-  python tools/ptlint.py paddle_tpu/
-  python tools/ptlint.py paddle_tpu/ --format json     # or sarif
-  python tools/ptlint.py paddle_tpu/ --update-baseline # prune stale
-  python tools/ptlint.py --families PT7,PT8 paddle_tpu/  # one family set
-  python tools/ptlint.py --list-rules
-
-For the concurrency families alone (PT7xx races + PT8xx fleet
-protocols) use tools/ptrace.py / ``--conc``; for the IR-level Program
-analyzer (PT6xx, needs jax) use tools/ptprog.py /
-``python -m paddle_tpu.analysis --program``.
+  python tools/ptrace.py paddle_tpu/
+  python tools/ptrace.py paddle_tpu/distributed/ --format sarif
+  python tools/ptrace.py paddle_tpu/ --no-baseline    # include
+                                                      # grandfathered
 """
 import importlib.util
 import os
@@ -45,4 +45,4 @@ def _load_analysis():
 
 
 if __name__ == "__main__":
-    sys.exit(_load_analysis().main())
+    sys.exit(_load_analysis().main(["--conc"] + sys.argv[1:]))

@@ -136,6 +136,8 @@ KNOWN_METRICS = (
     "analysis/programs_analyzed", "analysis/ops_analyzed",
     "analysis/findings", "analysis/peak_bytes",
     "analysis/verify_failures",
+    # concurrency analyzer (ptrace: PT7xx races + PT8xx protocols)
+    "analysis/conc_runs", "analysis/conc_findings",
     # distributed tracing + crash flight recorder (profiler/tracing.py)
     "trace/*",
     # fleet metrics aggregation plane (profiler/aggregate.py):
