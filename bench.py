@@ -1510,6 +1510,195 @@ def bench_weight_publish(on_tpu):
     }}
 
 
+def bench_autoscale_storm(on_tpu):
+    """Elastic resize gate row (ISSUE 18): a 2-replica fleet behind the
+    gateway meets a 4x admit storm; the AutoScaler grows it to 4 —
+    each spawn brought to the fleet's committed weight version (a real
+    publish lands BEFORE the storm, so catch-up ships actual weights)
+    before entering rotation, with ``kill@spawn`` felling the first
+    attempt mid-catch-up (swept + retried, fleet serving throughout) —
+    then the post-storm calm drains it back down to 2 while late
+    requests are still in flight.  Gate signals, zero slack on the
+    first two: every admitted real request completes (a resize may
+    never lose traffic) and every stream is token-bitwise-identical to
+    a FIXED-FLEET reference run (salt identity rides the stream_key,
+    so placement on a spawned replica or a drain off a retiring one
+    changes nothing); scale-up reaction time and goodput gate with the
+    normal threshold."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.inference.autoscaler import (AutoScaler,
+                                                 AutoScalerConfig,
+                                                 InProcessReplicaFactory)
+    from paddle_tpu.inference.fleet_supervisor import FleetSupervisor
+    from paddle_tpu.inference.gateway import (BrownoutConfig,
+                                              FleetGateway,
+                                              GatewayConfig,
+                                              SLOClassConfig,
+                                              TenantConfig)
+    from paddle_tpu.inference.router import Replica, ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+    from paddle_tpu.inference.weight_publish import WeightPublisher
+    from paddle_tpu.jit import functional as FB
+    from paddle_tpu.profiler import timeline as _ptimeline
+    from paddle_tpu.profiler.headroom import ScaleAdvisor
+
+    n_storm, n_calm, prompt_len, max_new = 8, 2, 12, 6
+    cfg = PagedServingConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=3, max_blocks_per_seq=6, token_budget=32,
+        max_queue=8)
+    paddle.seed(0)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(17)
+    storm_prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+                     for _ in range(n_storm)]
+    calm_prompts = [list(rng.randint(1, cfg.vocab_size, prompt_len))
+                    for _ in range(n_calm)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    # the committed version the spawns must catch up to: the serving
+    # params plus finite perturbation (same recipe as weight_publish)
+    nrng = np.random.RandomState(7)
+    new_params = {}
+    for k, v in FB.current_params(model).items():
+        a = np.asarray(jax.device_get(v))
+        if np.issubdtype(a.dtype, np.floating):
+            f = a.astype(np.float32)
+            new_params[k] = (f + nrng.normal(
+                0.0, 0.03 * (np.std(f) + 1e-6), f.shape)
+            ).astype(a.dtype)
+        else:
+            new_params[k] = a
+
+    def gateway_cfg():
+        # all real traffic is protected (the ladder may not clamp or
+        # shed it — bitwise gates at zero slack); the storm's synthetic
+        # clones are sheddable best-effort
+        return GatewayConfig(
+            classes={"interactive": SLOClassConfig(priority=0,
+                                                   protected=True),
+                     "best_effort": SLOClassConfig(priority=2,
+                                                   sheddable=True)},
+            tenants={"alpha": TenantConfig(rate=500.0, burst=100.0)},
+            brownout=BrownoutConfig(enter_load=1.6, exit_load=0.8,
+                                    hysteresis=2))
+
+    def build_fleet():
+        engines = []
+        for i in range(2):
+            e = ServingEngine.from_model(model, cfg, seed=30 + i)
+            e.fault_rank = i
+            engines.append(e)
+        router = ReplicaRouter(
+            [Replica(e, name=f"r{i}") for i, e in enumerate(engines)])
+        sup = FleetSupervisor(
+            router, engine_factory=lambda i: ServingEngine.from_model(
+                model, cfg, seed=30 + i))
+        gw = FleetGateway(router, gateway_cfg())
+        pub = WeightPublisher(router, model, supervisor=sup)
+        pub.publish(params=new_params)      # committed pre-storm epoch
+        return router, sup, gw, pub
+
+    def submit_wave(gw, prompts, key_base):
+        return [gw.submit(list(p), max_new_tokens=max_new, sampling=sp,
+                          tenant="alpha", slo="interactive",
+                          stream_key=key_base + i)
+                for i, p in enumerate(prompts)]
+
+    # -- fixed-fleet reference: same publish, no storm, no resize
+    faults.disarm()
+    _, _, gw_ref, _ = build_fleet()
+    t_ref = submit_wave(gw_ref, storm_prompts, 1000) \
+        + submit_wave(gw_ref, calm_prompts, 2000)
+    out_ref = gw_ref.run_to_completion(max_steps=4000)
+    ref = {gw_ref.ticket_info(t)["stream_key"]: out_ref.get(t, [])
+           for t in t_ref}
+
+    # -- the live run: storm + resize under chaos
+    step_count = [0]
+    tl = _ptimeline.Timeline(clock=lambda: float(step_count[0]))
+    advisor = ScaleAdvisor(tl, window_s=30.0, min_windows=2,
+                           high_load=0.8, low_load=0.3)
+    router, sup, gw, pub = build_fleet()
+    factory = InProcessReplicaFactory(model, cfg, seed_base=100)
+    scaler = AutoScaler(
+        router, sup, advisor, factory,
+        AutoScalerConfig(min_replicas=2, max_replicas=4,
+                         scale_up_after=2, scale_down_after=2,
+                         cooldown_evals=2, catchup_timeout_s=10.0,
+                         max_spawn_failures=3, spawn_backoff_base_s=0.0,
+                         spawn_backoff_cap_s=0.0),
+        gateway=gw, publisher=pub)
+    _ptimeline.install(tl)
+
+    def tick(every: int = 3):
+        step_count[0] += 1
+        if step_count[0] % every == 0:
+            tl.sample()
+            scaler.evaluate()
+
+    scaleup_s = None
+    try:
+        # kill@spawn#1: the FIRST spawn attempt dies mid-catch-up and
+        # is swept; overload@admit turns every arrival into 4
+        faults.arm("kill@spawn#1,overload@admit%1.0:x=4")
+        t0 = time.perf_counter()
+        tickets = submit_wave(gw, storm_prompts, 1000)
+        for _ in range(4000):
+            gw.step()
+            tick()
+            if scaleup_s is None and router.fleet_size() > 2:
+                scaleup_s = time.perf_counter() - t0
+            if not gw.queued() and not gw.router._live_pending():
+                break
+        faults.disarm()
+        peak_size = router.fleet_size()
+
+        # calm: late traffic still in flight while the fleet shrinks
+        tickets += submit_wave(gw, calm_prompts, 2000)
+        for _ in range(2000):
+            gw.step()
+            tick()
+            if router.fleet_size() <= 2 and not gw.queued() \
+                    and not gw.router._live_pending():
+                break
+        out = gw.results()
+        total_s = time.perf_counter() - t0
+    finally:
+        faults.disarm()
+        _ptimeline.uninstall(tl)
+
+    completed = sum(1 for t in tickets
+                    if len(out.get(t) or []) == max_new)
+    bitwise = all(
+        (out.get(t) or []) == ref.get(gw.ticket_info(t)["stream_key"])
+        for t in tickets)
+    actions = [r for r in scaler.history
+               if r["action"] in ("scale_up", "scale_down")]
+    return {"autoscale_storm": {
+        "n_requests": len(tickets), "max_new": max_new,
+        "requests_completed": completed,
+        "bitwise_match": 1.0 if bitwise else 0.0,
+        "scaleup_to_traffic_s": round(scaleup_s, 4)
+        if scaleup_s is not None else None,
+        "goodput_rps": round(completed / total_s, 2),
+        "total_s": round(total_s, 4),
+        "peak_fleet": peak_size,
+        "final_fleet": router.fleet_size(),
+        "spawn_failures": scaler.spawn_failures,
+        "actions": [{"action": r["action"], "size": r["size"]}
+                    for r in actions],
+        "committed_version": pub.version,
+    }}
+
+
 def bench_eager_dispatch(on_tpu):
     """Eager per-op dispatch cost through the per-signature jit cache
     (VERDICT r2 #1; reference analog: the all-C++ eager hot path,
@@ -1699,6 +1888,7 @@ WORKLOADS = (
     ("host_recovery", bench_host_recovery, True),
     ("weight_publish", bench_weight_publish, True),
     ("gateway_storm", bench_gateway_storm, True),
+    ("autoscale_storm", bench_autoscale_storm, True),
     ("second_order", bench_second_order, False),
 )
 

@@ -93,6 +93,22 @@ Process/frame kinds (kill/dup/corrupt/partition) are rejected at
     PT_FAULT_PLAN="overload@admit%1.0:x=4"    # sustained 4x storm
     PT_FAULT_PLAN="overload@admit#1:x=8"      # one 8x burst
 
+The ``spawn`` and ``retire`` sites are the AutoScaler's resize sites
+(``inference/autoscaler.py``): ``spawn`` is consulted once per
+scale-up attempt, after the new replica is built but BEFORE its weight
+catch-up completes — ``kill`` fells the half-built replica (the
+autoscaler sweeps it and retries under backoff, bounded by
+``max_spawn_failures``; the serving fleet never stops) and ``delay``
+slows the converge against ``catchup_timeout_s``.  ``retire`` is
+consulted once per scale-down as the draining replica hands off its
+in-flight work — ``kill`` fells it mid-drain, so the KV hand-off
+falls back to the requeue path with zero lost requests.  Both are
+process events: frame kinds are rejected.  Use ``:rank=R`` to target
+the replica slot being spawned / the replica index being retired::
+
+    PT_FAULT_PLAN="kill@spawn#1"              # first spawn attempt dies
+    PT_FAULT_PLAN="kill@retire#1:rank=2"      # replica 2 dies mid-drain
+
 Every injected fault increments ``faults/injected`` and
 ``faults/<kind>`` in the metrics registry so a chaos run's report shows
 exactly what was thrown at the system.
@@ -120,7 +136,7 @@ FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition",
                "overload")
 FAULT_SITES = ("send", "dial", "recv", "step", "save",
                "prefill", "decode", "migrate", "cache_save", "host",
-               "admit", "publish")
+               "admit", "publish", "spawn", "retire")
 
 # frame-level kinds are meaningless away from the wire: the validator
 # REJECTS them at the process/host sites instead of silently no-oping
@@ -140,6 +156,15 @@ _ADMIT_KINDS = ("overload", "drop", "delay")
 # failures a rollout exhibits; dup is meaningless (staging is
 # idempotent per version) and rejected so a no-op plan fails CI
 _PUBLISH_KINDS = ("kill", "delay", "drop", "corrupt")
+# the autoscaler's resize sites are PROCESS events, not wire frames:
+# spawn fires between a new replica's build and its weight catch-up
+# (kill = the half-built replica dies mid-catch-up and is swept; delay
+# = a slow converge against catchup_timeout_s), retire fires as a
+# draining replica hands off its last in-flight work (kill = it dies
+# mid-drain and the hand-off falls back to requeue).  Frame kinds are
+# rejected so a no-op plan fails CI instead of silently passing.
+_RESIZE_SITES = ("spawn", "retire")
+_RESIZE_KINDS = ("kill", "delay")
 
 
 @dataclass(frozen=True)
@@ -255,6 +280,12 @@ def parse_plan(spec: str) -> FaultPlan:
                 f"kind {kind!r} is meaningless at the 'publish' site "
                 f"in {clause!r} (only {'/'.join(_PUBLISH_KINDS)} fire "
                 f"there)")
+        if site in _RESIZE_SITES and kind not in _RESIZE_KINDS:
+            raise ValueError(
+                f"kind {kind!r} is meaningless at the {site!r} site in "
+                f"{clause!r} (a resize is a process event — only "
+                f"{'/'.join(_RESIZE_KINDS)} fire at "
+                f"{'/'.join(_RESIZE_SITES)})")
         for opt in opts:
             k, _, v = opt.partition("=")
             if k == "rank":

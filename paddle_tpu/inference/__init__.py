@@ -356,6 +356,7 @@ _FLEET_EXPORTS = {
     "ServingEngine": "serving", "PagedCausalLM": "serving",
     "PagedServingConfig": "serving", "SamplingParams": "serving",
     "EngineOverloadedError": "serving", "save_paged_model": "serving",
+    "resolve_backend_device": "serving",
     "PrefixCache": "prefix_cache",
     "PrefillWorker": "disagg", "DecodeWorker": "disagg",
     "migrate_request": "disagg", "receive_request": "disagg",
@@ -366,6 +367,9 @@ _FLEET_EXPORTS = {
     "FleetSupervisor": "fleet_supervisor",
     "FleetSupervisorConfig": "fleet_supervisor",
     "LoopbackTransport": "fleet_supervisor",
+    "AutoScaler": "autoscaler", "AutoScalerConfig": "autoscaler",
+    "ReplicaFactory": "autoscaler",
+    "InProcessReplicaFactory": "autoscaler",
     "WeightPublisher": "weight_publish",
     "PublishPolicy": "weight_publish",
     "PublishReport": "weight_publish",

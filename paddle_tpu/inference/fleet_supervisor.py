@@ -149,6 +149,23 @@ class FleetSupervisor:
                                                None]] = None
         router.failure_hook = self.on_failure
 
+    # -- elastic fleet membership ------------------------------------------
+    def _ensure_slot(self, idx: int) -> None:
+        # the autoscaler appends replicas after construction: grow the
+        # per-replica restart ledger to cover them
+        while len(self.restarts) <= idx:
+            self.restarts.append(0)
+
+    def adopt_replica(self, idx: int) -> None:
+        """Take a replica spawned AFTER construction (autoscaler
+        scale-up) into the supervision cadence: restart budget,
+        cache-snapshot pass, and pump() recovery all cover it from
+        here on."""
+        self._ensure_slot(idx)
+        _tracing.flight_note(
+            "replica_adopted", replica=self.router.replicas[idx].name,
+            idx=idx)
+
     # -- failure entry points --------------------------------------------
     def on_failure(self, idx: int) -> None:
         """Full recovery for replica ``idx``: dump the flight recorder
@@ -170,7 +187,9 @@ class FleetSupervisor:
         replicas whose engine died elsewhere (e.g. mid-snapshot) and
         probe demoted ones.  Returns the indices recovered."""
         recovered = []
-        for idx, rep in enumerate(self.router.replicas):
+        for idx, rep in enumerate(self.router._snapshot()):
+            if getattr(rep, "retired", False):
+                continue       # left the fleet: never restarted
             if getattr(rep.engine, "dead", False):
                 rep.mark_unhealthy()
                 self.on_failure(idx)
@@ -299,11 +318,18 @@ class FleetSupervisor:
             return True
         return False
 
-    def drain(self, idx: int) -> int:
+    def drain(self, idx: int, migrate: Optional[bool] = None) -> int:
         """Move every in-flight request off replica ``idx``: KV
         migration for decode-tip requests, requeue for the rest (and
         for hand-offs the dying engine fails to ship).  Returns how
-        many requests found a new home."""
+        many requests found a new home.  ``migrate`` overrides
+        ``cfg.migrate`` for this drain only — the autoscaler passes
+        False when the retiring replica's PROCESS died mid-drain
+        (kill@retire): an in-process engine fault leaves its KV pages
+        readable in host memory, but a dead process has no source end
+        to ship them, so only the requeue path (which rebuilds from
+        admission metadata) is honest there."""
+        use_migrate = self.cfg.migrate if migrate is None else migrate
         src = self.router.replicas[idx].engine
         targets = self.router._ordered(
             exclude=idx,
@@ -313,7 +339,7 @@ class FleetSupervisor:
             if r.done or r.timed_out:
                 continue       # finished/evicted before death: nothing live
             migrated = False
-            if self.cfg.migrate and targets \
+            if use_migrate and targets \
                     and r.length - r.cached == 1:
                 try:
                     migrated = self._migrate_one(idx, rid, targets)
@@ -336,9 +362,12 @@ class FleetSupervisor:
         cache during construction.  The replica stays demoted until the
         half-open probes pass.  False once ``max_restarts`` is spent —
         the replica is left out of rotation for good."""
+        self._ensure_slot(idx)
         if self.restarts[idx] >= self.cfg.max_restarts:
             return False
         rep = self.router.replicas[idx]
+        if getattr(rep, "retired", False):
+            return False       # retired replicas are not rebuilt
         old = rep.engine
         time.sleep(_backoff.delay(self.restarts[idx],
                                   base=self.cfg.backoff_base_s,
@@ -390,11 +419,12 @@ class FleetSupervisor:
         like any other death — the torn directory is swept at its next
         restore."""
         out = {}
-        for idx, rep in enumerate(self.router.replicas):
+        for idx, rep in enumerate(self.router._snapshot()):
             eng = rep.engine
             root = root_override or eng.cfg.prefix_snapshot_root
             if eng._prefix_cache is None or not root \
-                    or getattr(eng, "dead", False):
+                    or getattr(eng, "dead", False) \
+                    or getattr(rep, "retired", False):
                 continue
             try:
                 path = eng.save_prefix_cache(

@@ -465,6 +465,19 @@ class FleetGateway:
                 if tc.page_quota is not None:
                     cache.set_quota(name, tc.page_quota)
 
+    def notify_fleet_changed(self):
+        """The placement set changed under live traffic (autoscaler
+        resize): push tenant page quotas onto any replica that joined
+        since construction, and forget session affinity pointing at
+        replicas that can no longer take placements — the next turn
+        re-homes on whatever the prefix probe finds."""
+        self._apply_page_quotas()
+        reps = self.router._snapshot()
+        stale = [k for k, idx in self._sessions.items()
+                 if idx >= len(reps) or not reps[idx].placeable()]
+        for k in stale:
+            del self._sessions[k]
+
     # -- retry budget ------------------------------------------------------
     def _retry_gate(self, flavor: str) -> bool:
         ok = self.retry_budget.take()
@@ -618,13 +631,15 @@ class FleetGateway:
     # -- pressure + ladder -------------------------------------------------
     def _pressure(self) -> Tuple[float, Optional[float]]:
         """(mean healthy-replica load_score, max digest p95 TTFT ms)."""
-        loads = [rep.load_score() for rep in self.router.replicas
-                 if rep.healthy()]
+        reps = self.router._snapshot()
+        loads = [rep.load_score() for rep in reps if rep.healthy()]
         load = sum(loads) / len(loads) if loads else 0.0
         ttft = None
-        for rep in self.router.replicas:
+        for rep in reps:
             ns = getattr(rep.engine, "metrics_namespace", None)
-            if ns is None:
+            # a retired replica's series is frozen: a stale high p95
+            # must not hold the brownout ladder engaged forever
+            if ns is None or getattr(rep, "retired", False):
                 continue
             q = _metrics.child(ns).histogram(
                 "serving/ttft_ms").quantile(0.95)
@@ -683,8 +698,12 @@ class FleetGateway:
         tenant's namespace; the session's last replica breaks ties and
         stands in when nothing is cached yet."""
         best_idx, best_cov = None, 0
-        for idx, rep in enumerate(self.router.replicas):
-            if not rep.healthy():
+        reps = self.router._snapshot()
+        for idx, rep in enumerate(reps):
+            # draining replicas are finishing their in-flight work on
+            # the way OUT of the fleet: affinity must not pin new
+            # sessions to a cache that is about to retire
+            if not rep.placeable():
                 continue
             cache = getattr(rep.engine, "_prefix_cache", None)
             if cache is None:
@@ -693,12 +712,12 @@ class FleetGateway:
             if cov > best_cov or (
                     cov == best_cov and cov > 0 and best_idx is not None
                     and rep.load_score()
-                    < self.router.replicas[best_idx].load_score()):
+                    < reps[best_idx].load_score()):
                 best_idx, best_cov = idx, cov
         if best_idx is None and session is not None:
             idx = self._sessions.get((tenant, session))
-            if idx is not None and idx < len(self.router.replicas) \
-                    and self.router.replicas[idx].healthy():
+            if idx is not None and idx < len(reps) \
+                    and reps[idx].placeable():
                 best_idx = idx
         return best_idx, best_cov
 

@@ -34,6 +34,7 @@ exact values the ``serving/*`` gauges export.
 """
 from __future__ import annotations
 
+import threading
 import time as _time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -97,6 +98,16 @@ class Replica:
         self.restore_after = max(int(restore_after), 1)
         self._demoted = False
         self._streak = 0       # consecutive passing half-open probes
+        # elastic lifecycle (inference/autoscaler.py): a DRAINING
+        # replica keeps stepping its in-flight work but stops receiving
+        # placements (router ordering and gateway affinity skip it); a
+        # RETIRED replica left the fleet for good — its slot stays in
+        # the replica list so every handle/index minted before the
+        # resize stays valid, but it never serves, probes, or restores
+        # again.  Finished requests on the retained engine keep
+        # answering results().
+        self.draining = False
+        self.retired = False
         # bind the engine's serving/* writes to this replica's child
         # registry (rolls up to the global one) so co-hosted replicas
         # stop conflating their series; restarted engines re-bind to
@@ -106,7 +117,7 @@ class Replica:
             engine.set_metrics_namespace(self.name)
 
     def _probe_raw(self) -> bool:
-        if getattr(self.engine, "dead", False):
+        if self.retired or getattr(self.engine, "dead", False):
             return False
         if self.health_fn is not None:
             try:
@@ -116,9 +127,15 @@ class Replica:
         return True
 
     def healthy(self) -> bool:
-        if self._demoted:
+        if self._demoted or self.retired:
             return False
         return self._probe_raw()
+
+    def placeable(self) -> bool:
+        """Eligible for NEW work: healthy and not draining.  A draining
+        replica stays healthy (it finishes in-flight streams) but the
+        router stops placing on it and affinity probes skip it."""
+        return self.healthy() and not self.draining
 
     def probe(self) -> bool:
         """One health probe with half-open accounting: while demoted,
@@ -170,6 +187,14 @@ class ReplicaRouter:
             r if isinstance(r, Replica) else Replica(r) for r in replicas]
         if not self.replicas:
             raise ValueError("router needs at least one replica")
+        # replica-list mutation guard (autoscaler resizes a live fleet):
+        # add_replica/remove_replica mutate under this lock, and every
+        # traversal (_ordered/step_all/_live_pending) iterates a
+        # SNAPSHOT taken under it — a resize landing mid-step can never
+        # skip or double-step a replica.  Indices are append-only
+        # stable: adds append, removes tombstone in place (Replica.
+        # retired), so a handle's (idx, rid) survives any resize.
+        self._lock = threading.Lock()
         # a requeued request gets this fresh deadline (None: no deadline
         # on the retry — it already burned its first one)
         self.requeue_deadline_s = requeue_deadline_s
@@ -195,20 +220,64 @@ class ReplicaRouter:
         for idx, rep in enumerate(self.replicas):
             rep.engine.requeue_hook = self._make_requeue_hook(idx)
 
+    # -- elastic fleet membership ------------------------------------------
+    def _snapshot(self) -> List[Replica]:
+        """Point-in-time copy of the replica list for lock-free
+        iteration; indices in the copy equal live indices (the list is
+        append-only — removals tombstone in place)."""
+        with self._lock:
+            return list(self.replicas)
+
+    def add_replica(self, replica) -> int:
+        """Admit a new replica (or bare engine) into rotation; returns
+        its stable index.  The replica starts taking traffic on the
+        NEXT ordering pass — callers (the autoscaler) must bring its
+        engine to the fleet's committed weight version first."""
+        rep = replica if isinstance(replica, Replica) \
+            else Replica(replica)
+        with self._lock:
+            idx = len(self.replicas)
+            rep.engine.requeue_hook = self._make_requeue_hook(idx)
+            self.replicas.append(rep)
+        _timeline.emit_event("replica_added", replica=rep.name,
+                             idx=idx)
+        return idx
+
+    def remove_replica(self, idx: int) -> Replica:
+        """Retire replica ``idx`` for good: its slot stays (handles and
+        indices minted before the resize stay valid, finished requests
+        keep answering ``results()``) but it never places, probes, or
+        restores again.  The caller is responsible for draining its
+        in-flight work FIRST (``FleetSupervisor.drain``)."""
+        with self._lock:
+            rep = self.replicas[idx]
+            rep.retired = True
+            rep.draining = False
+            rep._demoted = True
+            rep._streak = 0
+        _timeline.emit_event("replica_retired", replica=rep.name,
+                             idx=idx)
+        return rep
+
+    def fleet_size(self) -> int:
+        """Replicas still in the fleet (draining counts, retired does
+        not) — the autoscaler's notion of current size."""
+        return sum(1 for r in self._snapshot() if not r.retired)
+
     # -- admission ---------------------------------------------------------
     def _ordered(self, exclude: Optional[int] = None,
                  prefer_off_host: Optional[str] = None) -> List[int]:
-        healthy = [i for i, r in enumerate(self.replicas)
-                   if i != exclude and r.healthy()]
+        reps = self._snapshot()
+        healthy = [i for i, r in enumerate(reps)
+                   if i != exclude and r.placeable()]
         if prefer_off_host is not None:
             # drain ordering under host loss: peers OFF the failing host
             # first (they do not share its fate), load-sorted within
             # each group
             return sorted(healthy, key=lambda i: (
-                self.replicas[i].host_id == prefer_off_host,
-                self.replicas[i].load_score()))
-        return sorted(healthy,
-                      key=lambda i: self.replicas[i].load_score())
+                reps[i].host_id == prefer_off_host,
+                reps[i].load_score()))
+        return sorted(healthy, key=lambda i: reps[i].load_score())
 
     def submit(self, prompt_tokens, max_new_tokens=8, sampling=None,
                eos_token_id=None, deadline_s=None, tenant=None,
@@ -221,13 +290,14 @@ class ReplicaRouter:
         EngineOverloadedError only when EVERY healthy replica sheds (the
         fleet is genuinely saturated — or fully demoted), or when the
         ``retry_gate`` vetoes rerouting past a shed."""
+        reps = self._snapshot()
         order = self._ordered()
         if prefer is not None and prefer in order:
             order.remove(prefer)
             order.insert(0, prefer)
         for idx in order:
             try:
-                rid = self.replicas[idx].engine.add_request(
+                rid = reps[idx].engine.add_request(
                     prompt_tokens, max_new_tokens=max_new_tokens,
                     sampling=sampling, eos_token_id=eos_token_id,
                     deadline_s=deadline_s, tenant=tenant)
@@ -243,8 +313,8 @@ class ReplicaRouter:
             self._by_engine[(idx, rid)] = h
             return h
         raise EngineOverloadedError(
-            f"all {len(self.replicas)} replicas saturated or unhealthy "
-            f"({sum(r.healthy() for r in self.replicas)} healthy)")
+            f"all {len(reps)} replicas saturated or unhealthy "
+            f"({sum(r.healthy() for r in reps)} healthy)")
 
     # -- deadline requeue --------------------------------------------------
     def _make_requeue_hook(self, src_idx: int):
@@ -264,8 +334,9 @@ class ReplicaRouter:
                     self._by_engine[(src_idx, info["rid"])] = handle
                 return
             wv = int(info.get("weight_version", 0) or 0)
+            reps = self._snapshot()
             for idx in self._ordered(exclude=src_idx):
-                eng = self.replicas[idx].engine
+                eng = reps[idx].engine
                 # version-bitwise identity across the requeue: the
                 # retry must resume under the version its stream
                 # STARTED on, so replicas not serving (or retaining)
@@ -286,7 +357,7 @@ class ReplicaRouter:
                     continue
                 if hasattr(eng, "pin_weight_version"):
                     eng.pin_weight_version(rid, wv)
-                retry_req = self.replicas[idx].engine._requests[rid]
+                retry_req = eng._requests[rid]
                 retry_req.requeues = n_prior + 1
                 # carry the sampling-salt identity: the retry
                 # regenerates the ORIGINAL stream bitwise (same
@@ -295,7 +366,7 @@ class ReplicaRouter:
                     retry_req.salt_rid = info["salt_rid"]
                     salt_seed = info.get("salt_seed")
                     if salt_seed is None:
-                        salt_seed = self.replicas[src_idx].engine.seed
+                        salt_seed = reps[src_idx].engine.seed
                     retry_req.salt_seed = salt_seed
                 # the retry joins the original request's trace: a
                 # requeue span bridges the evicted request to its new
@@ -304,12 +375,11 @@ class ReplicaRouter:
                 src_trace = info.get("trace")
                 if src_trace is not None:
                     now = _time.perf_counter()
-                    new_req = self.replicas[idx].engine._requests[rid]
+                    new_req = eng._requests[rid]
                     new_req.trace = _tracing.record_span(
                         "serving::requeue", now, now, parent=src_trace,
-                        args={"rid": rid,
-                              "engine": self.replicas[idx].engine.name,
-                              "from": self.replicas[src_idx].name})
+                        args={"rid": rid, "engine": eng.name,
+                              "from": reps[src_idx].name})
                 if handle is not None:
                     self._handles[handle] = (idx, rid)
                     self._by_engine[(idx, rid)] = handle
@@ -331,7 +401,9 @@ class ReplicaRouter:
         from ..distributed.resilience.errors import EngineDeadError
 
         produced: Dict[int, List[int]] = {}
-        for idx, rep in enumerate(self.replicas):
+        for idx, rep in enumerate(self._snapshot()):
+            if rep.retired:
+                continue
             if rep._demoted:
                 rep.probe()
                 if rep._demoted:
@@ -369,8 +441,9 @@ class ReplicaRouter:
         return produced
 
     def _live_pending(self) -> bool:
-        return any(rep.engine.pending() for rep in self.replicas
-                   if not getattr(rep.engine, "dead", False))
+        return any(rep.engine.pending() for rep in self._snapshot()
+                   if not rep.retired
+                   and not getattr(rep.engine, "dead", False))
 
     def run_to_completion(self, max_steps: int = 1000) -> Dict[int, List[int]]:
         for _ in range(max_steps):
@@ -380,21 +453,22 @@ class ReplicaRouter:
         return self.results()
 
     def results(self) -> Dict[int, List[int]]:
+        reps = self._snapshot()
         out = {}
         for h, (idx, rid) in self._handles.items():
-            out[h] = list(
-                self.replicas[idx].engine._requests[rid].generated)
+            out[h] = list(reps[idx].engine._requests[rid].generated)
         return out
 
     def timed_out(self) -> List[int]:
         """Handles whose FINAL placement still timed out (requeue also
         failed or re-expired)."""
+        reps = self._snapshot()
         out = []
         for h, (idx, rid) in self._handles.items():
-            if self.replicas[idx].engine._requests[rid].timed_out:
+            if reps[idx].engine._requests[rid].timed_out:
                 out.append(h)
         return out
 
     def placement(self, handle: int) -> Tuple[str, int]:
         idx, rid = self._handles[handle]
-        return self.replicas[idx].name, rid
+        return self._snapshot()[idx].name, rid

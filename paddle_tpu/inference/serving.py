@@ -103,6 +103,26 @@ class EngineOverloadedError(RuntimeError):
     deep in an unbounded queue."""
 
 
+def resolve_backend_device(backend):
+    """Resolve ``PagedServingConfig.backend`` to a concrete device.
+
+    ``None`` keeps the ambient default (resolution deferred to jax —
+    exactly the pre-seam behavior); a string names a platform and
+    resolves to its first device (``jax.devices(backend)[0]``); a
+    ``jax.Device`` passes through.  The single place engine
+    construction turns a backend HANDLE into placement, so
+    heterogeneous fleets (cpu/tpu/plugin replicas behind one router)
+    differ only in the handle their factory threads through."""
+    if backend is None:
+        return None
+    if isinstance(backend, str):
+        devs = jax.devices(backend)
+        if not devs:
+            raise ValueError(f"backend {backend!r} has no devices")
+        return devs[0]
+    return backend
+
+
 class PagedServingConfig:
     """Engine/model dims for the paged-KV serving path.
 
@@ -124,7 +144,8 @@ class PagedServingConfig:
                  max_batch=4, max_blocks_per_seq=8, token_budget=64,
                  num_kv_heads=None, dtype="float32", cache_quant=None,
                  max_queue=None, prefix_cache=False,
-                 prefix_snapshot_root=None, prefix_page_quota=None):
+                 prefix_snapshot_root=None, prefix_page_quota=None,
+                 backend=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -161,6 +182,13 @@ class PagedServingConfig:
         # pages OWNED (prefix_cache.py quotas; None = unbounded) — the
         # gateway overrides per tenant via PrefixCache.set_quota
         self.prefix_page_quota = prefix_page_quota
+        # backend: an EXPLICIT placement handle for engine construction
+        # — a jax.Device, a platform name ("cpu"/"tpu"/a PJRT plugin),
+        # or None for the process-ambient default (unchanged behavior).
+        # A ReplicaFactory building a heterogeneous fleet sets this per
+        # replica instead of relying on whatever jax.devices() happens
+        # to return first (resolve_backend_device).
+        self.backend = backend
         self.max_seq = max_blocks_per_seq * block_size
 
     @classmethod
@@ -627,20 +655,25 @@ class ServingEngine:
         self._spec_accepted_total = 0
         self.seed = seed
         self.cfg = cfg
+        # explicit placement (heterogeneous fleets): the device= arg
+        # wins, else cfg.backend resolves; None keeps the ambient
+        # default — exactly the pre-seam behavior
+        self._device = device if device is not None \
+            else resolve_backend_device(getattr(cfg, "backend", None))
         L = cfg.num_layers
         shape = (L, cfg.num_blocks, cfg.num_kv_heads, cfg.block_size,
                  cfg.head_dim)
         if cfg.cache_quant == "int8":
             cache_dt = jnp.int8
-            self._ks = jnp.zeros(shape[:-1], jnp.float32)
-            self._vs = jnp.zeros(shape[:-1], jnp.float32)
+            self._ks = self._alloc(shape[:-1], jnp.float32)
+            self._vs = self._alloc(shape[:-1], jnp.float32)
         else:
             cache_dt = jnp.bfloat16 if cfg.dtype == "bfloat16" \
                 else jnp.float32
             self._ks = self._vs = None
         self._cache_dt = cache_dt
-        self._kc = jnp.zeros(shape, cache_dt)
-        self._vc = jnp.zeros(shape, cache_dt)
+        self._kc = self._alloc(shape, cache_dt)
+        self._vc = self._alloc(shape, cache_dt)
         # page 0 is the trash page for padding tokens
         self._free_pages = list(range(1, cfg.num_blocks))
         self._requests = {}
@@ -694,6 +727,14 @@ class ServingEngine:
 
             restore_snapshot(self, cfg.prefix_snapshot_root)
 
+    def _alloc(self, shape, dt):
+        """KV-pool allocation on the engine's resolved device (ambient
+        default when no backend handle was threaded through)."""
+        if self._device is not None:
+            with jax.default_device(self._device):
+                return jnp.zeros(shape, dt)
+        return jnp.zeros(shape, dt)
+
     @classmethod
     def from_model(cls, model: PagedCausalLM, cfg: PagedServingConfig,
                    seed=0, weight_stream=None):
@@ -725,7 +766,10 @@ class ServingEngine:
                 f"'int8', 'int8-noprefetch' or 'int4'")
         eng = cls(None, cfg, seed=seed)
         eng._weight_stream_mode = weight_stream
-        share_key = (cfg.dtype, cfg.cache_quant, weight_stream)
+        # the backend handle joins the share key: engines on different
+        # devices must not share one staged weight copy or executable
+        share_key = (cfg.dtype, cfg.cache_quant, weight_stream,
+                     str(getattr(cfg, "backend", None)))
         cached = getattr(model, "_serving_shared", None)
         if cached is not None and cached[0] == share_key:
             (_, eng._compiled, eng._compiled_fresh,

@@ -267,6 +267,12 @@ class WeightPublisher:
         self._transport_factory = transport_factory
         self.version = 0          # last fleet-committed epoch
         self._next = 1            # next epoch a publish will claim
+        # True while a publish() epoch is between its fence claim and
+        # its terminal state (committed/rejected).  The autoscaler
+        # freezes resize actions on this flag: a replica joining
+        # mid-promote would race the payload build, and one retiring
+        # mid-canary could strand the only staged copy.
+        self.in_flight = False
         # per-version source params (host) + per-(version, mode) payload
         # cache: catch_up rebuilds any mode a late replica needs, and
         # rollback re-anchors on the PREVIOUS version's source — so two
@@ -477,6 +483,16 @@ class WeightPublisher:
                                        fence_version=self.version)
         # epoch claim precedes any byte hitting any replica
         self._fence(v, "staging")
+        self.in_flight = True
+        try:
+            return self._publish_epoch(v, t0, live, params,
+                                       draft_params)
+        finally:
+            self.in_flight = False
+
+    def _publish_epoch(self, v: int, t0: float, live, params,
+                       draft_params) -> PublishReport:
+        from ..jit import functional as FB
         src = params if params is not None \
             else FB.current_params(self.model)
         src = {k: np.asarray(jax.device_get(a)) for k, a in src.items()}

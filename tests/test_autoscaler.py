@@ -1,0 +1,839 @@
+"""AutoScaler (ISSUE 18): elastic fleet resizing — drain-safe
+retirement, catch-up-gated scale-up, flap-proof hysteresis, and the
+spawn/retire chaos sites.
+
+Layers under test:
+
+  * `inference/router.py` — elastic membership: `add_replica` /
+    `remove_replica` with append-only stable indices (removes
+    tombstone in place), the DRAINING lifecycle state
+    (`Replica.placeable`), and snapshot-under-lock traversal.
+  * `inference/autoscaler.py` — the synchronous control loop:
+    consecutive-eval hysteresis, cooldown, min/max clamps, the
+    publish-epoch / SLO-alert freezes, spawn retry under
+    `max_spawn_failures`, catch-up as the admission gate, drain
+    before retire.
+  * `inference/fleet_supervisor.py` + `weight_publish.py` — a FRESHLY
+    SPAWNED replica converges on the fleet's committed weight version
+    through the same `weight_catchup` hook that covers restarts.
+  * `resilience/faults.py` — `kill@spawn` (partial replica swept,
+    fleet keeps serving) and `kill@retire` (drain falls back to the
+    requeue path, zero lost requests).
+
+Bitwise identity is the invariant throughout: sampling salts depend
+only on (salt_seed, salt_rid, token index), so streams survive any
+resize — placement on a spawned replica, drain off a retiring one —
+token-for-token.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.inference.autoscaler import (AutoScaler, AutoScalerConfig,
+                                             InProcessReplicaFactory,
+                                             ReplicaFactory, SpawnError)
+from paddle_tpu.inference.fleet_supervisor import FleetSupervisor
+from paddle_tpu.inference.gateway import (FleetGateway, GatewayConfig,
+                                          default_classes)
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import (PagedCausalLM,
+                                          PagedServingConfig,
+                                          SamplingParams, ServingEngine)
+from paddle_tpu.inference.weight_publish import WeightPublisher
+from paddle_tpu.jit import functional as FB
+from paddle_tpu.profiler import metrics as _metrics
+from paddle_tpu.profiler import timeline as _timeline
+from paddle_tpu.profiler import tracing as _tracing
+from paddle_tpu.profiler.headroom import ScaleAdvice, ScaleAdvisor
+from paddle_tpu.profiler.timeline import Timeline
+
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+
+SP = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.disarm()
+    _tracing.flight.detach("timeline")
+    _tracing.set_flight_dir(None)
+    for tl in list(_timeline._sinks):
+        _timeline.uninstall(tl)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(3)
+    m = PagedCausalLM(PagedServingConfig(**BASE))
+    m.eval()
+    return m
+
+
+def _fresh_engine(model, seed=0, **over):
+    cfg = PagedServingConfig(**{**BASE, **over})
+    return ServingEngine.from_model(model, cfg, seed=seed)
+
+
+def _fleet(model, n=2, **over):
+    router = ReplicaRouter(
+        [Replica(_fresh_engine(model, seed=10 + i, **over),
+                 name=f"r{i}") for i in range(n)])
+    sup = FleetSupervisor(
+        router,
+        engine_factory=lambda i: _fresh_engine(model, seed=10 + i,
+                                               **over))
+    return router, sup
+
+
+def _prompts(n, rng_seed=7, length=10):
+    rng = np.random.RandomState(rng_seed)
+    return [list(rng.randint(1, BASE["vocab_size"], length))
+            for _ in range(n)]
+
+
+def _hold():
+    return ScaleAdvice("hold", "scripted", 0.5, None, None, None)
+
+
+def _up():
+    return ScaleAdvice("scale_up", "scripted storm", 1.5, None, None,
+                       None)
+
+
+def _down(candidates=()):
+    return ScaleAdvice("scale_down", "scripted calm", 0.1, None, None,
+                       None, drain_candidates=list(candidates))
+
+
+class StubAdvisor:
+    """Scripted advisories — the last one repeats once the script is
+    spent, so long drive loops stay deterministic."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.tracker = None
+
+    def recommend(self, replica_loads=None, now=None):
+        if len(self.script) > 1:
+            return self.script.pop(0)
+        return self.script[0] if self.script else _hold()
+
+
+def _scaler(model, router, sup, advisor, cfg=None, **kw):
+    factory = kw.pop("factory", None) or InProcessReplicaFactory(
+        model, PagedServingConfig(**BASE), seed_base=100)
+    return AutoScaler(router, sup, advisor, factory,
+                      cfg or AutoScalerConfig(
+                          min_replicas=1, max_replicas=4,
+                          scale_up_after=1, scale_down_after=1,
+                          cooldown_evals=0, spawn_backoff_base_s=0.0,
+                          spawn_backoff_cap_s=0.0), **kw)
+
+
+def _regenerate(model, prompt, salt_rid, salt_seed, max_new,
+                version=0, publisher_ref=None):
+    """Fixed-reference regeneration of one stream under its recorded
+    salt identity (and pinned weight version)."""
+    eng = publisher_ref[version] if publisher_ref else _fresh_engine(
+        model, seed=0)
+    rid = eng.add_request(list(prompt), max_new_tokens=max_new,
+                          sampling=SP)
+    r = eng._requests[rid]
+    r.salt_rid, r.salt_seed = salt_rid, int(salt_seed)
+    if version > 0:
+        eng.pin_weight_version(rid, version)
+    while not r.done:
+        eng.step()
+    return list(r.generated)
+
+
+def _assert_bitwise(model, router, out, prompts_by_handle, max_new,
+                    publisher_ref=None):
+    for h, prompt in prompts_by_handle.items():
+        idx, rid = router._handles[h]
+        eng = router.replicas[idx].engine
+        r = eng._requests[rid]
+        seed = eng.seed if r.salt_seed is None else r.salt_seed
+        ref = _regenerate(model, prompt, r.salt_rid, seed, max_new,
+                          version=int(getattr(r, "weight_version", 0)
+                                      or 0),
+                          publisher_ref=publisher_ref)
+        assert out[h] == ref, f"stream {h} diverged after resize"
+
+
+def _perturbed(model, noise_seed=5):
+    nrng = np.random.RandomState(noise_seed)
+    out = {}
+    for k, v in FB.current_params(model).items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            f = a.astype(np.float32)
+            out[k] = (f + nrng.normal(
+                0.0, 0.03 * (np.std(f) + 1e-6), f.shape)).astype(a.dtype)
+        else:
+            out[k] = a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# router: elastic membership + draining lifecycle
+# ---------------------------------------------------------------------------
+
+def test_add_replica_keeps_existing_handles_and_serves_new_traffic(
+        model):
+    router, sup = _fleet(model, n=2)
+    prompts = _prompts(3)
+    handles = [router.submit(p, max_new_tokens=4, sampling=SP)
+               for p in prompts]
+    for _ in range(2):
+        router.step_all()
+    before = {h: router._handles[h] for h in handles}
+
+    idx = router.add_replica(_fresh_engine(model, seed=50))
+    assert idx == 2
+    assert router.fleet_size() == 3
+    # pre-resize handles kept their (idx, rid) mapping
+    assert {h: router._handles[h] for h in handles} == before
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 4 for h in handles)
+    # the new replica is placeable and draws fresh admissions
+    assert 2 in router._ordered()
+
+
+def test_remove_replica_tombstones_slot_and_results_survive(model):
+    router, sup = _fleet(model, n=3)
+    prompts = _prompts(4)
+    handles = [router.submit(p, max_new_tokens=4, sampling=SP)
+               for p in prompts]
+    out = router.run_to_completion()
+    victim = next(idx for h in handles
+                  for idx, _ in [router._handles[h]])
+    rep = router.remove_replica(victim)
+    assert rep.retired and not rep.draining
+    # the slot stays: indices stable, finished streams still answer
+    assert len(router.replicas) == 3
+    assert router.fleet_size() == 2
+    assert router.results() == out
+    # a retired replica never places, probes healthy, or steps
+    assert victim not in router._ordered()
+    assert not rep.healthy() and not rep.placeable()
+    assert rep.probe() is False
+    stepped = router.step_all()
+    assert stepped == {}
+    # the supervisor never restarts a tombstone
+    rep.engine.dead = True
+    assert sup.restart(victim) is False
+
+
+def test_draining_replica_finishes_in_flight_but_takes_no_new_work(
+        model):
+    router, _ = _fleet(model, n=2)
+    p = _prompts(1)[0]
+    h = router.submit(p, max_new_tokens=5, sampling=SP)
+    idx, _ = router._handles[h]
+    rep = router.replicas[idx]
+    rep.draining = True
+    assert rep.healthy() and not rep.placeable()
+    assert idx not in router._ordered()
+    # new work lands on the other replica
+    h2 = router.submit(_prompts(2)[1], max_new_tokens=5, sampling=SP)
+    assert router._handles[h2][0] != idx
+    # but the in-flight stream still steps to completion on the
+    # draining replica itself
+    out = router.run_to_completion()
+    assert len(out[h]) == 5
+    assert router._handles[h][0] == idx
+
+
+def test_gateway_affinity_skips_draining_and_notify_drops_sessions(
+        model):
+    router, _ = _fleet(model, n=2)
+    cls = default_classes()
+    for c in cls.values():
+        c.deadline_s = None
+    gw = FleetGateway(router, GatewayConfig(classes=cls))
+    t = gw.submit(_prompts(1)[0], max_new_tokens=3, sampling=SP,
+                  tenant="t0", session="s0")
+    gw.run_to_completion()
+    assert ("t0", "s0") in gw._sessions
+    idx = gw._sessions[("t0", "s0")]
+    router.replicas[idx].draining = True
+    gw.notify_fleet_changed()
+    # the sticky session no longer points at a non-placeable replica
+    assert ("t0", "s0") not in gw._sessions
+
+
+# ---------------------------------------------------------------------------
+# scaler: hysteresis, clamps, freezes
+# ---------------------------------------------------------------------------
+
+def test_consecutive_eval_hysteresis_and_cooldown(model):
+    router, sup = _fleet(model, n=2)
+    sc = _scaler(model, router, sup, StubAdvisor(_up()),
+                 cfg=AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                      scale_up_after=3,
+                                      scale_down_after=2,
+                                      cooldown_evals=2,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0))
+    # two up-votes are not enough; the third acts
+    assert sc.evaluate()["action"] == "hold"
+    assert sc.evaluate()["action"] == "hold"
+    assert sc.evaluate()["action"] == "scale_up"
+    assert router.fleet_size() == 3
+    # cooldown freezes the next two evaluations even under pressure
+    assert sc.evaluate() == {"action": "frozen", "reason": "cooldown",
+                             "size": 3}
+    assert sc.evaluate()["action"] == "frozen"
+    # a single hold resets the up-streak: no immediate action after
+    sc.advisor = StubAdvisor(_hold(), _up(), _up(), _up())
+    assert sc.evaluate()["action"] == "hold"
+    assert sc.evaluate()["action"] == "hold"
+    assert sc.evaluate()["action"] == "hold"
+    assert sc.evaluate()["action"] == "scale_up"
+
+
+def test_min_max_clamps(model):
+    router, sup = _fleet(model, n=2)
+    sc = _scaler(model, router, sup, StubAdvisor(_down(["r0"])),
+                 cfg=AutoScalerConfig(min_replicas=2, max_replicas=2,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=0))
+    assert sc.evaluate() == {"action": "hold",
+                             "reason": "at min_replicas", "size": 2}
+    sc.advisor = StubAdvisor(_up())
+    assert sc.evaluate() == {"action": "hold",
+                             "reason": "at max_replicas", "size": 2}
+    assert router.fleet_size() == 2
+
+
+def test_freeze_on_publish_in_flight_and_slo_alert(model):
+    router, sup = _fleet(model, n=2)
+
+    class Pub:
+        in_flight = True
+        version = 0
+
+    class Trk:
+        def active_alerts(self):
+            return [object()]
+
+    sc = _scaler(model, router, sup, StubAdvisor(_up()), publisher=Pub())
+    f0 = _metrics.counter("autoscale/frozen_evals").value
+    assert sc.evaluate() == {"action": "frozen",
+                             "reason": "publish_in_flight", "size": 2}
+    sc.publisher = None
+    sc.tracker = Trk()
+    assert sc.evaluate() == {"action": "frozen",
+                             "reason": "slo_alert_active", "size": 2}
+    assert _metrics.counter("autoscale/frozen_evals").value == f0 + 2
+    # both freezes cleared: the pressure finally executes
+    sc.tracker = None
+    assert sc.evaluate()["action"] == "scale_up"
+
+
+def test_no_resize_during_live_publish_epoch(model):
+    """The real freeze window: WeightPublisher.in_flight spans the
+    fence claim to the terminal state, so an evaluation landing inside
+    a LIVE publish() epoch is frozen — membership cannot change under
+    the fence."""
+    router, sup = _fleet(model, n=2)
+    pub = WeightPublisher(router, model, supervisor=sup)
+    sc = _scaler(model, router, sup, StubAdvisor(_up()), publisher=pub)
+    seen = []
+    orig = pub._publish_epoch
+
+    def epoch_spy(v, t0, live, params, draft_params):
+        seen.append(sc.evaluate())
+        return orig(v, t0, live, params, draft_params)
+
+    pub._publish_epoch = epoch_spy
+    pub.publish(params=_perturbed(model))
+    assert seen == [{"action": "frozen", "reason": "publish_in_flight",
+                     "size": 2}]
+    assert router.fleet_size() == 2
+    assert pub.in_flight is False
+    # the epoch is terminal: the same pressure now executes
+    assert sc.evaluate()["action"] == "scale_up"
+    assert router.replicas[2].engine.active_weight_version == pub.version
+
+
+def test_gateway_pressure_outvotes_stale_hold(model):
+    router, sup = _fleet(model, n=2)
+    cls = default_classes()
+    for c in cls.values():
+        c.deadline_s = None
+    gw = FleetGateway(router, GatewayConfig(classes=cls))
+    sc = _scaler(model, router, sup, StubAdvisor(_hold()), gateway=gw)
+    sc.cfg.queue_depth_high = 1
+    assert sc.evaluate()["action"] == "hold"
+    # a queued backlog the recorded windows never saw: up-vote
+    gw.submit(_prompts(1)[0], max_new_tokens=3, sampling=SP,
+              tenant="t0")
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_up"
+    assert "queue depth" in rec["reason"]
+
+
+# ---------------------------------------------------------------------------
+# spawn failure handling
+# ---------------------------------------------------------------------------
+
+class FailingFactory(ReplicaFactory):
+    def __init__(self, fail_times, inner):
+        self.fail_times = fail_times
+        self.inner = inner
+        self.attempts = 0
+
+    def build(self, slot):
+        self.attempts += 1
+        if self.attempts <= self.fail_times:
+            raise SpawnError(f"scripted failure {self.attempts}")
+        return self.inner.build(slot)
+
+
+def test_spawn_failures_bounded_and_fleet_unchanged(model):
+    router, sup = _fleet(model, n=2)
+    inner = InProcessReplicaFactory(model, PagedServingConfig(**BASE),
+                                    seed_base=100)
+    factory = FailingFactory(99, inner)      # never succeeds
+    sc = _scaler(model, router, sup, StubAdvisor(_up()),
+                 factory=factory,
+                 cfg=AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=1,
+                                      max_spawn_failures=3,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0))
+    sf0 = _metrics.counter("autoscale/spawn_failures").value
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_up_failed"
+    assert factory.attempts == 3             # exactly max_spawn_failures
+    assert router.fleet_size() == 2          # fleet untouched
+    assert sc.spawn_failures == 3
+    assert _metrics.counter("autoscale/spawn_failures").value == sf0 + 3
+    # the failure starts a cooldown: no immediate retry storm
+    assert sc.evaluate()["reason"] == "cooldown"
+    # a later recovery succeeds through the same loop
+    factory.fail_times = 0
+    assert sc.evaluate()["action"] == "scale_up"
+    assert router.fleet_size() == 3
+
+
+def test_catchup_timeout_tears_down_spawn(model):
+    router, sup = _fleet(model, n=2)
+    clk = [0.0]
+
+    def slow_catchup(engine):
+        clk[0] += 60.0                        # converges far too late
+
+    sup.weight_catchup = slow_catchup
+    sc = _scaler(model, router, sup, StubAdvisor(_up()),
+                 cfg=AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=0,
+                                      catchup_timeout_s=5.0,
+                                      max_spawn_failures=2,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0),
+                 clock=lambda: clk[0])
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_up_failed"
+    assert router.fleet_size() == 2
+    assert sc.spawn_failures == 2
+
+
+# ---------------------------------------------------------------------------
+# fresh-spawn weight catch-up (the satellite 3 contract)
+# ---------------------------------------------------------------------------
+
+def _publisher_refs(model, pub, params):
+    """{version: fresh single engine committed at that version} — the
+    bitwise referee for pinned streams."""
+    from paddle_tpu.inference.weight_publish import build_weight_set
+
+    refs = {0: _fresh_engine(model, seed=0)}
+    if pub.version > 0:
+        arrays, crcs = build_weight_set(model, params, refs[0].cfg)
+        r1 = _fresh_engine(model, seed=0)
+        r1.stage_weight_set(pub.version, arrays, crcs=crcs)
+        r1.commit_weight_set(pub.version)
+        refs[pub.version] = r1
+    return refs
+
+
+def test_spawn_mid_epoch_serves_committed_version_bitwise(model):
+    """A replica spawned AFTER a publish lands must serve the
+    committed version from its first request — and those streams must
+    be bitwise-identical to a fixed reference committed at the same
+    version."""
+    router, sup = _fleet(model, n=2)
+    pub = WeightPublisher(router, model, supervisor=sup)
+    params = _perturbed(model)
+    pub.publish(params=params)
+    assert pub.version == 1
+
+    sc = _scaler(model, router, sup, StubAdvisor(_up()), publisher=pub)
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_up"
+    spawned = router.replicas[2]
+    # the catch-up gate: committed version BEFORE any placement
+    assert spawned.engine.active_weight_version == 1
+    assert spawned.placeable()
+
+    # saturate the originals so admissions spill onto the spawn
+    prompts = _prompts(6, rng_seed=11)
+    by_handle = {}
+    for p in prompts:
+        h = router.submit(p, max_new_tokens=5, sampling=SP)
+        by_handle[h] = p
+    placements = {router._handles[h][0] for h in by_handle}
+    assert 2 in placements, "spawned replica drew no traffic"
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 5 for h in by_handle)
+    # every stream pinned to the committed version, bitwise vs the
+    # fixed reference
+    refs = _publisher_refs(model, pub, params)
+    _assert_bitwise(model, router, out, by_handle, 5,
+                    publisher_ref=refs)
+
+
+def test_spawn_racing_concurrent_publish_lands_on_final_version(model):
+    """A publish landing WHILE the spawn is being built (after
+    factory.build, before catch-up) must not leave the new replica
+    behind: catch-up runs after the race and converges it on the FINAL
+    committed version."""
+    router, sup = _fleet(model, n=2)
+    pub = WeightPublisher(router, model, supervisor=sup)
+    params = _perturbed(model)
+
+    class RacingFactory(InProcessReplicaFactory):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.raced = False
+
+        def build(self, slot):
+            rep = super().build(slot)
+            if not self.raced:
+                self.raced = True
+                pub.publish(params=params)    # lands mid-spawn
+            return rep
+
+    factory = RacingFactory(model, PagedServingConfig(**BASE),
+                            seed_base=100)
+    sc = _scaler(model, router, sup, StubAdvisor(_up()),
+                 factory=factory, publisher=pub)
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_up"
+    assert pub.version == 1
+    spawned = router.replicas[2]
+    assert spawned.engine.active_weight_version == pub.version
+    # and it actually serves: streams under the final version match
+    # the fixed reference
+    p = _prompts(1, rng_seed=13)[0]
+    h = router.submit(p, max_new_tokens=4, sampling=SP,
+                      prefer=2)
+    assert router._handles[h][0] == 2
+    out = router.run_to_completion()
+    refs = _publisher_refs(model, pub, params)
+    _assert_bitwise(model, router, out, {h: p}, 4, publisher_ref=refs)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the spawn and retire sites
+# ---------------------------------------------------------------------------
+
+def test_kill_at_spawn_sweeps_partial_replica_fleet_keeps_serving(
+        model):
+    router, sup = _fleet(model, n=2)
+    prompts = _prompts(3, rng_seed=17)
+    by_handle = {}
+    for p in prompts:
+        h = router.submit(p, max_new_tokens=5, sampling=SP)
+        by_handle[h] = p
+    for _ in range(2):
+        router.step_all()
+
+    faults.arm("kill@spawn#1")
+    sc = _scaler(model, router, sup, StubAdvisor(_up()),
+                 cfg=AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=0,
+                                      max_spawn_failures=3,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0))
+    rec = sc.evaluate()
+    # first attempt died mid-catch-up and was swept; the retry landed
+    assert rec["action"] == "scale_up"
+    assert rec["attempts"] == 2
+    assert sc.spawn_failures == 1
+    assert router.fleet_size() == 3
+    assert len(router.replicas) == 3         # the corpse never joined
+    # in-flight traffic survived the failed spawn, bitwise
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 5 for h in by_handle)
+    _assert_bitwise(model, router, out, by_handle, 5)
+
+
+def test_kill_at_retire_falls_back_to_requeue_zero_lost(model):
+    router, sup = _fleet(model, n=3)
+    prompts = _prompts(5, rng_seed=19)
+    by_handle = {}
+    for p in prompts:
+        h = router.submit(p, max_new_tokens=6, sampling=SP)
+        by_handle[h] = p
+    for _ in range(2):
+        router.step_all()
+    # the victim must genuinely hold in-flight work
+    victim_idx = next(i for i, rep in enumerate(router.replicas)
+                      if rep.engine.pending())
+    victim = router.replicas[victim_idx]
+
+    faults.arm("kill@retire#1")
+    requeues0 = _metrics.counter("serving/drain_requeues").value
+    sc = _scaler(model, router, sup,
+                 StubAdvisor(_down([victim.name])),
+                 cfg=AutoScalerConfig(min_replicas=2, max_replicas=4,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=0))
+    rec = sc.evaluate()
+    assert rec["action"] == "scale_down"
+    assert rec["replica"] == victim.name
+    # the chaos kill felled the engine mid-drain: migration was
+    # impossible, the requeue fallback carried every stream
+    assert victim.engine.dead
+    assert victim.retired
+    assert _metrics.counter("serving/drain_requeues").value > requeues0
+    out = router.run_to_completion()
+    assert all(len(out[h]) == 6 for h in by_handle), \
+        "a request was lost in the drain"
+    _assert_bitwise(model, router, out, by_handle, 6)
+
+
+def test_faultplan_rejects_frame_kinds_at_resize_sites():
+    faults.parse_plan("kill@spawn#1,delay@retire:ms=2,kill@retire#1")
+    with pytest.raises(ValueError, match="spawn"):
+        faults.parse_plan("drop@spawn#1")
+    with pytest.raises(ValueError, match="retire"):
+        faults.parse_plan("corrupt@retire%0.5")
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics, events, flight dumps, fleetboard
+# ---------------------------------------------------------------------------
+
+def test_resize_events_land_in_timeline_and_flight_dump(model,
+                                                        tmp_path):
+    router, sup = _fleet(model, n=2)
+    clk = [0.0]
+    tl = Timeline(registry=_metrics.registry(), clock=lambda: clk[0])
+    _timeline.install(tl)
+    tl.attach_flight(n=50)
+    _tracing.set_flight_dir(str(tmp_path))
+
+    sc = _scaler(model, router, sup,
+                 StubAdvisor(_up(), _down(["r0"])),
+                 cfg=AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                      scale_up_after=1,
+                                      scale_down_after=1,
+                                      cooldown_evals=0,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0))
+    a0 = _metrics.counter("autoscale/actions").value
+    assert sc.evaluate()["action"] == "scale_up"
+    assert sc.evaluate()["action"] == "scale_down"
+    assert _metrics.counter("autoscale/actions").value == a0 + 2
+    clk[0] += 5.0
+    tl.sample()
+    kinds = [ev["kind"] for w in tl.windows() for ev in w["events"]]
+    assert "autoscale_action" in kinds
+    assert "autoscale_draining" in kinds
+    assert "replica_added" in kinds and "replica_retired" in kinds
+    # a flight dump mid-incident embeds the resize history
+    path = _tracing.flight_dump("resize_postmortem")
+    with open(path) as f:
+        dump = json.load(f)
+    dumped = [ev["kind"] for w in dump["timeline"]
+              for ev in w.get("events", ())]
+    assert "autoscale_action" in dumped
+    # catch-up/drain latencies observed
+    assert _metrics.registry().histogram(
+        "autoscale/catchup_ms").count >= 1
+    assert _metrics.registry().histogram(
+        "autoscale/drain_ms").count >= 1
+
+
+def test_autoscale_metrics_are_known_to_trace_report():
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_tr", os.path.join(root, "tools", "trace_report.py"))
+    tr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr)
+    for name in ("autoscale/actions", "autoscale/spawn_failures",
+                 "autoscale/catchup_ms", "autoscale/drain_ms",
+                 "autoscale/frozen_evals", "autoscale/fleet_size"):
+        assert tr._known(name), f"{name} unknown to trace_report"
+
+
+def test_fleetboard_renders_autoscaler_panel():
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_fb", os.path.join(root, "tools", "fleetboard.py"))
+    fb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fb)
+    wins = [
+        {"t": 0.0, "seq": 0, "gauges": {}, "counters": {}, "events": [
+            {"kind": "autoscale_frozen", "reason": "publish_in_flight",
+             "size": 2}]},
+        {"t": 5.0, "seq": 1, "gauges": {}, "counters": {}, "events": [
+            {"kind": "autoscale_action", "action": "scale_up",
+             "replica": "auto2", "idx": 2, "size": 3,
+             "reason": "load high"},
+            {"kind": "autoscale_draining", "replica": "r0", "idx": 0}]},
+    ]
+    text = fb.render(wins)
+    assert "last action: scale_up auto2 -> fleet size 3" in text
+    assert "frozen evals: 1" in text
+    assert "STUCK DRAINING: r0" in text
+
+
+# ---------------------------------------------------------------------------
+# backend-handle seam (PagedServingConfig(backend=))
+# ---------------------------------------------------------------------------
+
+def test_backend_handle_threads_into_engine_construction(model):
+    import jax
+
+    from paddle_tpu.inference.serving import resolve_backend_device
+
+    assert resolve_backend_device(None) is None
+    dev = jax.devices("cpu")[0]
+    assert resolve_backend_device("cpu") == dev
+    assert resolve_backend_device(dev) is dev
+    with pytest.raises(RuntimeError):
+        resolve_backend_device("no_such_platform")
+
+    # default behavior unchanged: no backend -> ambient placement
+    assert _fresh_engine(model, seed=60)._device is None
+    # explicit backend: caches allocated under the named device, and
+    # the share key forks (engines on different backends must not
+    # share a staged weight copy)
+    eng = _fresh_engine(model, seed=61, backend="cpu")
+    assert eng._device == dev
+    assert list(eng._kc.devices()) == [dev]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance storm: grow under fire, shrink in the calm
+# ---------------------------------------------------------------------------
+
+def test_autoscale_storm_acceptance(model):
+    """The ISSUE 18 acceptance walk, end to end: a 4x storm drives the
+    2-replica fleet to 4 — the new replicas serve only after catch-up
+    to the committed publish version, with ``kill@spawn`` felling one
+    attempt (retried within ``max_spawn_failures`` while the fleet
+    keeps serving) — then the post-storm calm drains back down with
+    requests still in flight.  Zero requests lost; every stream
+    token-bitwise-identical to the fixed-fleet reference."""
+    router, sup = _fleet(model, n=2)
+    pub = WeightPublisher(router, model, supervisor=sup)
+    params = _perturbed(model)
+    pub.publish(params=params)
+
+    clk = [0.0]
+    reg = _metrics.MetricsRegistry()
+    tl = Timeline(registry=reg, clock=lambda: clk[0])
+    advisor = ScaleAdvisor(tl, window_s=30.0, min_windows=2,
+                           high_load=0.8, low_load=0.3)
+    load_gauge = reg.gauge("gateway/load_score")
+    sc = _scaler(model, router, sup, advisor, publisher=pub,
+                 cfg=AutoScalerConfig(min_replicas=2, max_replicas=4,
+                                      scale_up_after=2,
+                                      scale_down_after=2,
+                                      cooldown_evals=1,
+                                      max_spawn_failures=3,
+                                      spawn_backoff_base_s=0.0,
+                                      spawn_backoff_cap_s=0.0))
+
+    def tick():
+        # mean placeable load -> the gauge the advisor reads (exactly
+        # the gateway's definition)
+        reps = [r for r in router._snapshot() if r.placeable()]
+        load_gauge.set(sum(r.load_score() for r in reps)
+                       / max(len(reps), 1))
+        clk[0] += 5.0
+        tl.sample()
+        return sc.evaluate()
+
+    # -- storm: 4x the calm arrival volume, kill@spawn on one attempt
+    faults.arm("kill@spawn#1")
+    prompts = _prompts(8, rng_seed=23)
+    by_handle = {}
+    for p in prompts:
+        h = router.submit(p, max_new_tokens=6, sampling=SP)
+        by_handle[h] = p
+    grew_at = None
+    for i in range(60):
+        router.step_all()
+        rec = tick()
+        if router.fleet_size() == 4 and grew_at is None:
+            grew_at = i
+        if not router._live_pending() and router.fleet_size() == 4:
+            break
+    assert router.fleet_size() == 4, "storm never grew the fleet"
+    assert sc.spawn_failures >= 1            # the chaos kill fired
+    faults.disarm()
+    # the spawned replicas entered at the committed version
+    for rep in router._snapshot():
+        if not rep.retired:
+            assert rep.engine.active_weight_version == pub.version
+
+    # -- calm: late requests still decoding while the fleet shrinks
+    late = _prompts(2, rng_seed=29, length=8)
+    for p in late:
+        h = router.submit(p, max_new_tokens=8, sampling=SP)
+        by_handle[h] = p
+    router.step_all()                        # genuinely mid-decode
+    for _ in range(200):
+        router.step_all()
+        tick()
+        if router.fleet_size() == 2 and not router._live_pending():
+            break
+    assert router.fleet_size() == 2, "calm never drained the fleet"
+
+    out = router.run_to_completion()
+    # zero lost: every admitted request completed in full
+    for h, p in by_handle.items():
+        want = 8 if p in late else 6
+        assert len(out[h]) == want, f"stream {h} lost in the resize"
+    # bitwise: every stream equals the fixed-reference regeneration
+    # under its pinned version and origin salt identity
+    refs = _publisher_refs(model, pub, params)
+    for h, p in by_handle.items():
+        idx, rid = router._handles[h]
+        eng = router.replicas[idx].engine
+        r = eng._requests[rid]
+        seed = eng.seed if r.salt_seed is None else r.salt_seed
+        ref = _regenerate(model, p, r.salt_rid, seed,
+                          8 if p in late else 6,
+                          version=int(getattr(r, "weight_version", 0)
+                                      or 0),
+                          publisher_ref=refs)
+        assert out[h] == ref, f"stream {h} diverged across the resize"

@@ -106,8 +106,9 @@ def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
     assert gate == ["llama_train", "eager_dispatch", "serving",
                     "spec_decode", "fleet", "fleet_recovery",
-                    "host_recovery", "weight_publish", "gateway_storm"]
-    assert len(bench.WORKLOADS) == 14
+                    "host_recovery", "weight_publish", "gateway_storm",
+                    "autoscale_storm"]
+    assert len(bench.WORKLOADS) == 15
 
 
 # ---------------------------------------------------------------------------

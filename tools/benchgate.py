@@ -186,6 +186,18 @@ _SPEC_GATES = {"tokens_per_sec": True, "accept_rate": True,
 # goodput under the rollout must not sag past the normal threshold.
 _PUBLISH_GATES = {"requests_completed": True, "bitwise_match": True,
                   "goodput_rps": True, "publish_s": False}
+# autoscale_storm: the fleet RESIZES under a 4x admit storm (ISSUE 18)
+# — scale-up with catch-up-gated entry (kill@spawn fells the first
+# attempt), then a drain-down while late traffic is in flight.
+# requests_completed and bitwise_match are zero-slack — a resize may
+# never lose an admitted request, and every stream must match the
+# fixed-fleet reference bitwise whether it was placed on a spawned
+# replica or drained off a retiring one; scale-up reaction time must
+# not rise and goodput under the resize must not sag past the normal
+# threshold.  Old baselines without the row skip it (set
+# intersection), so the gate phases in.
+_AUTOSCALE_GATES = {"requests_completed": True, "bitwise_match": True,
+                    "goodput_rps": True, "scaleup_to_traffic_s": False}
 _CHAOS_ROWS = (
     # fleet_recovery: one replica killed mid-decode; host_recovery: a
     # whole host's replicas felled at once; gateway_storm: every
@@ -198,6 +210,8 @@ _CHAOS_ROWS = (
      ("interactive_completed", "interactive_slo_attainment")),
     ("spec_decode", _SPEC_GATES, ("bitwise_match",)),
     ("weight_publish", _PUBLISH_GATES,
+     ("requests_completed", "bitwise_match")),
+    ("autoscale_storm", _AUTOSCALE_GATES,
      ("requests_completed", "bitwise_match")),
 )
 _RECOVERY_ROWS = tuple(r for r, _, _ in _CHAOS_ROWS)

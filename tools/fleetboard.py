@@ -130,6 +130,46 @@ DEFAULT_METRICS = ("gateway/load_score", "gateway/brownout_level",
                    "gateway/outcome/completed")
 
 
+def _autoscaler_panel(windows: List[dict]) -> List[str]:
+    """Resize history from the timeline's autoscale_* events: the
+    last executed action, the current fleet size it left behind, and
+    any replica stuck DRAINING (an autoscale_draining event with no
+    matching retirement — the RUNBOOK's stuck-drain walk starts
+    here)."""
+    evs = [ev for w in windows for ev in w.get("events", ())
+           if str(ev.get("kind", "")).startswith("autoscale")]
+    if not evs:
+        return []
+    lines = ["autoscaler:"]
+    actions = [e for e in evs if e.get("kind") == "autoscale_action"]
+    if actions:
+        last = actions[-1]
+        lines.append(f"  last action: {last.get('action')} "
+                     f"{last.get('replica', '?')} -> fleet size "
+                     f"{last.get('size', '?')} "
+                     f"({last.get('reason', '')})")
+    frozen = [e for e in evs if e.get("kind") == "autoscale_frozen"]
+    if frozen:
+        lines.append(f"  frozen evals: {len(frozen)} "
+                     f"(last: {frozen[-1].get('reason')})")
+    failed = [e for e in evs
+              if e.get("kind") in ("autoscale_spawn_retry",
+                                   "autoscale_spawn_failed")]
+    if failed:
+        lines.append(f"  spawn retries/failures: {len(failed)} "
+                     f"(last: {failed[-1].get('kind')})")
+    retired = {e.get("replica") for e in evs
+               if e.get("kind") == "autoscale_action"
+               and e.get("action") == "scale_down"}
+    stuck = [e.get("replica") for e in evs
+             if e.get("kind") == "autoscale_draining"
+             and e.get("replica") not in retired]
+    if stuck:
+        lines.append("  STUCK DRAINING: " + ", ".join(
+            str(s) for s in stuck))
+    return lines
+
+
 def render(windows: List[dict], slo: Optional[dict] = None,
            fleet: Optional[dict] = None, advice: Optional[dict] = None,
            metrics: Tuple[str, ...] = ()) -> str:
@@ -198,6 +238,10 @@ def render(windows: List[dict], slo: Optional[dict] = None,
         if advice.get("drain_candidates"):
             lines.append("  drain: "
                          + ", ".join(advice["drain_candidates"]))
+
+    auto = _autoscaler_panel(windows)
+    if auto:
+        lines.extend(auto)
 
     if len(lines) == 1:
         lines.append("(no inputs — pass --spill/--slo/--fleet/--advice)")

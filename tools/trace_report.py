@@ -148,6 +148,11 @@ KNOWN_METRICS = (
     "timeline/*", "slo/*",
     # reason-coded gateway terminal outcomes (inference/gateway.py)
     "gateway/outcome/*",
+    # elastic fleet resizing (inference/autoscaler.py): resize actions,
+    # spawn retries, catch-up/drain latencies, freeze accounting
+    "autoscale/actions", "autoscale/spawn_failures",
+    "autoscale/catchup_ms", "autoscale/drain_ms",
+    "autoscale/frozen_evals", "autoscale/fleet_size",
 )
 
 
