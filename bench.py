@@ -1871,6 +1871,36 @@ def bench_llama_train(on_tpu):
             "loss": float(jax.device_get(loss))}
 
 
+def bench_autotune_rank(on_tpu):
+    """Static auto-tuner row: rank the full (dp, pp, sharding, mp,
+    recompute) grid for the llama-block capture from sharding
+    propagation alone — no compile, no device.  Gated on configs_ranked
+    and Pareto consistency of the top pick vs the MULTICHIP
+    dryrun-validated configs (both zero-slack)."""
+    import time as _time
+
+    from paddle_tpu.analysis.program.capture import PRESETS
+    from paddle_tpu.analysis.sharding import graph_from_program
+    from paddle_tpu.distributed.auto_tuner import (
+        StaticAutoTuner, top_is_pareto_consistent)
+
+    cap = PRESETS["llama-block"]()
+    g = graph_from_program(cap.program, cap.feed_spec, name=cap.name)
+    tuner = StaticAutoTuner(g)
+    tuner.rank()                                    # warm caches
+    t0 = _time.perf_counter()
+    ranked = tuner.rank()
+    rank_ms = (_time.perf_counter() - t0) * 1e3
+    return {"autotune_rank": {
+        "rank_ms": round(rank_ms, 2),
+        "configs_ranked": len(ranked),
+        "pareto_consistent":
+            1.0 if top_is_pareto_consistent(ranked) else 0.0,
+        "top_config": ranked[0].config.describe(),
+        "top_step_ms": round(ranked[0].est_step_ms, 3),
+    }}
+
+
 # (name, fn, gate_row): gate rows run under --fast too — they feed the
 # tools/benchgate.py regression gate (tokens/s-per-chip, ttft/tpot,
 # dispatch µs); the rest only run under --full
@@ -1889,6 +1919,7 @@ WORKLOADS = (
     ("weight_publish", bench_weight_publish, True),
     ("gateway_storm", bench_gateway_storm, True),
     ("autoscale_storm", bench_autoscale_storm, True),
+    ("autotune_rank", bench_autotune_rank, True),
     ("second_order", bench_second_order, False),
 )
 

@@ -265,6 +265,35 @@ class Report:
         return 1 if self.findings else 0
 
 
+def apply_baseline_and_select(findings: List[Finding],
+                              baseline: Optional[str],
+                              select: Optional[Iterable[str]],
+                              files: int = 1) -> Report:
+    """Fold pre-computed findings (ptprog/ptshard: the rules ran outside
+    the AST walk) through the shared select filter and the grandfather
+    baseline, producing a Report the reporters render unchanged."""
+    report = Report(files=files)
+    sel = list(select) if select is not None else None
+
+    def selected(rid):
+        if sel is None:
+            return True
+        return any(rid == s or (s.endswith("xx") and rid.startswith(s[:-2]))
+                   for s in sel)
+
+    base_counts = load_baseline(baseline) if baseline else {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule_id)):
+        if not selected(f.rule_id):
+            continue
+        k = f.key()
+        if base_counts.get(k, 0) > 0:
+            base_counts[k] -= 1
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    return report
+
+
 def run(paths: Iterable[str], baseline: Optional[str] = None,
         select: Optional[Iterable[str]] = None) -> Report:
     """Lint `paths` (files or directories). `baseline` is a path to a
@@ -387,10 +416,11 @@ def render_json(report: Report) -> str:
     }, indent=1)
 
 
-# PT6xx: the IR-level ptprog families (paddle_tpu/analysis/program/).
-# Kept here — the one jax-free module both CLIs always load — so
-# `--list-rules` can show the full inventory without importing the
-# analyzer (which needs jax for abstract evaluation).
+# PT6xx: the IR-level ptprog families (paddle_tpu/analysis/program/)
+# and PT9xx: the sharding-propagation family (analysis/sharding/,
+# ptshard).  Kept here — the one jax-free module every CLI always
+# loads — so `--list-rules` can show the full inventory without
+# importing the analyzers (abstract evaluation needs jax).
 PTPROG_RULES = (
     ("PT601", "error", "op entry failed abstract (eval_shape) evaluation"),
     ("PT602", "warning", "op mixes floating dtypes across tensor inputs "
@@ -406,6 +436,17 @@ PTPROG_RULES = (
     ("PT623", "error", "unmatched send/recv pair across pipeline stages"),
     ("PT630", "error", "pass changed a fetchable shape/dtype"),
     ("PT631", "error", "pass made a fetch target unproducible"),
+    ("PT901", "error", "sharding spec binds an axis not on the mesh, "
+                       "or maps one mesh axis to two tensor dims"),
+    ("PT902", "warning", "implicit reshard at a producer->consumer "
+                         "sharding mismatch (estimated bytes in the "
+                         "message)"),
+    ("PT903", "error", "sharded dim not divisible by its mesh-axis "
+                       "size (silent padding)"),
+    ("PT904", "warning", "redundant collective: operand already "
+                         "replicated/unsharded over the axis"),
+    ("PT905", "error", "pipeline-stage boundary sharding mismatch "
+                       "(output spec != next stage's feed spec)"),
 )
 
 

@@ -69,6 +69,15 @@ def main(argv=None) -> int:
     ap.add_argument("--memory-report", action="store_true",
                     help="print the full per-op memory/roofline table "
                          "(IR mode, text format)")
+    ap.add_argument("--mesh", default=None, metavar="SPEC",
+                    help="IR mode: mesh for sharding propagation "
+                         "(PT9xx), e.g. 'dp=2,mp=4' or two-tier "
+                         "'dp=2@dcn,mp=4' (default: dp=2,mp=2; "
+                         "'none' disables the pass)")
+    ap.add_argument("--plan", default="megatron",
+                    choices=("megatron", "replicated"),
+                    help="IR mode: sharding plan seeding the PT9xx "
+                         "propagation (default: megatron)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -78,9 +87,8 @@ def main(argv=None) -> int:
             print(f"{rid}  [{sev:7s}] (program) {summary}")
         return 0
 
-    if args.program is not None:
-        return _run_program_mode(args)
-
+    # fold --families/--conc into --select before branching: program
+    # mode honors the same selection syntax (--families PT9, PT6xx, ...)
     select = list(args.select or [])
     if args.families:
         select += [f"{fam.strip()}xx" for fam in args.families.split(",")
@@ -89,6 +97,9 @@ def main(argv=None) -> int:
         select += ["PT7xx", "PT8xx"]
     args.select = select or None
     tool = "ptrace" if args.conc else "ptlint"
+
+    if args.program is not None:
+        return _run_program_mode(args)
 
     paths = args.paths or ["paddle_tpu"]
     for p in paths:
@@ -176,10 +187,20 @@ def _run_program_mode(args) -> int:
 
     budget = (int(args.budget_gb * (1 << 30))
               if args.budget_gb is not None else None)
+    shard_mesh = None
+    mesh_arg = getattr(args, "mesh", None)
+    if mesh_arg is None:
+        mesh_arg = "dp=2,mp=2"     # demo mesh: every program-mode run
+        #                            exercises the PT9xx pass by default
+    if mesh_arg.lower() not in ("none", "off", ""):
+        from .sharding import MeshSpec
+
+        shard_mesh = MeshSpec.parse(mesh_arg)
     res = analyze(cap.program, name=cap.name, feed_spec=cap.feed_spec,
                   mesh=cap.mesh, budget_bytes=budget,
                   capture_fn=cap.capture_fn, baseline=baseline,
-                  select=args.select)
+                  select=args.select, shard_mesh=shard_mesh,
+                  shard_plan=getattr(args, "plan", None) or "megatron")
 
     out = _render(res.report, args.format, tool="ptprog")
     if args.format == "text":
@@ -187,6 +208,10 @@ def _run_program_mode(args) -> int:
         if res.memory is not None:
             extra.append(render_memory_report(
                 res.memory, top=10_000 if args.memory_report else 12))
+        if res.sharding is not None:
+            from .sharding import render_sharding_report
+
+            extra.append(render_sharding_report(res.sharding))
         if res.verify:
             extra.append("pass verification:")
             extra.extend(f"  {v.summary()}" for v in res.verify)

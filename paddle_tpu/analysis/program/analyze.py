@@ -40,6 +40,7 @@ class AnalysisResult:
     memory: Optional[MemoryReport] = None
     verify: List[VerifyReport] = field(default_factory=list)
     env: Dict[int, object] = field(default_factory=dict)
+    sharding: Optional[object] = None      # sharding.ShardingReport
 
     @property
     def exit_code(self) -> int:
@@ -47,45 +48,75 @@ class AnalysisResult:
 
 
 def _apply_baseline_and_select(findings, baseline, select) -> engine.Report:
-    report = engine.Report(files=1)
+    return engine.apply_baseline_and_select(findings, baseline, select)
 
-    def selected(rid):
-        if select is None:
-            return True
-        return any(rid == s or (s.endswith("xx") and rid.startswith(s[:-2]))
-                   for s in select)
 
-    base_counts = engine.load_baseline(baseline) if baseline else {}
-    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule_id)):
-        if not selected(f.rule_id):
-            continue
-        k = f.key()
-        if base_counts.get(k, 0) > 0:
-            base_counts[k] -= 1
-            report.baselined.append(f)
+def _shard_metrics(shard_rep, shard_findings) -> None:
+    try:
+        from ...profiler import metrics as _metrics
+
+        _metrics.inc("analysis/shard_runs")
+        _metrics.inc("analysis/shard_findings", len(shard_findings))
+    except Exception:
+        pass
+
+
+def _stage_sharding(stage_programs, shard_mesh, shard_plan):
+    """PT905: cross-stage boundary sharding mismatches.  Builds one
+    ShardGraph per pipeline stage and pairs stage ``i`` fetches with
+    stage ``i+1`` feeds under the propagated specs."""
+    from ..sharding import (MeshSpec, check_stage_boundaries,
+                            graph_from_program, plan_by_name)
+
+    try:
+        mesh = (shard_mesh if isinstance(shard_mesh, MeshSpec)
+                else MeshSpec.parse(shard_mesh)
+                if isinstance(shard_mesh, str)
+                else MeshSpec.from_mesh(shard_mesh))
+    except Exception:
+        return []
+    graphs, plans = [], []
+    for i, sp in enumerate(stage_programs):
+        try:
+            g = graph_from_program(sp, None, name=f"stage{i}")
+        except Exception:
+            return []      # un-analyzable stage: PT62x already covers it
+        graphs.append(g)
+        if shard_plan is None or isinstance(shard_plan, str):
+            plans.append(plan_by_name(shard_plan or "replicated", g, mesh))
         else:
-            report.findings.append(f)
-    return report
+            plans.append(shard_plan)
+    return check_stage_boundaries(graphs, mesh, plans=plans)
 
 
 def analyze(program=None, name: str = "program", feed_spec=None,
             mesh=None, budget_bytes: Optional[int] = None,
             capture_fn=None, stage_programs: Optional[Sequence] = None,
             baseline: Optional[str] = None,
-            select: Optional[Sequence[str]] = None) -> AnalysisResult:
-    """Run the four IR passes over ``program``.
+            select: Optional[Sequence[str]] = None,
+            shard_mesh=None, shard_plan=None) -> AnalysisResult:
+    """Run the IR passes over ``program``.
 
     - dataflow (PT60x) and memory (PT61x) always run;
     - collective consistency (PT62x) runs against ``mesh`` (default:
       the active topology mesh), plus cross-stage send/recv matching
       when ``stage_programs`` is given;
     - pass equivalence (PT63x) runs when ``capture_fn`` can produce a
-      fresh Program per shipped pass (passes mutate what they verify).
+      fresh Program per shipped pass (passes mutate what they verify);
+    - sharding propagation (PT9xx) runs when ``shard_mesh`` is given
+      (a MeshSpec, jax Mesh, or ``"dp=2,mp=2"``-style string; falls
+      back to ``mesh``), seeded from ``shard_plan`` ("replicated" |
+      "megatron" | a ShardingPlan).  Stage programs additionally get
+      the PT905 boundary check.
     """
     findings: List[engine.Finding] = []
     memrep = None
     verify_reports: List[VerifyReport] = []
     env: Dict[int, object] = {}
+    shard_rep = None
+
+    if shard_mesh is None:
+        shard_mesh = mesh
 
     if program is not None:
         ir = ProgramIR(program, feed_spec=feed_spec, name=name)
@@ -93,9 +124,19 @@ def analyze(program=None, name: str = "program", feed_spec=None,
         mem_f, memrep = check_memory(ir, env, budget_bytes)
         findings.extend(mem_f)
         findings.extend(check_collectives(ir, mesh=mesh))
+        if shard_mesh is not None:
+            from ..sharding import check_sharding
+
+            shard_f, shard_rep = check_sharding(
+                ir, env, shard_mesh, plan=shard_plan)
+            findings.extend(shard_f)
+            _shard_metrics(shard_rep, shard_f)
 
     if stage_programs:
         findings.extend(check_pipeline(stage_programs, mesh=mesh))
+        if shard_mesh is not None:
+            findings.extend(_stage_sharding(stage_programs, shard_mesh,
+                                            shard_plan))
 
     if capture_fn is not None:
         for pname, p in shipped_passes():
@@ -129,4 +170,5 @@ def analyze(program=None, name: str = "program", feed_spec=None,
     except Exception:
         pass
     return AnalysisResult(report=report, memory=memrep,
-                          verify=verify_reports, env=env)
+                          verify=verify_reports, env=env,
+                          sharding=shard_rep)

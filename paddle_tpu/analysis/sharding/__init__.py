@@ -1,0 +1,71 @@
+"""ptshard — static sharding-propagation analysis (the PT9xx family).
+
+Propagates PartitionSpec-style annotations op-by-op through a recorded
+``static.Program`` on a declared mesh — without compiling — and turns
+the classic silent-perf-loss classes into CI-gated findings:
+
+- **PT901** spec axis not on the mesh / one axis mapped to two dims
+- **PT902** implicit reshard at a producer→consumer sharding mismatch
+  (message quantifies the estimated all-gather/all-to-all bytes)
+- **PT903** sharded dim not divisible by its mesh-axis size (silent
+  padding)
+- **PT904** redundant collective (all-reduce over an axis the operand
+  is already replicated on; all-gather of an unsharded value)
+- **PT905** pipeline-stage boundary sharding mismatch (composes with
+  ptprog's ``check_pipeline``)
+
+The same propagation yields per-step communication volume (tiered
+ICI/DCN) and per-op parallelism factors — the inputs
+``distributed.auto_tuner.static_tuner`` ranks TP×PP×sharding configs
+with.  Core modules (`spec`, `graph`, `propagate`, `plan`, `pipeline`)
+are stdlib-only so ``tools/ptshard.py`` runs jax-free on serialized
+graphs; only :func:`graph_from_program` needs the framework.
+"""
+from __future__ import annotations
+
+from .graph import ShardGraph, ShardOp, graph_from_ir
+from .pipeline import check_stage_boundaries
+from .plan import (ShardingPlan, megatron_plan, plan_by_name,
+                   replicated_plan)
+from .propagate import (CommEvent, ShardingReport, propagate,
+                        render_sharding_report)
+from .spec import MeshSpec, ShardSpec, parse_spec, replicated
+
+__all__ = [
+    "MeshSpec", "ShardSpec", "parse_spec", "replicated",
+    "ShardGraph", "ShardOp", "graph_from_ir", "graph_from_program",
+    "ShardingPlan", "replicated_plan", "megatron_plan", "plan_by_name",
+    "CommEvent", "ShardingReport", "propagate",
+    "render_sharding_report", "check_stage_boundaries",
+    "check_sharding",
+]
+
+
+def graph_from_program(program, feed_spec=None,
+                       name: str = "program") -> ShardGraph:
+    """Capture-time bridge: Program -> abstract dataflow -> jax-free
+    ShardGraph (the only entry point here that needs jax)."""
+    from ..program.dataflow import abstract_run
+    from ..program.ir import ProgramIR
+
+    ir = ProgramIR(program, feed_spec=feed_spec, name=name)
+    env, _findings = abstract_run(ir)
+    return graph_from_ir(ir, env)
+
+
+def check_sharding(ir, env, mesh, plan=None):
+    """The ``analyze()`` pass entry: ProgramIR + abstract env + mesh ->
+    (findings, ShardingReport).  ``plan`` is a ShardingPlan or a plan
+    name ("replicated" | "megatron"); ``mesh`` is a MeshSpec, a jax
+    Mesh, or a parseable string."""
+    graph = graph_from_ir(ir, env)
+    if isinstance(mesh, str):
+        mesh_spec = MeshSpec.parse(mesh)
+    else:
+        mesh_spec = MeshSpec.from_mesh(mesh)
+    if mesh_spec is None:
+        return [], None
+    if plan is None or isinstance(plan, str):
+        plan = plan_by_name(plan, graph, mesh_spec)
+    rep = propagate(graph, mesh_spec, plan)
+    return list(rep.findings), rep

@@ -1,0 +1,142 @@
+"""ptshard CLI: sharding propagation (PT9xx) over serialized graphs,
+with no jax in the process.
+
+Inputs are ``ShardGraph`` JSON files (``ShardGraph.to_json`` — the
+capture side needs jax once; this side never does).  Multiple graphs
+with ``--pipeline`` are treated as consecutive pipeline stages and get
+the PT905 boundary check.  Shares the ptlint reporters
+(``--format text|json|sarif``) and the committed
+``.ptlint-baseline.json`` grandfather workflow.
+
+For captures living in presets (llama, mlp, decode) use the framework
+route instead: ``python -m paddle_tpu.analysis --program llama
+--families PT9`` (jax required there for abstract evaluation).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from .. import engine
+from .graph import ShardGraph
+from .pipeline import check_stage_boundaries
+from .plan import plan_by_name
+from .propagate import propagate, render_sharding_report
+from .spec import MeshSpec
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ptshard",
+        description="static sharding-propagation analysis (PT901 bad "
+                    "axis, PT902 implicit reshard, PT903 divisibility, "
+                    "PT904 redundant collective, PT905 stage boundary)")
+    ap.add_argument("graphs", nargs="+", metavar="GRAPH.json",
+                    help="serialized ShardGraph file(s)")
+    ap.add_argument("--mesh", default="dp=2,mp=2", metavar="SPEC",
+                    help="mesh, e.g. 'dp=2,mp=4' or two-tier "
+                         "'dp=2@dcn,mp=4' (default: dp=2,mp=2)")
+    ap.add_argument("--plan", default="megatron",
+                    choices=("megatron", "replicated"))
+    ap.add_argument("--pipeline", action="store_true",
+                    help="treat the graphs as consecutive pipeline "
+                         "stages and check boundaries (PT905)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--report", action="store_true",
+                    help="print the full per-graph sharding report "
+                         "(comm volume, largest transfers; text format)")
+    ap.add_argument("--select", action="append", default=None,
+                    metavar="RULE")
+    ap.add_argument("--families", default="PT9", metavar="FAMS",
+                    help="comma list of rule families (default: PT9)")
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="prune baseline entries whose findings no "
+                         "longer fire and exit 0")
+    args = ap.parse_args(argv)
+
+    select = list(args.select or [])
+    if args.families:
+        select += [f"{fam.strip()}xx" for fam in args.families.split(",")
+                   if fam.strip()]
+    select = select or None
+
+    try:
+        mesh = MeshSpec.parse(args.mesh)
+    except ValueError as e:
+        print(f"ptshard: bad --mesh: {e}", file=sys.stderr)
+        return 2
+
+    graphs: List[ShardGraph] = []
+    for path in args.graphs:
+        if not os.path.isfile(path):
+            print(f"ptshard: no such file: {path}", file=sys.stderr)
+            return 2
+        with open(path) as f:
+            try:
+                graphs.append(ShardGraph.from_json(f.read()))
+            except Exception as e:
+                print(f"ptshard: {path}: not a ShardGraph JSON ({e})",
+                      file=sys.stderr)
+                return 2
+
+    findings, reports = [], []
+    plans = [plan_by_name(args.plan, g, mesh) for g in graphs]
+    for g, plan in zip(graphs, plans):
+        rep = propagate(g, mesh, plan=plan)
+        reports.append(rep)
+        findings.extend(rep.findings)
+    if args.pipeline and len(graphs) > 1:
+        findings.extend(check_stage_boundaries(graphs, mesh, plans=plans,
+                                               reports=reports))
+
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        baseline = args.baseline or engine.find_baseline(os.getcwd())
+        if baseline and not os.path.isfile(baseline):
+            baseline = None
+
+    report = engine.apply_baseline_and_select(
+        findings, baseline, select, files=len(graphs))
+
+    if args.write_baseline:
+        target = args.baseline or os.path.join(os.getcwd(),
+                                               engine.BASELINE_NAME)
+        engine.write_baseline(target, report.findings)
+        print(f"ptshard: wrote {len(report.findings)} entr"
+              f"{'y' if len(report.findings) == 1 else 'ies'} to "
+              f"{target}")
+        return 0
+
+    if args.update_baseline:
+        if not baseline:
+            print("ptshard: --update-baseline needs an existing "
+                  "baseline", file=sys.stderr)
+            return 2
+        n_before = sum(engine.load_baseline(baseline).values())
+        engine.write_baseline(baseline, report.baselined)
+        pruned = n_before - len(report.baselined)
+        print(f"ptshard: baseline {baseline}: kept "
+              f"{len(report.baselined)} live entr"
+              f"{'y' if len(report.baselined) == 1 else 'ies'}, pruned "
+              f"{pruned} stale")
+        return 0
+
+    if args.format == "json":
+        out = engine.render_json(report)
+    elif args.format == "sarif":
+        out = engine.render_sarif(report, tool_name="ptshard")
+    else:
+        out = engine.render_text(report, tool_name="ptshard")
+        if args.report:
+            out = "\n".join([out] + [render_sharding_report(r)
+                                     for r in reports])
+    print(out)
+    return report.exit_code

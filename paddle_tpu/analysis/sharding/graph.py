@@ -1,0 +1,207 @@
+"""The propagator's program view: a jax-free shadow of ``ProgramIR``.
+
+``ShardGraph`` keeps exactly what sharding propagation needs — op list
+with input/output uids, per-uid shapes and itemsizes, feed/external/
+fetch roots and the recorded collective metadata — as plain ints and
+tuples.  Two construction paths:
+
+- :func:`graph_from_ir` bridges a ``ProgramIR`` plus its abstract
+  environment (jax needed once, at capture time);
+- :meth:`ShardGraph.from_json` loads a serialized graph, which is how
+  ``tools/ptshard.py`` analyzes a capture with no jax in the process
+  and how the fixture matrix builds violating programs by hand.
+
+Per-op attrs (``perm`` for transpose-family, ``axis`` for
+index_select/softmax) are recovered from the recorded closure when
+available — the same closure-recovery discipline as
+``ir.collective_info``.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ShardOp", "ShardGraph", "graph_from_ir"]
+
+
+@dataclass
+class ShardOp:
+    index: int
+    name: str
+    in_uids: Tuple[int, ...]
+    out_uids: Tuple[int, ...]
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ShardGraph:
+    name: str
+    ops: List[ShardOp] = field(default_factory=list)
+    shapes: Dict[int, Tuple[int, ...]] = field(default_factory=dict)
+    itemsize: Dict[int, int] = field(default_factory=dict)
+    feeds: Dict[str, int] = field(default_factory=dict)      # name -> uid
+    externals: List[int] = field(default_factory=list)
+    fetches: List[int] = field(default_factory=list)
+    collectives: List[Dict[str, Any]] = field(default_factory=list)
+
+    def __post_init__(self):
+        self.producer: Dict[int, int] = {}
+        self.consumers: Dict[int, List[int]] = {}
+        self._reindex()
+
+    def _reindex(self):
+        self.producer.clear()
+        self.consumers.clear()
+        for op in self.ops:
+            for u in op.out_uids:
+                self.producer.setdefault(u, op.index)
+            for u in op.in_uids:
+                self.consumers.setdefault(u, []).append(op.index)
+
+    def shape(self, uid: int) -> Tuple[int, ...]:
+        return tuple(self.shapes.get(uid, ()))
+
+    def nbytes(self, uid: int) -> int:
+        n = self.itemsize.get(uid, 4)
+        for d in self.shape(uid):
+            n *= int(d)
+        return int(n)
+
+    def seed_uids(self) -> List[Tuple[int, str]]:
+        """(uid, label) for every value live before op 0 — feeds first
+        (labelled by feed name), then externals."""
+        out = [(u, f"feed:{n}") for n, u in self.feeds.items()]
+        ext = {u for u, _ in out}
+        out += [(u, f"external:{u}") for u in self.externals
+                if u not in ext]
+        return out
+
+    def meta_for(self, op_index: int) -> Optional[Dict[str, Any]]:
+        for m in self.collectives:
+            if int(m.get("op_index", -1)) == op_index:
+                return m
+        return None
+
+    def last_use(self) -> Dict[int, int]:
+        n = len(self.ops)
+        out = {u: max(idxs) for u, idxs in self.consumers.items()}
+        for u in self.fetches:
+            out[u] = n - 1 if n else 0
+        return out
+
+    # -- serialization ----------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 1,
+            "name": self.name,
+            "ops": [{"index": o.index, "name": o.name,
+                     "ins": list(o.in_uids), "outs": list(o.out_uids),
+                     "attrs": o.attrs} for o in self.ops],
+            "shapes": {str(u): list(s) for u, s in self.shapes.items()},
+            "itemsize": {str(u): n for u, n in self.itemsize.items()},
+            "feeds": self.feeds,
+            "externals": list(self.externals),
+            "fetches": list(self.fetches),
+            "collectives": self.collectives,
+        }, indent=1)
+
+    @classmethod
+    def from_json(cls, text: str) -> "ShardGraph":
+        d = json.loads(text)
+        return cls(
+            name=d.get("name", "graph"),
+            ops=[ShardOp(int(o["index"]), o["name"],
+                         tuple(int(u) for u in o["ins"]),
+                         tuple(int(u) for u in o["outs"]),
+                         dict(o.get("attrs") or {}))
+                 for o in d.get("ops", [])],
+            shapes={int(u): tuple(int(x) for x in s)
+                    for u, s in d.get("shapes", {}).items()},
+            itemsize={int(u): int(n)
+                      for u, n in d.get("itemsize", {}).items()},
+            feeds={str(n): int(u) for n, u in d.get("feeds", {}).items()},
+            externals=[int(u) for u in d.get("externals", [])],
+            fetches=[int(u) for u in d.get("fetches", [])],
+            collectives=list(d.get("collectives", [])),
+        )
+
+
+# op name -> closure freevars worth lifting into attrs, with the
+# canonical attr each maps to
+_ATTR_VARS = {
+    "transpose": {"p": "perm", "perm": "perm"},
+    "moveaxis": {"source": "source", "destination": "destination"},
+    "swapaxes": {"axis0": "axis0", "axis1": "axis1"},
+    "index_select": {"axis": "axis"},
+    "softmax": {"axis": "axis"},
+    "argmax": {"axis": "axis"},
+    "argmin": {"axis": "axis"},
+    "mean": {"axis": "axis"},
+    "sum": {"axis": "axis"},
+    "concat": {"axis": "axis"},
+    "split": {"axis": "axis"},
+}
+
+
+def _closure_attrs(name: str, fn) -> Dict[str, Any]:
+    want = _ATTR_VARS.get(name)
+    if not want:
+        return {}
+    code = getattr(fn, "__code__", None)
+    cells = getattr(fn, "__closure__", None) or ()
+    if code is None:
+        return {}
+    out: Dict[str, Any] = {}
+    for var, cell in zip(code.co_freevars, cells):
+        if var not in want:
+            continue
+        try:
+            val = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(val, int) and not isinstance(val, bool):
+            out[want[var]] = int(val)
+        elif isinstance(val, (tuple, list)) and all(
+                isinstance(v, int) for v in val):
+            out[want[var]] = [int(v) for v in val]
+    # normalize the transpose family to one canonical "perm"
+    if name == "swapaxes" and {"axis0", "axis1"} <= out.keys():
+        out = {"swap": [out["axis0"], out["axis1"]]}
+    return out
+
+
+def graph_from_ir(ir, env) -> ShardGraph:
+    """Bridge a ``ProgramIR`` + abstract environment (from
+    ``dataflow.abstract_run``) into the jax-free graph.  Values whose
+    abstract evaluation failed are simply absent from ``shapes``; the
+    propagator replicates them."""
+    import numpy as np
+
+    shapes: Dict[int, Tuple[int, ...]] = {}
+    itemsize: Dict[int, int] = {}
+    for u, aval in env.items():
+        shape = getattr(aval, "shape", None)
+        if shape is None:
+            continue
+        shapes[u] = tuple(int(d) for d in shape)
+        try:
+            itemsize[u] = int(np.dtype(aval.dtype).itemsize)
+        except Exception:
+            itemsize[u] = 4
+
+    ops = []
+    for op in ir.ops:
+        ops.append(ShardOp(
+            index=op.index, name=op.name,
+            in_uids=tuple(int(u) for u in op.in_uids),
+            out_uids=tuple(int(u) for u in op.out_uids),
+            attrs=_closure_attrs(op.name, op.fn)))
+
+    return ShardGraph(
+        name=ir.name, ops=ops, shapes=shapes, itemsize=itemsize,
+        feeds={str(n): int(u) for n, u in ir.feed_uids.items()},
+        externals=[int(u) for u in ir.external_uids],
+        fetches=[int(u) for u in ir.fetch_uids],
+        collectives=[dict(m) for m in ir.collectives],
+    )

@@ -1,0 +1,75 @@
+"""PT905: pipeline-stage boundary sharding consistency.
+
+``ptprog.check_pipeline`` (PT623) proves every send has a matching
+recv across stage sub-programs; this module checks what those matched
+transfers *carry*: the sharding of stage *i*'s outputs must equal the
+sharding stage *i+1* expects on its inputs.  A mismatch is not a
+deadlock — the runtime reshards silently — but on a pp boundary the
+reshard happens once per microbatch per step, usually over DCN, which
+is exactly the "my pipeline is mysteriously 2x slower" class.
+
+Boundary pairing is positional: stage *i*'s fetch list against stage
+*i+1*'s feed list (same-shape pairs only; shape routing itself is
+PT623/PT601 territory).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..engine import Finding
+from .graph import ShardGraph
+from .propagate import ShardingReport, propagate, _collective_bytes
+from .spec import MeshSpec
+
+__all__ = ["check_stage_boundaries"]
+
+
+def check_stage_boundaries(graphs: Sequence[ShardGraph],
+                           mesh: MeshSpec,
+                           plans: Optional[Sequence] = None,
+                           reports: Optional[
+                               Sequence[ShardingReport]] = None,
+                           ) -> List[Finding]:
+    """Propagate each stage graph (unless precomputed ``reports`` are
+    given) and flag PT905 at every fetch->feed boundary whose specs
+    disagree.  Per-stage propagation findings are included, so one call
+    covers the whole PT9xx surface of a pipeline."""
+    findings: List[Finding] = []
+    if reports is None:
+        reports = []
+        for i, g in enumerate(graphs):
+            plan = plans[i] if plans and i < len(plans) else None
+            rep = propagate(g, mesh, plan)
+            findings.extend(rep.findings)
+            reports.append(rep)
+
+    for i in range(len(graphs) - 1):
+        src_g, dst_g = graphs[i], graphs[i + 1]
+        src_r, dst_r = reports[i], reports[i + 1]
+        dst_feeds = list(dst_g.feeds.items())    # insertion-ordered
+        for pos, out_uid in enumerate(src_g.fetches):
+            if pos >= len(dst_feeds):
+                break
+            feed_name, in_uid = dst_feeds[pos]
+            if src_g.shape(out_uid) != dst_g.shape(in_uid):
+                continue                         # not a boundary pair
+            out_spec = src_r.specs.get(out_uid)
+            in_spec = dst_r.specs.get(in_uid)
+            if out_spec is None or in_spec is None:
+                continue
+            rank = len(src_g.shape(out_uid))
+            if out_spec.normalized(rank) == in_spec.normalized(rank):
+                continue
+            moved = _collective_bytes(
+                "reshard", src_g.nbytes(out_uid),
+                max(out_spec.factor(mesh), in_spec.factor(mesh), 2))
+            findings.append(Finding(
+                "PT905", "error", f"program:{src_g.name}",
+                len(src_g.ops), 0,
+                f"pipeline boundary stage {i}->{i + 1}: output {pos} "
+                f"leaves sharded {out_spec} but stage {i + 1} feed "
+                f"'{feed_name}' expects {in_spec} — "
+                f"~{moved / (1 << 20):.2f} MiB resharded per "
+                f"microbatch per step on the stage boundary",
+                line_text=f"boundary:{i}->{i + 1}:{feed_name}"))
+    return findings

@@ -1,0 +1,166 @@
+"""Sharding plans: the seed specs propagation starts from.
+
+A plan only pins down the program's *inputs* (feeds by name, externals
+by uid); everything else is derived by propagation.  Two built-ins:
+
+- :func:`replicated_plan` — nothing sharded.  The conservative CI
+  default: zero findings unless the program carries explicitly
+  redundant collectives (PT904) or declared specs are malformed.
+- :func:`megatron_plan` — data parallel on the batch dim of every feed
+  that divides, tensor parallel on the 2-D weight externals in the
+  classic Megatron alternation: a weight consumed by an activation
+  that is not yet tp-tainted is column-split ``[-, tp]``, one consumed
+  by a tp-tainted activation is row-split ``[tp, -]`` (its matmul
+  contracts over the sharded dim, producing the partial sum the
+  propagator charges one all-reduce for).  The taint scan is a cheap
+  forward walk over the op list — no propagation needed to build the
+  plan, so planning stays O(ops) per candidate config in the tuner's
+  grid.
+
+Weights whose dims do not divide the tp axis are left replicated (the
+plan degrades rather than generating PT903 noise); 1-D externals
+(norm gains, biases) are tp-sharded only when they feed an
+elementwise op whose other operand's *last dim* is tp-sharded.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .graph import ShardGraph
+from .spec import MeshSpec, ShardSpec
+
+__all__ = ["ShardingPlan", "replicated_plan", "megatron_plan",
+           "plan_by_name"]
+
+_MATMUL = ("matmul", "linear", "bmm", "dense", "fc")
+
+
+@dataclass
+class ShardingPlan:
+    name: str = "replicated"
+    feed_specs: Dict[str, ShardSpec] = field(default_factory=dict)
+    external_specs: Dict[int, ShardSpec] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        parts = [f"plan={self.name}"]
+        for n, s in self.feed_specs.items():
+            parts.append(f"{n}:{s}")
+        parts.append(f"{len(self.external_specs)} external spec(s)")
+        return " ".join(parts)
+
+
+def replicated_plan() -> ShardingPlan:
+    return ShardingPlan(name="replicated")
+
+
+def megatron_plan(graph: ShardGraph, mesh: MeshSpec,
+                  tp_axis: str = "mp",
+                  dp_axis: str = "dp") -> ShardingPlan:
+    plan = ShardingPlan(name="megatron")
+    tp = mesh.size(tp_axis) if mesh.has(tp_axis) else 1
+    dp = mesh.size(dp_axis) if mesh.has(dp_axis) else 1
+
+    # data parallel: shard dim 0 of every feed that divides — batch for
+    # activations, (rows*blocks) for block tables, broadcast-aligned
+    # leading dims for masks
+    if dp > 1:
+        for name, uid in graph.feeds.items():
+            shape = graph.shape(uid)
+            if shape and shape[0] % dp == 0 and shape[0] >= dp:
+                plan.feed_specs[name] = ShardSpec.of(dp_axis)
+
+    if tp <= 1:
+        return plan
+
+    externals = set(graph.externals)
+    # forward taint scan: which uids carry tp-sharded content, and
+    # whether their LAST dim is the tp-sharded one
+    taint: Set[int] = set()
+    lastdim_tp: Set[int] = set()
+    for op in graph.ops:
+        name = op.name.lower()
+        t_ins = [u for u in op.in_uids if graph.shape(u)]
+        is_mm = any(k in name for k in _MATMUL) and "fused" not in name
+        w = None
+        if is_mm and len(op.in_uids) >= 2:
+            cand = op.in_uids[1]
+            if cand in externals and len(graph.shape(cand)) == 2:
+                w = cand
+        if w is not None:
+            act = op.in_uids[0]
+            wsh = graph.shape(w)
+            out = op.out_uids[0] if op.out_uids else None
+            osh = graph.shape(out) if out is not None else ()
+            if act in taint:
+                # row-split: contraction dim sharded -> partial sum,
+                # output whole again
+                if wsh[0] % tp == 0 and w not in plan.external_specs:
+                    plan.external_specs[w] = ShardSpec.of(tp_axis, None)
+                if out is not None:
+                    pass        # output untainted
+            else:
+                # column-split: output's last dim becomes tp-sharded
+                if wsh[1] % tp == 0 and osh and osh[-1] % tp == 0 \
+                        and w not in plan.external_specs:
+                    plan.external_specs[w] = ShardSpec.of(None, tp_axis)
+                    if out is not None:
+                        taint.add(out)
+                        lastdim_tp.add(out)
+            continue
+        # 1-D externals riding a tp-sharded last dim (bias, norm gain
+        # applied after a column-split linear)
+        if not is_mm and len(t_ins) >= 2:
+            for u in t_ins:
+                ush = graph.shape(u)
+                if u in externals and len(ush) == 1 \
+                        and u not in plan.external_specs:
+                    others = [v for v in t_ins if v != u]
+                    if any(v in lastdim_tp
+                           and graph.shape(v)[-1:] == ush
+                           for v in others) and ush[0] % tp == 0:
+                        plan.external_specs[u] = ShardSpec.of(tp_axis)
+
+        # generic taint flow
+        tainted_in = any(u in taint for u in op.in_uids)
+        if not tainted_in:
+            continue
+        for out in op.out_uids:
+            taint.add(out)
+        # track whether the last dim stays the tp-sharded one
+        src = next((u for u in op.in_uids if u in taint), None)
+        src_last = src in lastdim_tp
+        for out in op.out_uids:
+            osh = graph.shape(out)
+            ish = graph.shape(src) if src is not None else ()
+            if not osh:
+                continue
+            keep = False
+            if op.name == "reshape" and ish:
+                if len(osh) < len(ish):          # merge
+                    keep = src_last or (osh[-1] % tp == 0
+                                        and osh[-1] != ish[-1])
+                elif len(osh) > len(ish):        # split
+                    keep = False
+                else:
+                    keep = src_last
+            elif op.name in ("transpose", "moveaxis", "swapaxes"):
+                perm = op.attrs.get("perm")
+                keep = bool(perm) and list(perm)[-1] == len(ish) - 1 \
+                    and src_last
+            elif len(osh) == len(ish):
+                keep = src_last
+            if keep:
+                lastdim_tp.add(out)
+    return plan
+
+
+def plan_by_name(name: Optional[str], graph: ShardGraph,
+                 mesh: MeshSpec) -> ShardingPlan:
+    """CLI/driver entry: ``"replicated"`` | ``"megatron"``."""
+    if name in (None, "", "replicated", "none"):
+        return replicated_plan()
+    if name == "megatron":
+        return megatron_plan(graph, mesh)
+    raise ValueError(
+        f"unknown sharding plan {name!r} (want replicated|megatron)")

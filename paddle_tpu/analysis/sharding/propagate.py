@@ -1,0 +1,782 @@
+"""GSPMD-style static sharding propagation over a :class:`ShardGraph`.
+
+Walks the recorded op list once, carrying a ``uid -> ShardSpec``
+environment seeded from a :class:`~.plan.ShardingPlan`, and models how
+each op transforms the sharding of its inputs — without compiling
+anything.  Three kinds of output:
+
+- **findings** — the PT9xx family.  PT901 (spec axis not on the mesh /
+  one axis mapped to two dims) and PT903 (sharded dim not divisible —
+  silent padding) fire on declared specs; PT902 fires when a
+  producer's sharding contradicts what a consumer needs and the
+  runtime would have to reshard implicitly, with the estimated
+  all-gather bytes in the message; PT904 fires on redundant explicit
+  collectives (all-reduce over an axis the operand is already
+  replicated on, all-gather of an unsharded value).
+- **comm events** — every modelled transfer (explicit collectives,
+  implicit partial-sum all-reduces from contraction-dim sharding, and
+  the resharding movements behind PT902), priced by
+  ``cost_model.collective_bytes`` and tagged with the fabric tier
+  (ICI vs DCN) of the mesh axes involved.  This is the communication
+  volume the static auto-tuner ranks configs by.
+- **per-op parallelism factors** — how many devices divide each op's
+  compute, feeding the tuner's roofline estimate.
+
+Partial sums are tracked explicitly: a matmul whose contraction dim is
+sharded produces a *partial* value (Megatron row-parallel ``g``); an
+explicit all-reduce consumes it silently, and any other consumer
+triggers the implicit all-reduce the runtime would insert — charged as
+an event, not flagged, because that is exactly the planned cost of
+tensor parallelism.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..engine import Finding
+from .graph import ShardGraph, ShardOp
+from .spec import MeshSpec, ShardSpec, replicated, validate
+
+__all__ = ["CommEvent", "ShardingReport", "propagate",
+           "render_sharding_report", "COLLECTIVE_SET", "P2P_SET"]
+
+COLLECTIVE_SET = frozenset({
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "all_to_all_single", "broadcast", "scatter", "reduce"})
+P2P_SET = frozenset({"send", "recv", "isend", "irecv"})
+
+_ELEMENTWISE = frozenset({
+    "add", "subtract", "multiply", "divide", "relu", "gelu", "silu",
+    "sigmoid", "tanh", "exp", "log", "rsqrt", "sqrt", "pow", "abs",
+    "neg", "maximum", "minimum", "cast", "scale", "dropout", "clip",
+    "where", "swiglu", "fused_rope", "erf", "square"})
+_MATMUL = ("matmul", "linear", "bmm", "dense", "fc")
+_LASTDIM = frozenset({"softmax", "log_softmax", "rms_norm",
+                      "layer_norm"})
+_REDUCE_SUM = frozenset({"mean", "sum"})
+_REDUCE_OTHER = frozenset({"max", "min", "prod", "argmax", "argmin",
+                           "all", "any"})
+
+
+def _collective_bytes(kind: str, nbytes: int, group_size: int) -> int:
+    try:
+        from ...cost_model import collective_bytes
+
+        return collective_bytes(kind, nbytes, group_size)
+    except Exception:
+        # jax-free detached load without a cost_model module: the same
+        # ring formulas, kept in sync with cost_model.collective_bytes
+        n = max(int(group_size), 1)
+        if n <= 1:
+            return 0
+        frac = (n - 1) / n
+        if kind in ("all_reduce", "reduce"):
+            return int(2 * nbytes * frac)
+        if kind in ("all_gather", "reduce_scatter", "all_to_all",
+                    "all_to_all_single", "reshard"):
+            return int(nbytes * frac)
+        return int(nbytes)
+
+
+@dataclass
+class CommEvent:
+    op_index: int
+    op_name: str
+    kind: str                     # all_reduce | all_gather | reshard | ...
+    axes: Tuple[str, ...]
+    bytes: int
+    tier: str = "ici"
+    implicit: bool = False
+    note: str = ""
+
+
+@dataclass
+class ShardingReport:
+    name: str
+    mesh: MeshSpec
+    plan_name: str = "replicated"
+    graph: Optional[ShardGraph] = None
+    specs: Dict[int, ShardSpec] = field(default_factory=dict)
+    partial: Dict[int, Tuple[str, ...]] = field(default_factory=dict)
+    events: List[CommEvent] = field(default_factory=list)
+    findings: List[Finding] = field(default_factory=list)
+    op_parallel: Dict[int, int] = field(default_factory=dict)
+
+    def sharded_nbytes(self, uid: int) -> int:
+        if self.graph is None:
+            return 0
+        spec = self.specs.get(uid)
+        shape = self.graph.shape(uid)
+        item = self.graph.itemsize.get(uid, 4)
+        if spec is None:
+            return self.graph.nbytes(uid)
+        return spec.shard_nbytes(shape, item, self.mesh)
+
+    def comm_bytes(self, tier: Optional[str] = None) -> int:
+        return sum(e.bytes for e in self.events
+                   if tier is None or e.tier == tier)
+
+    def comm_by_kind(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.events:
+            out[e.kind] = out.get(e.kind, 0) + e.bytes
+        return out
+
+
+class _Propagator:
+    def __init__(self, graph: ShardGraph, mesh: MeshSpec, plan):
+        self.g = graph
+        self.mesh = mesh
+        self.plan = plan
+        self.env: Dict[int, ShardSpec] = {}
+        self.partial: Dict[int, Tuple[str, ...]] = {}
+        self.findings: List[Finding] = []
+        self.events: List[CommEvent] = []
+        self.op_parallel: Dict[int, int] = {}
+
+    # -- small helpers ----------------------------------------------------
+    def _rank(self, uid: int) -> int:
+        return len(self.g.shape(uid))
+
+    def spec(self, uid: int) -> ShardSpec:
+        s = self.env.get(uid)
+        if s is None:
+            s = replicated(self._rank(uid))
+        return s
+
+    def _nbytes_sharded(self, uid: int) -> int:
+        return self.spec(uid).shard_nbytes(
+            self.g.shape(uid), self.g.itemsize.get(uid, 4), self.mesh)
+
+    def _tier(self, axes: Sequence[str]) -> str:
+        return ("dcn" if any(self.mesh.tier(a) == "dcn" for a in axes)
+                else "ici")
+
+    def _axes_factor(self, axes: Sequence[str]) -> int:
+        f = 1
+        for a in axes:
+            if self.mesh.has(a):
+                f *= self.mesh.size(a)
+        return f
+
+    def _find(self, rule: str, sev: str, idx: int, msg: str, ctx: str):
+        self.findings.append(Finding(
+            rule, sev, f"program:{self.g.name}", idx + 1, 0, msg,
+            line_text=ctx))
+
+    def _event(self, op: Optional[ShardOp], kind: str,
+               axes: Sequence[str], nbytes: int, implicit=False,
+               note: str = ""):
+        axes = tuple(axes)
+        self.events.append(CommEvent(
+            op_index=op.index if op else -1,
+            op_name=op.name if op else "<seed>",
+            kind=kind, axes=axes,
+            bytes=_collective_bytes(kind, nbytes,
+                                    self._axes_factor(axes)),
+            tier=self._tier(axes), implicit=implicit, note=note))
+
+    def _sanitize(self, spec: ShardSpec) -> ShardSpec:
+        """Drop axes PT901 already flagged so propagation continues."""
+        seen = set()
+        dims = []
+        for d in spec.dims:
+            kept = []
+            for a in d:
+                if self.mesh.has(a) and a not in seen:
+                    kept.append(a)
+                    seen.add(a)
+            dims.append(tuple(kept))
+        return ShardSpec(dims=tuple(dims))
+
+    def _set(self, op: ShardOp, uid: int, spec: ShardSpec):
+        spec = spec.normalized(self._rank(uid))
+        for rid, msg in validate(spec, self.g.shape(uid), self.mesh):
+            if rid == "PT903":
+                self._find(rid, "error", op.index,
+                           f"output of op #{op.index} '{op.name}': {msg}",
+                           op.name)
+        self.env[uid] = spec
+
+    def _gather_spec(self, op: ShardOp, uid: int, axes: Sequence[str],
+                     note: str) -> ShardSpec:
+        """Charge an all-gather of ``uid`` over ``axes`` and return its
+        spec with those axes removed."""
+        spec = self.spec(uid)
+        axes = [a for a in axes if a in spec.axes()]
+        if axes:
+            self._event(op, "all_gather", axes, self.g.nbytes(uid),
+                        implicit=True, note=note)
+            for a in axes:
+                spec = spec.drop_axis(a)
+        return spec
+
+    def _mismatch(self, op: ShardOp, uid: int, have: ShardSpec,
+                  want: ShardSpec, why: str):
+        """PT902: producer spec contradicts consumer expectation —
+        quantify the implicit reshard and continue with ``want``."""
+        moved = _collective_bytes(
+            "reshard", self.g.nbytes(uid),
+            max(have.factor(self.mesh), 2))
+        self._find(
+            "PT902", "warning", op.index,
+            f"implicit reshard at op #{op.index} '{op.name}': input "
+            f"uid {uid} arrives as {have} but {why} expects {want} — "
+            f"~{moved / (1 << 20):.2f} MiB moved "
+            f"(all-gather/all-to-all) every step", op.name)
+        self._event(op, "reshard",
+                    tuple(set(have.axes()) | set(want.axes())),
+                    self.g.nbytes(uid), implicit=True,
+                    note=f"PT902 uid {uid}")
+
+    # -- driver -----------------------------------------------------------
+    def run(self) -> ShardingReport:
+        plan = self.plan
+        for uid, label in self.g.seed_uids():
+            spec = None
+            if plan is not None:
+                if label.startswith("feed:"):
+                    spec = plan.feed_specs.get(label[5:])
+                if spec is None:
+                    spec = plan.external_specs.get(uid)
+            spec = (spec or replicated()).normalized(self._rank(uid))
+            for rid, msg in validate(spec, self.g.shape(uid), self.mesh):
+                sev = "error" if rid in ("PT901", "PT903") else "warning"
+                self._find(rid, sev, -1, f"{label}: {msg}", label)
+            self.env[uid] = self._sanitize(spec)
+
+        for op in self.g.ops:
+            self._consume_partials(op)
+            try:
+                self._dispatch(op)
+            except Exception:
+                # a malformed entry must not kill the whole pass —
+                # replicate its outputs and move on
+                for u in op.out_uids:
+                    self.env.setdefault(u, replicated(self._rank(u)))
+            if op.index not in self.op_parallel:
+                f = 1
+                if op.out_uids:
+                    f = self.spec(op.out_uids[0]).factor(self.mesh)
+                self.op_parallel[op.index] = max(f, 1)
+
+        rep = ShardingReport(
+            name=self.g.name, mesh=self.mesh,
+            plan_name=getattr(plan, "name", "replicated") if plan
+            else "replicated",
+            graph=self.g, specs=dict(self.env),
+            partial=dict(self.partial), events=self.events,
+            findings=self.findings, op_parallel=self.op_parallel)
+        return rep
+
+    def _consume_partials(self, op: ShardOp):
+        """Any op other than an explicit reducing collective that reads
+        a partial-sum value forces the implicit all-reduce the runtime
+        would insert (Megatron row-parallel output meeting the residual
+        add)."""
+        if op.name in ("all_reduce", "reduce_scatter", "reduce"):
+            return
+        for u in op.in_uids:
+            axes = self.partial.pop(u, None)
+            if axes:
+                self._event(op, "all_reduce", axes,
+                            self._nbytes_sharded(u), implicit=True,
+                            note=f"partial-sum uid {u} consumed by "
+                                 f"'{op.name}'")
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, op: ShardOp):
+        name = op.name.lower()
+        if op.name in COLLECTIVE_SET:
+            return self._rule_collective(op)
+        if op.name in P2P_SET:
+            return self._rule_p2p(op)
+        if any(k in name for k in _MATMUL) and "fused" not in name:
+            return self._rule_matmul(op)
+        if op.name in _LASTDIM:
+            return self._rule_lastdim(op)
+        if op.name == "scaled_dot_product_attention":
+            return self._rule_sdpa(op)
+        if op.name in _REDUCE_SUM or op.name in _REDUCE_OTHER:
+            return self._rule_reduce(op)
+        if op.name == "reshape":
+            return self._rule_reshape(op)
+        if op.name in ("transpose", "moveaxis", "swapaxes"):
+            return self._rule_transpose(op)
+        if op.name == "index_select":
+            return self._rule_index_select(op)
+        if op.name in _ELEMENTWISE or name.startswith("fused_") \
+                or name.startswith("recompute::"):
+            return self._rule_elementwise(op)
+        return self._rule_default(op)
+
+    def _rule_default(self, op: ShardOp):
+        """Unknown op: carry the first input's spec to same-rank
+        outputs, replicate the rest.  Never flags."""
+        src = op.in_uids[0] if op.in_uids else None
+        src_spec = self.spec(src) if src is not None else replicated()
+        for u in op.out_uids:
+            if src is not None and self._rank(u) == self._rank(src):
+                self._set(op, u, src_spec)
+            else:
+                self.env[u] = replicated(self._rank(u))
+
+    # resolve one output dim across broadcasting inputs
+    def _rule_elementwise(self, op: ShardOp):
+        tensor_ins = [u for u in op.in_uids if self.g.shape(u)]
+        for out in op.out_uids:
+            oshape = self.g.shape(out)
+            dims: List[Tuple[str, ...]] = []
+            for j, dim in enumerate(oshape):
+                cands: List[Tuple[int, Tuple[str, ...]]] = []
+                for u in tensor_ins:
+                    ishape = self.g.shape(u)
+                    i = j - (len(oshape) - len(ishape))
+                    if i < 0 or (ishape[i] == 1 and dim != 1):
+                        continue
+                    ax = self.spec(u).dim_axes(i)
+                    if ax:
+                        cands.append((u, ax))
+                uniq = {ax for _, ax in cands}
+                if len(uniq) <= 1:
+                    dims.append(cands[0][1] if cands else ())
+                    continue
+                # conflict: keep the largest operand's sharding, the
+                # runtime reshards the rest — PT902 each loser
+                cands.sort(key=lambda c: -self.g.nbytes(c[0]))
+                win_u, win_ax = cands[0]
+                dims.append(win_ax)
+                for u, ax in cands[1:]:
+                    if ax != win_ax:
+                        self._mismatch(
+                            op, u, self.spec(u),
+                            self.spec(win_u),
+                            f"co-input uid {win_u} (dim {j})")
+            # the resolved spec may double-map an axis across dims when
+            # two inputs shard different dims on the same axis
+            spec = self._dedup(op, ShardSpec(dims=tuple(dims)))
+            self._set(op, out, spec)
+
+    def _dedup(self, op: ShardOp, spec: ShardSpec) -> ShardSpec:
+        seen = set()
+        dims = []
+        for d in spec.dims:
+            kept = []
+            for a in d:
+                if a in seen:
+                    continue
+                kept.append(a)
+                seen.add(a)
+            dims.append(tuple(kept))
+        return ShardSpec(dims=tuple(dims))
+
+    def _rule_lastdim(self, op: ShardOp):
+        """softmax / rms_norm / layer_norm: elementwise in shape, but
+        internally reduce over one dim — that dim must be whole."""
+        self._rule_elementwise(op)
+        axis = op.attrs.get("axis", -1)
+        for out in op.out_uids:
+            rank = self._rank(out)
+            if rank == 0:
+                continue
+            ax = axis % rank if isinstance(axis, int) else rank - 1
+            spec = self.spec(out)
+            shard_axes = spec.dim_axes(ax)
+            if shard_axes:
+                src = op.in_uids[0] if op.in_uids else out
+                spec = self._gather_spec(
+                    op, src, shard_axes,
+                    f"{op.name} reduces dim {ax}")
+                self._set(op, out, spec.normalized(rank))
+
+    def _rule_sdpa(self, op: ShardOp):
+        """(batch, seq, heads, head_dim) attention: batch/heads sharding
+        flows through; seq or head_dim sharding needs a gather (no ring
+        attention modelled here)."""
+        self._rule_elementwise(op)
+        for out in op.out_uids:
+            rank = self._rank(out)
+            spec = self.spec(out)
+            bad = []
+            for d in (1, rank - 1):
+                if 0 <= d < rank:
+                    bad.extend(spec.dim_axes(d))
+            if bad:
+                src = op.in_uids[0] if op.in_uids else out
+                spec = self._gather_spec(
+                    op, src, bad, "attention contracts seq/head_dim")
+                self._set(op, out, spec.normalized(rank))
+
+    def _rule_matmul(self, op: ShardOp):
+        if len(op.in_uids) < 2 or not op.out_uids:
+            return self._rule_default(op)
+        a, b = op.in_uids[0], op.in_uids[1]
+        out = op.out_uids[0]
+        ash, bsh, osh = self.g.shape(a), self.g.shape(b), self.g.shape(out)
+        if len(ash) < 2 or len(bsh) < 2 or not osh:
+            return self._rule_default(op)
+        aspec, bspec = self.spec(a), self.spec(b)
+
+        # orientation: does B carry k on dim -2 (normal) or -1
+        # (transpose_y)?  shape-matched; square B defaults to normal.
+        k = ash[-1]
+        if bsh[-2] == k and bsh[-1] == osh[-1]:
+            bk_dim, bn_dim = len(bsh) - 2, len(bsh) - 1
+        elif bsh[-1] == k and bsh[-2] == osh[-1]:
+            bk_dim, bn_dim = len(bsh) - 1, len(bsh) - 2
+        else:
+            return self._rule_default(op)
+
+        rank = len(osh)
+        if rank < 2:
+            return self._rule_default(op)
+        dims: List[Tuple[str, ...]] = [() for _ in range(rank)]
+        # batch dims (everything left of m/n) aligned right among the
+        # batch portions of A and B; both sharded differently = PT902
+        for j in range(rank - 2):
+            ai = j - ((rank - 2) - (len(ash) - 2))
+            a_ax = aspec.dim_axes(ai) if 0 <= ai < len(ash) - 2 else ()
+            bi = j - ((rank - 2) - (len(bsh) - 2))
+            b_ax = (bspec.dim_axes(bi)
+                    if 0 <= bi < len(bsh) - 2 else ())
+            if a_ax and b_ax and a_ax != b_ax:
+                self._mismatch(op, b, bspec, aspec,
+                               f"batch dim {j} of co-input uid {a}")
+                b_ax = ()
+            dims[j] = a_ax or b_ax
+        # m dim from A, n dim from B
+        dims[rank - 2] = aspec.dim_axes(len(ash) - 2)
+        dims[rank - 1] = bspec.dim_axes(bn_dim)
+
+        # contraction-dim agreement: equal (or one-sided) sharding
+        # yields a partial sum; disagreement is an implicit reshard
+        ak = aspec.dim_axes(len(ash) - 1)
+        bk = bspec.dim_axes(bk_dim)
+        partial_axes: Tuple[str, ...] = ()
+        if ak and bk and set(ak) != set(bk):
+            self._mismatch(op, b, bspec, aspec,
+                           "contraction dim of co-input")
+        else:
+            partial_axes = tuple(dict.fromkeys(ak + bk))
+
+        spec = self._dedup(op, ShardSpec(dims=tuple(dims)))
+        self._set(op, out, spec)
+        if partial_axes:
+            self.partial[out] = partial_axes
+        self.op_parallel[op.index] = max(
+            1, spec.factor(self.mesh) * self._axes_factor(partial_axes))
+
+    def _rule_reduce(self, op: ShardOp):
+        if not op.in_uids or not op.out_uids:
+            return self._rule_default(op)
+        src, out = op.in_uids[0], op.out_uids[0]
+        ish, osh = self.g.shape(src), self.g.shape(out)
+        spec = self.spec(src)
+        axis = op.attrs.get("axis")
+        if isinstance(axis, int):
+            reduced = [axis % len(ish)] if ish else []
+        elif isinstance(axis, (list, tuple)):
+            reduced = [a % len(ish) for a in axis]
+        elif len(osh) == len(ish):
+            reduced = [i for i in range(len(ish))
+                       if osh[i] == 1 and ish[i] != 1]
+        else:
+            reduced = list(range(len(osh), len(ish)))
+        red_axes: List[str] = []
+        for d in reduced:
+            red_axes.extend(spec.dim_axes(d))
+        if len(osh) == len(ish):
+            odims = [() if i in reduced else spec.dim_axes(i)
+                     for i in range(len(ish))]
+        else:
+            odims = [spec.dim_axes(i) for i in range(len(osh))]
+        self._set(op, out, ShardSpec(dims=tuple(odims)))
+        if red_axes:
+            if op.name in _REDUCE_SUM:
+                self.partial[out] = tuple(dict.fromkeys(red_axes))
+            else:
+                # max/argmax over a sharded dim: gather the input
+                self._gather_spec(op, src, red_axes,
+                                  f"{op.name} over sharded dim")
+        self.op_parallel[op.index] = max(
+            1, self.spec(out).factor(self.mesh)
+            * self._axes_factor(red_axes))
+
+    def _rule_reshape(self, op: ShardOp):
+        if not op.in_uids or not op.out_uids:
+            return self._rule_default(op)
+        src, out = op.in_uids[0], op.out_uids[0]
+        ish, osh = self.g.shape(src), self.g.shape(out)
+        spec = self.spec(src)
+        if not ish or not osh:
+            return self._rule_default(op)
+        odims: List[Tuple[str, ...]] = [() for _ in osh]
+        for ins, outs in _reshape_groups(ish, osh):
+            sharded = [(pos, d) for pos, d in enumerate(ins)
+                       if spec.dim_axes(d)]
+            if not sharded:
+                continue
+            # the GROUP's leading dim shards contiguous blocks of the
+            # flattened group, so its sharding carries to the group's
+            # leading output dim when divisible; sharding on any later
+            # dim is stride-interleaved after the regroup = gather
+            lead_axes = spec.dim_axes(ins[0])
+            keep_lead = bool(lead_axes) and (
+                osh[outs[0]] % self._axes_factor(lead_axes) == 0)
+            if keep_lead:
+                odims[outs[0]] = lead_axes
+            gather = []
+            for pos, d in sharded:
+                if keep_lead and pos == 0:
+                    continue
+                gather.extend(spec.dim_axes(d))
+            if gather:
+                self._gather_spec(op, src, gather,
+                                  f"reshape {tuple(ish)}->{tuple(osh)} "
+                                  f"regroups a sharded dim")
+        self._set(op, out, ShardSpec(dims=tuple(odims)))
+
+    def _rule_transpose(self, op: ShardOp):
+        if not op.in_uids or not op.out_uids:
+            return self._rule_default(op)
+        src, out = op.in_uids[0], op.out_uids[0]
+        ish, osh = self.g.shape(src), self.g.shape(out)
+        spec = self.spec(src)
+        perm = self._perm(op, ish, osh)
+        if perm is None:
+            if spec.is_replicated:
+                self.env[out] = replicated(len(osh))
+            else:
+                s = self._gather_spec(op, src, spec.axes(),
+                                      "ambiguous permutation of a "
+                                      "sharded tensor")
+                self._set(op, out, s.normalized(len(osh)))
+            return
+        self._set(op, out, ShardSpec(
+            dims=tuple(spec.dim_axes(perm[j]) for j in range(len(osh)))))
+
+    def _perm(self, op: ShardOp, ish, osh) -> Optional[List[int]]:
+        perm = op.attrs.get("perm")
+        if isinstance(perm, (list, tuple)) and len(perm) == len(ish):
+            return [int(p) for p in perm]
+        swap = op.attrs.get("swap")
+        if isinstance(swap, (list, tuple)) and len(swap) == 2:
+            p = list(range(len(ish)))
+            i, j = int(swap[0]) % len(ish), int(swap[1]) % len(ish)
+            p[i], p[j] = p[j], p[i]
+            return p
+        src_d = op.attrs.get("source")
+        dst_d = op.attrs.get("destination")
+        if isinstance(src_d, int) and isinstance(dst_d, int):
+            p = list(range(len(ish)))
+            v = p.pop(src_d % len(ish))
+            p.insert(dst_d % len(ish), v)
+            return p
+        # infer from shapes when dim sizes are unique
+        if sorted(ish) == sorted(osh) and len(set(ish)) == len(ish):
+            remaining = list(enumerate(ish))
+            perm = []
+            for d in osh:
+                for pos, (i, sz) in enumerate(remaining):
+                    if sz == d:
+                        perm.append(i)
+                        remaining.pop(pos)
+                        break
+            return perm
+        return None
+
+    def _rule_index_select(self, op: ShardOp):
+        if len(op.in_uids) < 2 or not op.out_uids:
+            return self._rule_default(op)
+        table, idx = op.in_uids[0], op.in_uids[1]
+        out = op.out_uids[0]
+        axis = int(op.attrs.get("axis", 0))
+        tsh = self.g.shape(table)
+        idx_rank = len(self.g.shape(idx))
+        tspec, ispec = self.spec(table), self.spec(idx)
+        axis = axis % len(tsh) if tsh else 0
+        if tspec.dim_axes(axis):
+            # gathering arbitrary rows of a row-sharded table needs the
+            # whole table on every shard
+            tspec = self._gather_spec(
+                op, table, tspec.dim_axes(axis),
+                "index_select over the sharded dim")
+        dims: List[Tuple[str, ...]] = []
+        for d in range(axis):
+            dims.append(tspec.dim_axes(d))
+        for d in range(idx_rank):
+            dims.append(ispec.dim_axes(d))
+        for d in range(axis + 1, len(tsh)):
+            dims.append(tspec.dim_axes(d))
+        self._set(op, out, self._dedup(op, ShardSpec(dims=tuple(dims))))
+
+    # -- explicit collectives --------------------------------------------
+    def _meta(self, op: ShardOp):
+        m = self.g.meta_for(op.index) or {}
+        axis = m.get("axis")
+        size = m.get("axis_size")
+        if size is None and m.get("ranks"):
+            size = len(m["ranks"])
+        if size is None and axis and self.mesh.has(axis):
+            size = self.mesh.size(axis)
+        is_world = axis in (None, "world") or \
+            str(axis or "").startswith("group_")
+        return axis, (int(size) if size else self.mesh.n_devices), is_world
+
+    def _rule_collective(self, op: ShardOp):
+        axis, size, is_world = self._meta(op)
+        src = op.in_uids[0] if op.in_uids else None
+        out = op.out_uids[0] if op.out_uids else None
+        spec = self.spec(src) if src is not None else replicated()
+        nb = self._nbytes_sharded(src) if src is not None else 0
+        axes = (axis,) if (axis and self.mesh.has(axis)) else ()
+        ctx = f"{op.name}@{axis or 'world'}"
+
+        if op.name in ("all_reduce", "reduce"):
+            part = self.partial.get(src) if src is not None else None
+            consumed = part and (is_world or (axis in part))
+            if consumed:
+                self.partial.pop(src, None)
+            if not consumed and not is_world and src is not None \
+                    and axis not in spec.axes() and not part:
+                self._find(
+                    "PT904", "warning", op.index,
+                    f"all_reduce over axis '{axis}' but its operand is "
+                    f"already replicated on that axis (no partial sum, "
+                    f"no sharding) — the collective moves "
+                    f"~{_collective_bytes('all_reduce', nb, size) / (1 << 20):.2f} "
+                    f"MiB to reproduce the same value", ctx)
+            self.events.append(CommEvent(
+                op.index, op.name, "all_reduce",
+                axes or ("world",),
+                _collective_bytes("all_reduce", nb, size),
+                tier=self._tier(axes), note=ctx))
+            if out is not None:
+                self._set(op, out, spec)
+        elif op.name == "all_gather":
+            if src is not None and axis and axis in spec.axes():
+                spec = spec.drop_axis(axis)
+            elif src is not None and not is_world:
+                self._find(
+                    "PT904", "warning", op.index,
+                    f"all_gather over axis '{axis}' but its operand is "
+                    f"not sharded on that axis — every device already "
+                    f"holds the full value (redundant collective)", ctx)
+            self.events.append(CommEvent(
+                op.index, op.name, "all_gather", axes or ("world",),
+                _collective_bytes("all_gather", self.g.nbytes(src)
+                                  if src is not None else 0, size),
+                tier=self._tier(axes), note=ctx))
+            if out is not None:
+                self._set(op, out, spec.normalized(self._rank(out)))
+        elif op.name == "reduce_scatter":
+            if src is not None:
+                self.partial.pop(src, None)
+            if out is not None and axes:
+                osh = self.g.shape(out)
+                if osh and osh[0] % self._axes_factor(axes) == 0:
+                    spec = spec.normalized(len(osh)).with_dim(
+                        0, spec.dim_axes(0) + axes)
+            self.events.append(CommEvent(
+                op.index, op.name, "reduce_scatter", axes or ("world",),
+                _collective_bytes("reduce_scatter", nb, size),
+                tier=self._tier(axes), note=ctx))
+            if out is not None:
+                self._set(op, out, spec.normalized(self._rank(out)))
+        else:   # all_to_all / broadcast / scatter
+            self.events.append(CommEvent(
+                op.index, op.name, op.name, axes or ("world",),
+                _collective_bytes(op.name, nb, size),
+                tier=self._tier(axes), note=ctx))
+            if out is not None and src is not None:
+                self._set(op, out, spec.normalized(self._rank(out)))
+            elif out is not None:
+                self.env[out] = replicated(self._rank(out))
+
+    def _rule_p2p(self, op: ShardOp):
+        src = op.in_uids[0] if op.in_uids else None
+        nb = self._nbytes_sharded(src) if src is not None else 0
+        self.events.append(CommEvent(
+            op.index, op.name, "p2p", (), nb, tier="ici",
+            note=op.name))
+        self._rule_default(op)
+
+
+def _reshape_groups(ish: Sequence[int], osh: Sequence[int]):
+    """Two-pointer factor grouping: yields (in_dims, out_dims) index
+    lists whose products match — the unit sharding can (or cannot)
+    carry across."""
+    groups = []
+    i = j = 0
+    ni, nj = len(ish), len(osh)
+    while i < ni and j < nj:
+        a, b = int(ish[i]), int(osh[j])
+        ins, outs = [i], [j]
+        while a != b:
+            if a < b:
+                i += 1
+                if i >= ni:
+                    break
+                ins.append(i)
+                a *= int(ish[i])
+            else:
+                j += 1
+                if j >= nj:
+                    break
+                outs.append(j)
+                b *= int(osh[j])
+        groups.append((ins, outs))
+        i += 1
+        j += 1
+    # trailing size-1 dims attach to the last group
+    if groups:
+        while i < ni:
+            groups[-1][0].append(i)
+            i += 1
+        while j < nj:
+            groups[-1][1].append(j)
+            j += 1
+    return groups
+
+
+def propagate(graph: ShardGraph, mesh: MeshSpec,
+              plan=None) -> ShardingReport:
+    """Run sharding propagation over ``graph`` on ``mesh`` under
+    ``plan`` (None = everything replicated: the conservative baseline
+    that can only flag explicit-collective redundancy)."""
+    return _Propagator(graph, mesh, plan).run()
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20),
+                      ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n} B"
+
+
+def render_sharding_report(rep: ShardingReport, top: int = 8) -> str:
+    lines = [f"sharding report — {rep.name} on mesh "
+             f"[{rep.mesh.describe()}] plan={rep.plan_name}",
+             f"  comm volume   : {_fmt_bytes(rep.comm_bytes())} / step "
+             f"(ici {_fmt_bytes(rep.comm_bytes('ici'))}, "
+             f"dcn {_fmt_bytes(rep.comm_bytes('dcn'))})"]
+    by_kind = rep.comm_by_kind()
+    if by_kind:
+        kinds = ", ".join(f"{k}={_fmt_bytes(v)}"
+                          for k, v in sorted(by_kind.items()))
+        lines.append(f"  by kind       : {kinds}")
+    ev = sorted(rep.events, key=lambda e: -e.bytes)[:top]
+    if ev:
+        lines.append("  largest transfers:")
+        for e in ev:
+            tag = "implicit" if e.implicit else "explicit"
+            lines.append(
+                f"    op #{e.op_index:<3d} {e.op_name:<24s} {e.kind:<14s}"
+                f" {_fmt_bytes(e.bytes):>10s}  [{e.tier}/{tag}]"
+                + (f"  {e.note}" if e.note else ""))
+    n_err = sum(1 for f in rep.findings if f.severity == "error")
+    lines.append(f"  findings      : {len(rep.findings)} "
+                 f"({n_err} error)")
+    return "\n".join(lines)

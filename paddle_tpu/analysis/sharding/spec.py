@@ -1,0 +1,288 @@
+"""PartitionSpec-style sharding vocabulary for the PT9xx analyzer.
+
+Two deliberately tiny value types:
+
+- :class:`MeshSpec` — an ordered ``name -> size`` view of a device mesh,
+  plus a per-axis *tier* tag (``"ici"`` within a slice, ``"dcn"`` across
+  slices) so the propagator can price a reshard on the right fabric.
+  Built from a live ``jax.sharding.Mesh`` (``from_mesh`` reads only
+  ``mesh.shape``, so a duck-typed stand-in works), or parsed from the
+  CLI string form ``"dp=2,mp=4"`` / ``"dp=2@dcn,mp=4"``.
+- :class:`ShardSpec` — one PartitionSpec: a tuple with one entry per
+  tensor dim, each ``None`` (replicated), an axis name, or a tuple of
+  axis names (multi-axis sharding of one dim).
+
+Deliberately stdlib-only: the jax-free ``tools/ptshard.py`` CLI and the
+fixture tests load this without the framework.  ``validate`` returns the
+raw PT901/PT903 issues; the propagator owns turning them into engine
+Findings with op context.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["MeshSpec", "ShardSpec", "replicated", "parse_spec"]
+
+_TIERS = ("ici", "dcn")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Ordered mesh axes with sizes and fabric tiers."""
+
+    axes: Tuple[Tuple[str, int], ...]
+    tiers: Tuple[Tuple[str, str], ...] = ()     # (axis, "ici"|"dcn")
+
+    def __post_init__(self):
+        seen = set()
+        for name, size in self.axes:
+            if name in seen:
+                raise ValueError(f"duplicate mesh axis {name!r}")
+            seen.add(name)
+            if int(size) < 1:
+                raise ValueError(f"mesh axis {name!r} has size {size}")
+        for name, tier in self.tiers:
+            if tier not in _TIERS:
+                raise ValueError(f"unknown tier {tier!r} for axis {name!r}")
+
+    @property
+    def sizes(self) -> Dict[str, int]:
+        return {n: int(s) for n, s in self.axes}
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= int(s)
+        return n
+
+    def has(self, name: str) -> bool:
+        return any(n == name for n, _ in self.axes)
+
+    def size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return int(s)
+        raise KeyError(name)
+
+    def tier(self, name: str) -> str:
+        for n, t in self.tiers:
+            if n == name:
+                return t
+        return "ici"
+
+    def describe(self) -> str:
+        parts = []
+        for n, s in self.axes:
+            t = self.tier(n)
+            parts.append(f"{n}={s}" + (f"@{t}" if t != "ici" else ""))
+        return ",".join(parts)
+
+    @classmethod
+    def from_mesh(cls, mesh) -> Optional["MeshSpec"]:
+        """From a live (or duck-typed) jax Mesh.  Axes marked DCN by
+        ``topology.build_hybrid_mesh`` (``mesh._pt_dcn_axes``) keep
+        their tier."""
+        if mesh is None:
+            return None
+        if isinstance(mesh, cls):
+            return mesh
+        shape = getattr(mesh, "shape", None)
+        if shape is None:
+            return None
+        try:
+            items = list(dict(shape).items())
+        except Exception:
+            return None
+        dcn = tuple(getattr(mesh, "_pt_dcn_axes", ()) or ())
+        return cls(axes=tuple((str(n), int(s)) for n, s in items),
+                   tiers=tuple((str(a), "dcn") for a in dcn))
+
+    @classmethod
+    def parse(cls, text: str) -> "MeshSpec":
+        """``"dp=2,mp=4"``; append ``@dcn`` to mark a cross-slice axis:
+        ``"dp=2@dcn,pp=2,mp=2"``."""
+        axes: List[Tuple[str, int]] = []
+        tiers: List[Tuple[str, str]] = []
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"bad mesh axis {part!r} (want name=size)")
+            name, _, rest = part.partition("=")
+            tier = "ici"
+            if "@" in rest:
+                rest, _, tier = rest.partition("@")
+            axes.append((name.strip(), int(rest)))
+            tiers.append((name.strip(), tier.strip() or "ici"))
+        return cls(axes=tuple(axes),
+                   tiers=tuple((n, t) for n, t in tiers if t != "ici"))
+
+
+def _as_dim(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """One PartitionSpec: per-dim axis assignment."""
+
+    dims: Tuple[Tuple[str, ...], ...] = ()
+
+    @classmethod
+    def of(cls, *entries) -> "ShardSpec":
+        """``ShardSpec.of('dp', None, ('mp', 'sep'))``."""
+        return cls(dims=tuple(_as_dim(e) for e in entries))
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def normalized(self, rank: int) -> "ShardSpec":
+        """Pad with replicated dims (or truncate trailing replicated
+        dims) to match a tensor rank."""
+        dims = tuple(self.dims[:rank]) + ((),) * max(0, rank - len(self.dims))
+        return ShardSpec(dims=dims)
+
+    def dim_axes(self, i: int) -> Tuple[str, ...]:
+        if 0 <= i < len(self.dims):
+            return self.dims[i]
+        return ()
+
+    def axes(self) -> Tuple[str, ...]:
+        out: List[str] = []
+        for d in self.dims:
+            out.extend(d)
+        return tuple(out)
+
+    @property
+    def is_replicated(self) -> bool:
+        return not self.axes()
+
+    def factor(self, mesh: MeshSpec) -> int:
+        """Number of shards this spec splits the tensor into."""
+        f = 1
+        for a in self.axes():
+            if mesh.has(a):
+                f *= mesh.size(a)
+        return f
+
+    def dim_factor(self, i: int, mesh: MeshSpec) -> int:
+        f = 1
+        for a in self.dim_axes(i):
+            if mesh.has(a):
+                f *= mesh.size(a)
+        return f
+
+    def sharded_shape(self, shape: Sequence[int],
+                      mesh: MeshSpec) -> Tuple[int, ...]:
+        out = []
+        for i, d in enumerate(shape):
+            f = self.dim_factor(i, mesh)
+            out.append(-(-int(d) // f))          # ceil: padding model
+        return tuple(out)
+
+    def shard_nbytes(self, shape: Sequence[int], itemsize: int,
+                     mesh: MeshSpec) -> int:
+        n = itemsize
+        for d in self.sharded_shape(shape, mesh):
+            n *= int(d)
+        return int(n)
+
+    def with_dim(self, i: int, axes) -> "ShardSpec":
+        dims = list(self.dims)
+        while len(dims) <= i:
+            dims.append(())
+        dims[i] = _as_dim(axes)
+        return ShardSpec(dims=tuple(dims))
+
+    def drop_axis(self, axis: str) -> "ShardSpec":
+        return ShardSpec(dims=tuple(
+            tuple(a for a in d if a != axis) for d in self.dims))
+
+    def __str__(self):
+        if self.is_replicated:
+            return "P(replicated)"
+        parts = []
+        for d in self.dims:
+            if not d:
+                parts.append("-")
+            elif len(d) == 1:
+                parts.append(d[0])
+            else:
+                parts.append("(" + "+".join(d) + ")")
+        return "P[" + ",".join(parts) + "]"
+
+
+def replicated(rank: int = 0) -> ShardSpec:
+    return ShardSpec(dims=((),) * rank)
+
+
+def parse_spec(text: str) -> ShardSpec:
+    """``"dp,-,mp"`` / ``"dp,None,mp+sep"`` — the CLI/plan string form."""
+    entries = []
+    for part in text.split(","):
+        part = part.strip()
+        if part in ("-", "", "None", "none", "*"):
+            entries.append(None)
+        elif "+" in part:
+            entries.append(tuple(p.strip() for p in part.split("+")))
+        else:
+            entries.append(part)
+    return ShardSpec.of(*entries)
+
+
+def validate(spec: ShardSpec, shape: Sequence[int],
+             mesh: MeshSpec) -> List[Tuple[str, str]]:
+    """Raw PT901/PT903 issues for one (spec, shape) pair:
+    ``[(rule_id, message), ...]`` — no op context, the caller adds it.
+
+    PT901: a named axis is absent from the mesh, or one mesh axis is
+    mapped to two tensor dims (each device would need two different
+    slices of the same tensor).  PT903: a sharded dim is not divisible
+    by the product of its mesh-axis sizes — jax ``shard_map`` rejects
+    it, and GSPMD pads silently (wasted memory + compute).
+    """
+    issues: List[Tuple[str, str]] = []
+    seen: Dict[str, int] = {}
+    for i, d in enumerate(spec.dims):
+        for a in d:
+            if not mesh.has(a):
+                tiers = mesh.describe()
+                issues.append((
+                    "PT901",
+                    f"spec {spec} binds axis '{a}' (dim {i}) which is "
+                    f"not on the mesh [{tiers}]"))
+                continue
+            if a in seen:
+                issues.append((
+                    "PT901",
+                    f"spec {spec} maps mesh axis '{a}' to both dim "
+                    f"{seen[a]} and dim {i} — an axis can shard at "
+                    f"most one dim"))
+            seen.setdefault(a, i)
+    for i, d in enumerate(spec.dims):
+        if i >= len(shape):
+            break
+        f = 1
+        for a in d:
+            if mesh.has(a):
+                f *= mesh.size(a)
+        if f > 1 and int(shape[i]) % f != 0:
+            issues.append((
+                "PT903",
+                f"dim {i} of size {shape[i]} is sharded {spec} over "
+                f"{f} shards ({'x'.join(d)}) — not divisible; each "
+                f"shard pads to {-(-int(shape[i]) // f)} rows "
+                f"(silent padding)"))
+    return issues

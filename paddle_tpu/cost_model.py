@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-__all__ = ["CostModel", "StaticCostModel", "op_flops"]
+__all__ = ["CostModel", "StaticCostModel", "op_flops",
+           "collective_bytes"]
 
 
 def _numel(aval) -> int:
@@ -63,6 +64,28 @@ def op_flops(name: str, in_avals: Sequence, out_avals: Sequence) -> int:
         return 5 * out_elems          # exp/sum/div or mean/var/scale
     # elementwise / data-movement floor
     return out_elems
+
+
+def collective_bytes(kind: str, nbytes: int, group_size: int) -> int:
+    """Bytes moved per participant by one collective over a tensor of
+    ``nbytes`` (the FULL, unsharded size) across ``group_size`` devices
+    — the standard ring formulas the PT902 reshard estimates and the
+    static auto-tuner's communication-volume scoring both use:
+
+    - all_gather / reduce_scatter / all_to_all: ``(n-1)/n * nbytes``
+    - all_reduce (reduce-scatter + all-gather): ``2 * (n-1)/n * nbytes``
+    - p2p / broadcast / everything else: ``nbytes``
+    """
+    n = max(int(group_size), 1)
+    if n <= 1:
+        return 0
+    frac = (n - 1) / n
+    if kind in ("all_reduce", "reduce"):
+        return int(2 * nbytes * frac)
+    if kind in ("all_gather", "reduce_scatter", "all_to_all",
+                "all_to_all_single", "reshard"):
+        return int(nbytes * frac)
+    return int(nbytes)
 
 
 class StaticCostModel:

@@ -176,3 +176,15 @@ def prune_by_memory(cands: List[TunerCfg], tuner: AutoTuner
     return [c for c in cands
             if estimate_memory_bytes(c, tuner.n_params, tuner.hidden,
                                      tuner.layers, tuner.seq) < tuner.hbm]
+
+
+# the propagation-backed static tuner rides alongside the calibrated
+# analytic one: same package, program-derived costs (see static_tuner)
+from .static_tuner import (MULTICHIP_VALIDATED, RankedConfig,  # noqa: E402
+                           StaticAutoTuner, StaticConfig, estimate_cost,
+                           pareto_front, rank_table,
+                           top_is_pareto_consistent)
+
+__all__ += ["StaticAutoTuner", "StaticConfig", "RankedConfig",
+            "MULTICHIP_VALIDATED", "pareto_front",
+            "top_is_pareto_consistent", "rank_table", "estimate_cost"]

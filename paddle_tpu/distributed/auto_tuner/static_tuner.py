@@ -1,0 +1,300 @@
+"""Static auto-tuner: rank hybrid-parallel configs from sharding
+propagation alone — no compile, no device, seconds not hours.
+
+Where :class:`..AutoTuner` scores closed-form formulas calibrated to one
+model family, this tuner scores the CAPTURED program: for every
+(dp, pp, sharding, mp) factorization of the chip count it runs the
+PT9xx sharding propagation (``analysis.sharding.propagate``) under the
+megatron plan and reads off
+
+- **communication volume** — the propagated reshard/all-reduce events,
+  priced per-participant by ``cost_model.collective_bytes``;
+- **per-device compute** — per-op FLOPs (``cost_model.op_flops``)
+  divided by each op's propagated parallelism;
+- **peak memory** — a liveness sweep over the SHARDED value sizes plus
+  the analytic param/grad/optimizer-state terms (the ``sharding`` axis
+  is ZeRO-style: states divided, params re-gathered per step at
+  all-gather cost).
+
+The captured graph is one transformer block; ``layers`` scales it to
+the full stack and ``pp`` staging adds the standard pipeline bubble
+``(pp-1)/(m+pp-1)``.
+
+Validation anchor: the MULTICHIP dryrun suite exercises the folded
+configs in :data:`MULTICHIP_VALIDATED` (its ``sep`` degree folds into
+``dp`` here — both shard the batch dimension).  Those dryruns assert
+loss-parity, not step time, so the consistency check is structural:
+the tuner's top pick must not be Pareto-dominated on
+(est_step_ms, est_peak_bytes) by any validated config.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ...analysis.sharding import MeshSpec, propagate
+from ...analysis.sharding.plan import plan_by_name
+from ...cost_model import collective_bytes, op_flops
+
+__all__ = ["StaticConfig", "RankedConfig", "StaticAutoTuner",
+           "MULTICHIP_VALIDATED", "pareto_front",
+           "top_is_pareto_consistent", "rank_table", "estimate_cost"]
+
+# (dp, pp, sharding, mp) configs the MULTICHIP dryrun suite validates
+# for loss parity on 8 chips (dryrun sep degree folded into dp).
+MULTICHIP_VALIDATED: Tuple[Tuple[int, int, int, int], ...] = (
+    (2, 2, 1, 2),
+    (2, 1, 2, 2),
+)
+
+
+@dataclass(frozen=True)
+class StaticConfig:
+    dp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    mp: int = 1
+    recompute: bool = False
+
+    def world(self) -> int:
+        return self.dp * self.pp * self.sharding * self.mp
+
+    def key(self) -> Tuple[int, int, int, int]:
+        return (self.dp, self.pp, self.sharding, self.mp)
+
+    def mesh(self) -> MeshSpec:
+        # all four axes always present (size-1 included) so the plan's
+        # axis lookups and PT901 validation never depend on the degree
+        return MeshSpec(axes=(("dp", self.dp), ("pp", self.pp),
+                              ("sharding", self.sharding),
+                              ("mp", self.mp)))
+
+    def describe(self) -> str:
+        return (f"dp{self.dp}·pp{self.pp}·sh{self.sharding}·mp{self.mp}"
+                f"{'·rc' if self.recompute else ''}")
+
+
+@dataclass
+class RankedConfig:
+    config: StaticConfig
+    est_step_ms: float
+    est_peak_bytes: int
+    comm_bytes: int            # per device per step, all tiers
+    flops_per_device: int
+    bubble: float
+    fits: bool
+    validated: bool = False
+    note: str = ""
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def pareto_front(ranked: List[RankedConfig]) -> List[RankedConfig]:
+    """Configs not dominated on (est_step_ms, est_peak_bytes)."""
+    front = []
+    for r in ranked:
+        if not any(_dominates(o, r) for o in ranked if o is not r):
+            front.append(r)
+    return front
+
+
+def _dominates(a: RankedConfig, b: RankedConfig) -> bool:
+    return (a.est_step_ms <= b.est_step_ms
+            and a.est_peak_bytes <= b.est_peak_bytes
+            and (a.est_step_ms < b.est_step_ms
+                 or a.est_peak_bytes < b.est_peak_bytes))
+
+
+def top_is_pareto_consistent(ranked: List[RankedConfig]) -> bool:
+    """The top pick must not be dominated by a dryrun-validated config:
+    a static model that ranks something above a validated config while
+    that config beats it on BOTH axes is mis-calibrated."""
+    if not ranked:
+        return False
+    top = ranked[0]
+    return not any(_dominates(v, top) for v in ranked if v.validated)
+
+
+class StaticAutoTuner:
+    """Rank (dp, pp, sharding, mp, recompute) over a captured block.
+
+    ``graph`` is an ``analysis.sharding.ShardGraph`` of ONE layer/block
+    at the full (global) batch; ``layers`` extends it to the model.
+    """
+
+    def __init__(self, graph, n_devices: int = 8, layers: int = 32,
+                 micro_batches: int = 8, plan: str = "megatron",
+                 hbm_bytes: float = 95e9, chip_flops: float = 197e12,
+                 mfu: float = 0.5, ici_bw: float = 9e10,
+                 dcn_bw: float = 2.5e9):
+        self.graph = graph
+        self.n_devices = int(n_devices)
+        self.layers = int(layers)
+        self.micro_batches = int(micro_batches)
+        self.plan = plan
+        self.hbm = float(hbm_bytes)
+        self.chip_flops = float(chip_flops) * float(mfu)
+        self.ici_bw = float(ici_bw)
+        self.dcn_bw = float(dcn_bw)
+
+    # -- enumeration ------------------------------------------------------
+
+    def candidates(self) -> List[StaticConfig]:
+        out = []
+        n = self.n_devices
+        for mp in _divisors(n):
+            for pp in _divisors(n // mp):
+                if self.layers % pp != 0:
+                    continue
+                rest = n // (mp * pp)
+                for sh in _divisors(rest):
+                    dp = rest // sh
+                    for rc in (False, True):
+                        out.append(StaticConfig(dp, pp, sh, mp, rc))
+        return out
+
+    # -- scoring ----------------------------------------------------------
+
+    def score(self, cfg: StaticConfig) -> RankedConfig:
+        g = self.graph
+        mesh = cfg.mesh()
+        rep = propagate(g, mesh, plan=plan_by_name(self.plan, g, mesh))
+        layers_here = max(self.layers // cfg.pp, 1)
+
+        # compute: per-op flops over the PROPAGATED parallelism (matmul
+        # contraction splits included via op_parallel; everything else
+        # splits by its output spec's shard factor)
+        fwd = 0
+        for op in g.ops:
+            par = rep.op_parallel.get(op.index)
+            if par is None:
+                par = 1
+                if op.out_uids and op.out_uids[0] in rep.specs:
+                    par = max(rep.specs[op.out_uids[0]].factor(mesh), 1)
+            fwd += _graph_op_flops(g, op) // max(par, 1)
+        step_flops = fwd * layers_here * (4 if cfg.recompute else 3)
+        compute_s = step_flops / self.chip_flops
+
+        # communication: propagated events (fwd) + the bwd mirror (~2x)
+        ici = rep.comm_bytes("ici") * layers_here * 3
+        dcn = rep.comm_bytes("dcn") * layers_here * 3
+        param_dev = sum(rep.sharded_nbytes(u) for u in g.externals) \
+            * layers_here
+        if cfg.dp * cfg.sharding > 1:      # gradient all-reduce
+            ici += collective_bytes("all_reduce", param_dev,
+                                    cfg.dp * cfg.sharding)
+        if cfg.sharding > 1:               # ZeRO param re-gather
+            ici += collective_bytes("all_gather", param_dev, cfg.sharding)
+        comm_s = ici / self.ici_bw + dcn / self.dcn_bw
+
+        # pipeline bubble + boundary activation sends
+        bubble = ((cfg.pp - 1) / (self.micro_batches + cfg.pp - 1)
+                  if cfg.pp > 1 else 0.0)
+        step_s = (compute_s + comm_s) / max(1.0 - bubble, 1e-3)
+        if cfg.pp > 1:
+            act_out = sum(rep.sharded_nbytes(u) for u in g.fetches)
+            step_s += (cfg.pp - 1) * act_out / self.ici_bw
+            ici += (cfg.pp - 1) * act_out
+
+        # memory: analytic param/grad/state terms + sharded activation
+        # liveness (params bf16-as-recorded; grads same size; AdamW
+        # states 2x fp32-ish -> 4x param bytes, ZeRO-divided)
+        states = 4 * param_dev / cfg.sharding
+        grads = param_dev / cfg.sharding
+        act_peak, act_total = _sharded_liveness(g, rep)
+        if cfg.recompute:
+            boundary = sum(rep.sharded_nbytes(u) for u in g.fetches)
+            acts = act_peak + boundary * max(layers_here - 1, 0)
+        else:
+            acts = act_peak + act_total * max(layers_here - 1, 0)
+        peak = int(param_dev + grads + states + acts)
+
+        return RankedConfig(
+            config=cfg, est_step_ms=step_s * 1e3, est_peak_bytes=peak,
+            comm_bytes=int(ici + dcn), flops_per_device=int(step_flops),
+            bubble=bubble, fits=peak <= self.hbm,
+            validated=cfg.key() in MULTICHIP_VALIDATED,
+            note="" if peak <= self.hbm else "over HBM")
+
+    def rank(self) -> List[RankedConfig]:
+        t0 = time.perf_counter()
+        ranked = [self.score(c) for c in self.candidates()]
+        ranked.sort(key=lambda r: (not r.fits, r.est_step_ms,
+                                   r.est_peak_bytes))
+        ms = (time.perf_counter() - t0) * 1e3
+        try:
+            from ...profiler import metrics as _metrics
+
+            _metrics.inc("analysis/tuner_configs_ranked", len(ranked))
+            _metrics.set_gauge("analysis/tuner_rank_ms", ms)
+        except Exception:  # ptlint: disable=PT502 — metrics are an
+            pass           # optional observer; ranking must not fail
+            #                when the registry is absent (jax-free use)
+        return ranked
+
+
+def _graph_op_flops(g, op) -> int:
+    class _Aval:
+        __slots__ = ("shape",)
+
+        def __init__(self, shape):
+            self.shape = shape
+
+    ins = [_Aval(g.shape(u)) for u in op.in_uids]
+    outs = [_Aval(g.shape(u)) for u in op.out_uids]
+    return op_flops(op.name, ins, outs)
+
+
+def _sharded_liveness(g, rep) -> Tuple[int, int]:
+    """(peak, total) bytes of op-produced values under the propagated
+    sharding — externals/params are costed analytically by the caller."""
+    last = g.last_use()
+    frees: Dict[int, List[int]] = {}
+    live = sum(rep.sharded_nbytes(u) for n, u in g.feeds.items())
+    total = 0
+    peak = live
+    for op in g.ops:
+        for u in op.out_uids:
+            b = rep.sharded_nbytes(u)
+            live += b
+            total += b
+            frees.setdefault(last.get(u, op.index), []).append(b)
+        peak = max(peak, live)
+        for b in frees.pop(op.index, ()):
+            live -= b
+    return peak, total
+
+
+def rank_table(ranked: List[RankedConfig], top: int = 10) -> str:
+    lines = ["config                 step_ms    peak      comm/step  "
+             "bubble  fits"]
+    for r in ranked[:top]:
+        mark = " *" if r.validated else ""
+        lines.append(
+            f"  {r.config.describe():<20} {r.est_step_ms:8.2f}  "
+            f"{r.est_peak_bytes / (1 << 30):6.2f}G  "
+            f"{r.comm_bytes / (1 << 20):8.2f}M  "
+            f"{r.bubble:5.2f}  {'yes' if r.fits else 'NO'}{mark}")
+    if any(r.validated for r in ranked):
+        lines.append("  (* = MULTICHIP dryrun-validated config)")
+    return "\n".join(lines)
+
+
+def estimate_cost(program) -> dict:
+    """``CostModel.profile_measure`` hook: static step-time estimate for
+    a captured Program — ranks the parallel-config grid over its graph
+    and returns the best config's numbers (needs jax once, to abstract-
+    evaluate the capture into a ShardGraph)."""
+    from ...analysis.sharding import graph_from_program
+
+    g = graph_from_program(program, None,
+                           name=getattr(program, "name", "program"))
+    ranked = StaticAutoTuner(g).rank()
+    best = ranked[0]
+    return {"time": best.est_step_ms / 1e3,
+            "config": best.config.describe(),
+            "peak_bytes": best.est_peak_bytes,
+            "comm_bytes": best.comm_bytes}

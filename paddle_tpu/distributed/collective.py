@@ -314,8 +314,13 @@ def _track(op_name, group, tensor=None, peer=None) -> _CommRecord:
         meta = getattr(rec, "collective_meta", None)
         if meta is None:
             meta = rec.collective_meta = []
+        # axis_size (not just the axis NAME) and payload bytes are
+        # recorded so PT903/PT904 and the static auto-tuner can score
+        # the collective without re-deriving the mesh from closures
         meta.append({"op": op_name, "gid": g.id,
                      "ranks": tuple(g.ranks), "axis": g.axis_name,
+                     "axis_size": len(g.ranks),
+                     "nbytes": _tensor_nbytes(tensor),
                      "peer": peer, "op_index": len(rec.ops)})
     task = None
     if comm_task_manager.enabled:

@@ -23,8 +23,8 @@ from jax.sharding import Mesh
 from . import collective, env
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup", "build_mesh",
-           "get_hybrid_communicate_group", "set_hybrid_communicate_group",
-           "get_mesh"]
+           "build_hybrid_mesh", "get_hybrid_communicate_group",
+           "set_hybrid_communicate_group", "get_mesh"]
 
 _AXES = ["dp", "pp", "sharding", "sep", "mp"]  # outermost -> innermost
 
@@ -42,6 +42,37 @@ def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None) -> Mesh:
             f"topology {shape} needs {total} devices, have {len(devices)}")
     dev_array = np.asarray(devices[:total]).reshape(shape)
     return Mesh(dev_array, _AXES)
+
+
+def build_hybrid_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1,
+                      dcn_dp=1, dcn_pp=1, dcn_sharding=1,
+                      devices=None) -> Mesh:
+    """Two-tier DCN×ICI hybrid mesh (multi-slice / multi-pod).
+
+    Per axis the total extent is ``ici * dcn`` with the DCN factor
+    outermost (slowest varying), so collectives along a pure-ICI axis
+    never cross the data-center network.  Only the outer axes admit a
+    DCN factor — mp/sep collectives are latency-bound and stay on ICI
+    (the scaling-book rule the default ``build_mesh`` ordering encodes).
+
+    The returned Mesh carries ``_pt_dcn_axes`` — the axis names with a
+    DCN factor — which ``analysis.sharding.MeshSpec.from_mesh`` reads to
+    tier the PT9xx reshard cost estimates (PT901 messages name the tier
+    so a spec typo on a two-tier mesh is diagnosable from the text).
+    """
+    from ..utils.jax_compat import hybrid_device_mesh
+
+    ici = (dp, pp, sharding, sep, mp)
+    dcn = (dcn_dp, dcn_pp, dcn_sharding, 1, 1)
+    dev_array = hybrid_device_mesh(ici, dcn, devices=devices)
+    mesh = Mesh(dev_array, _AXES)
+    dcn_axes = tuple(n for n, d in zip(_AXES, dcn) if int(d) > 1)
+    try:
+        object.__setattr__(mesh, "_pt_dcn_axes", dcn_axes)
+    except Exception:  # ptlint: disable=PT502 — the annotation is a
+        pass           # best-effort hint for MeshSpec.from_mesh; a
+        #                frozen Mesh still works, just untied (ici)
+    return mesh
 
 
 def get_mesh() -> Optional[Mesh]:

@@ -339,7 +339,8 @@ def _rewrite_chains(program, chains, name_of, consumers, fetch_uids):
 # ---------------------------------------------------------------------------
 
 def fusion_candidates(program, max_intensity: float = 8.0,
-                      min_chain: int = 2, feed_spec=None):
+                      min_chain: int = 2, feed_spec=None,
+                      cost_model_fn=None):
     """Rank fusable chains of memory-bound ops by estimated HBM bytes
     saved.
 
@@ -358,13 +359,23 @@ def fusion_candidates(program, max_intensity: float = 8.0,
     a deterministic ranking for a given capture.  ``est_bytes_saved``
     counts each fused-away intermediate twice (the HBM write by its
     producer plus the read by its consumer that fusion eliminates).
+
+    ``cost_model_fn(program, feed_spec)`` overrides the default
+    ``CostModel.static_estimate`` roofline — any report with ``per_op``
+    rows carrying ``index``/``intensity``/``out_bytes`` works, which is
+    how a sharding-aware caller prices intensities on SHARDED shapes
+    (per-device bytes) instead of the full logical ones.
     """
     if not program.ops:
         return []
-    from ..cost_model import CostModel
+    if cost_model_fn is None:
+        from ..cost_model import CostModel
+
+        def cost_model_fn(p, fs):
+            return CostModel().static_estimate(p, feed_spec=fs)
 
     try:
-        rep = CostModel().static_estimate(program, feed_spec=feed_spec)
+        rep = cost_model_fn(program, feed_spec)
     except Exception:
         return []        # abstractly unevaluable capture: nothing to rank
     rows = {r["index"]: r for r in rep.per_op}
@@ -419,7 +430,7 @@ def fusion_candidates(program, max_intensity: float = 8.0,
 
 @register_pass("auto_fuse")
 def auto_fuse(program, max_intensity: float = 8.0, min_chain: int = 2,
-              feed_spec=None, max_regions=None):
+              feed_spec=None, max_regions=None, cost_model_fn=None):
     """Cost-model-driven chain fusion: collapse the ``fusion_candidates``
     chains (roofline-ranked memory-bound regions) into single fused
     entries — the automatic replacement for hand-naming chains via
@@ -435,7 +446,8 @@ def auto_fuse(program, max_intensity: float = 8.0, min_chain: int = 2,
 
     t0 = time.perf_counter()
     cands = fusion_candidates(program, max_intensity=max_intensity,
-                              min_chain=min_chain, feed_spec=feed_spec)
+                              min_chain=min_chain, feed_spec=feed_spec,
+                              cost_model_fn=cost_model_fn)
     if max_regions is not None:
         cands = cands[:max_regions]
     if cands:

@@ -20,7 +20,7 @@ except AttributeError:                  # jax 0.4.x
 
     _IS_NATIVE = False
 
-__all__ = ["shard_map", "axis_size"]
+__all__ = ["shard_map", "axis_size", "hybrid_device_mesh"]
 
 
 def axis_size(axis_name):
@@ -30,6 +30,47 @@ def axis_size(axis_name):
     if fn is not None:
         return fn(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def hybrid_device_mesh(mesh_shape, dcn_mesh_shape, devices=None,
+                       allow_split_physical_axes=False):
+    """``mesh_utils.create_hybrid_device_mesh`` across jax versions.
+
+    Per axis, the device count is ``mesh_shape[i] * dcn_mesh_shape[i]``
+    with the dcn factor laid out across slices (slowest varying).  Two
+    degradations are absorbed here so callers never branch:
+
+    - ``allow_split_physical_axes`` only exists on newer jax — dropped
+      (with its semantics unused) when the signature rejects it;
+    - hosts whose devices carry no ``slice_index`` (CPU, single-slice
+      TPU) make the real helper unusable, so we fall back to a plain
+      row-major reshape — the axis ORDER (dcn outermost per axis) is
+      preserved, which is all the static analyses consume.
+    """
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    total = int(np.prod(mesh_shape)) * int(np.prod(dcn_mesh_shape))
+    try:
+        from jax.experimental import mesh_utils
+
+        kw = {"devices": devices}
+        if allow_split_physical_axes:
+            kw["allow_split_physical_axes"] = True
+        return mesh_utils.create_hybrid_device_mesh(
+            tuple(mesh_shape), tuple(dcn_mesh_shape), **kw)
+    except TypeError:       # older signature: retry without the kwarg
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_hybrid_device_mesh(
+            tuple(mesh_shape), tuple(dcn_mesh_shape), devices=devices)
+    except Exception:
+        if total > len(devices):
+            raise
+        shape = tuple(int(d) * int(i)
+                      for i, d in zip(mesh_shape, dcn_mesh_shape))
+        return np.asarray(devices[:total]).reshape(shape)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,

@@ -107,8 +107,8 @@ def test_fast_mode_selects_gate_rows_only():
     assert gate == ["llama_train", "eager_dispatch", "serving",
                     "spec_decode", "fleet", "fleet_recovery",
                     "host_recovery", "weight_publish", "gateway_storm",
-                    "autoscale_storm"]
-    assert len(bench.WORKLOADS) == 15
+                    "autoscale_storm", "autotune_rank"]
+    assert len(bench.WORKLOADS) == 16
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +338,30 @@ def test_benchgate_spec_decode_row_gated(tmp_path):
     assert _gate(tmp_path, _spec_result(step_ms=1.3), _spec_result()) == 1
     # a baseline predating the spec row gates only the rest
     assert _gate(tmp_path, _spec_result(), _result()) == 0
+
+
+def _tuner_result(configs=40.0, pareto=1.0, rank_ms=35.0):
+    r = _result()
+    r["extra"]["autotune_rank"] = {"autotune_rank": {
+        "configs_ranked": configs, "pareto_consistent": pareto,
+        "rank_ms": rank_ms}}
+    return r
+
+
+def test_benchgate_autotune_rank_row_gated(tmp_path):
+    """autotune_rank: zero slack on configs_ranked and
+    pareto_consistent — a shrunken grid or a validated config
+    dominating the top pick is a tuner bug; rank_ms is recorded but
+    not gated (pure-python noise)."""
+    assert _gate(tmp_path, _tuner_result(), _tuner_result()) == 0
+    assert _gate(tmp_path, _tuner_result(configs=39.0),
+                 _tuner_result()) == 1
+    assert _gate(tmp_path, _tuner_result(pareto=0.0),
+                 _tuner_result()) == 1
+    assert _gate(tmp_path, _tuner_result(rank_ms=80.0),
+                 _tuner_result()) == 0
+    # a baseline predating the row gates only the rest
+    assert _gate(tmp_path, _tuner_result(), _result()) == 0
 
 
 def test_benchgate_reads_partial_jsonl_stream(tmp_path):

@@ -1,0 +1,292 @@
+"""ptshard — sharding-propagation analyzer (PT9xx) unit tests.
+
+Fixture matrix: for each rule PT901–PT905 one violating and one
+conforming hand-built ShardGraph (jax-free — the graphs are built from
+plain ints, exactly what ``tools/ptshard.py`` consumes), plus
+divisibility edges, reshape sharding carry, the megatron plan's
+col/row alternation, two-tier mesh parsing, and JSON round-trip.
+"""
+import pytest
+
+from paddle_tpu.analysis.sharding import (MeshSpec, ShardGraph, ShardOp,
+                                          ShardSpec, ShardingPlan,
+                                          check_stage_boundaries,
+                                          megatron_plan, parse_spec,
+                                          plan_by_name, propagate,
+                                          replicated_plan)
+from paddle_tpu.analysis.sharding.spec import validate
+
+MESH = MeshSpec.parse("dp=2,mp=2")
+
+
+def G(ops, shapes, feeds, externals=(), fetches=(), collectives=(),
+      name="fix"):
+    return ShardGraph(
+        name=name,
+        ops=[ShardOp(i, n, tuple(ins), tuple(outs), dict(attrs))
+             for i, (n, ins, outs, attrs) in enumerate(ops)],
+        shapes=dict(shapes), itemsize={},
+        feeds=dict(feeds), externals=list(externals),
+        fetches=list(fetches), collectives=list(collectives))
+
+
+def plan_for(feeds=None, exts=None):
+    return ShardingPlan(name="fix", feed_specs=dict(feeds or {}),
+                        external_specs=dict(exts or {}))
+
+
+def rules(rep):
+    return sorted({f.rule_id for f in rep.findings})
+
+
+# ---------------------------------------------------------------- PT901
+
+def test_pt901_unknown_axis_flagged_and_message_names_mesh():
+    g = G([("relu", [1], [2], {})], {1: (4, 8), 2: (4, 8)}, {"x": 1},
+          fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("tp")}))
+    assert rules(rep) == ["PT901"]
+    (f,) = rep.findings
+    assert f.severity == "error" and "tp" in f.message
+    assert "dp=2" in f.message        # the mesh is named in the text
+    # propagation continued: the bad axis was dropped, not fatal
+    assert rep.specs[2].is_replicated
+
+
+def test_pt901_double_mapped_axis():
+    g = G([("relu", [1], [2], {})], {1: (4, 8), 2: (4, 8)}, {"x": 1},
+          fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("dp", "dp")}))
+    assert "PT901" in rules(rep)
+
+
+def test_pt901_conforming_axes_clean():
+    g = G([("relu", [1], [2], {})], {1: (4, 8), 2: (4, 8)}, {"x": 1},
+          fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("dp", "mp")}))
+    assert rep.findings == []
+    assert str(rep.specs[2]) == "P[dp,mp]"
+
+
+# ---------------------------------------------------------------- PT902
+
+def test_pt902_elementwise_conflict_flags_and_charges_reshard():
+    g = G([("add", [1, 2], [3], {})],
+          {1: (8, 8), 2: (8, 8), 3: (8, 8)}, {"a": 1, "b": 2},
+          fetches=[3])
+    rep = propagate(g, MESH, plan_for({"a": ShardSpec.of("dp"),
+                                       "b": ShardSpec.of("mp")}))
+    pt902 = [f for f in rep.findings if f.rule_id == "PT902"]
+    assert pt902 and pt902[0].severity == "warning"
+    assert "MiB" in pt902[0].message      # bytes are quantified
+    assert any(e.kind == "reshard" and e.implicit for e in rep.events)
+
+
+def test_pt902_matmul_conflicting_contraction():
+    g = G([("matmul", [1, 2], [3], {})],
+          {1: (8, 8), 2: (8, 8), 3: (8, 8)}, {"a": 1, "b": 2},
+          fetches=[3])
+    # contraction dim sharded dp on one side, mp on the other
+    rep = propagate(g, MESH,
+                    plan_for({"a": ShardSpec.of(None, "dp"),
+                              "b": ShardSpec.of("mp", None)}))
+    assert "PT902" in rules(rep)
+
+
+def test_pt902_conforming_aligned_operands_clean():
+    g = G([("add", [1, 2], [3], {})],
+          {1: (8, 8), 2: (8, 8), 3: (8, 8)}, {"a": 1, "b": 2},
+          fetches=[3])
+    rep = propagate(g, MESH, plan_for({"a": ShardSpec.of("dp"),
+                                       "b": ShardSpec.of("dp")}))
+    assert rep.findings == [] and not rep.events
+    assert str(rep.specs[3]) == "P[dp,-]"
+
+
+# ---------------------------------------------------------------- PT903
+
+def test_pt903_indivisible_feed_dim():
+    g = G([("relu", [1], [2], {})], {1: (3, 8), 2: (3, 8)}, {"x": 1},
+          fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("dp")}))
+    assert rules(rep) == ["PT903"]
+    assert rep.findings[0].severity == "error"
+    assert rep.findings[0].line == 0          # seed-time, before op 0
+
+
+def test_pt903_divisibility_edges():
+    # dim == factor divides exactly; dim < factor always pads
+    ok = validate(ShardSpec.of("dp"), (2, 8), MESH)
+    assert ok == []
+    bad = validate(ShardSpec.of("dp"), (1, 8), MESH)
+    assert [r for r, _ in bad] == ["PT903"]
+    # multi-axis dim: factor is the product (dp*mp = 4)
+    bad2 = validate(ShardSpec.of(("dp", "mp")), (6, 8), MESH)
+    assert [r for r, _ in bad2] == ["PT903"]
+    assert validate(ShardSpec.of(("dp", "mp")), (8, 8), MESH) == []
+
+
+def test_pt903_conforming_divisible_clean():
+    g = G([("relu", [1], [2], {})], {1: (4, 8), 2: (4, 8)}, {"x": 1},
+          fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("dp")}))
+    assert rep.findings == []
+
+
+# ---------------------------------------------------------------- PT904
+
+def test_pt904_all_reduce_of_replicated_value():
+    g = G([("all_reduce", [1], [2], {})], {1: (4, 4), 2: (4, 4)},
+          {"x": 1}, fetches=[2],
+          collectives=[{"op_index": 0, "op": "all_reduce",
+                        "axis": "mp", "axis_size": 2}])
+    rep = propagate(g, MESH, replicated_plan())
+    assert rules(rep) == ["PT904"]
+    assert "replicated" in rep.findings[0].message
+
+
+def test_pt904_all_gather_of_unsharded_value():
+    g = G([("all_gather", [1], [2], {})], {1: (4, 4), 2: (8, 4)},
+          {"x": 1}, fetches=[2],
+          collectives=[{"op_index": 0, "op": "all_gather",
+                        "axis": "mp", "axis_size": 2}])
+    rep = propagate(g, MESH, replicated_plan())
+    assert rules(rep) == ["PT904"]
+
+
+def test_pt904_conforming_all_reduce_consumes_partial():
+    # row-split matmul -> partial sum -> explicit all_reduce: the
+    # textbook Megatron 'g'; no finding, exactly one charged event
+    g = G([("matmul", [1, 2], [3], {}),
+           ("all_reduce", [3], [4], {})],
+          {1: (4, 8), 2: (8, 4), 3: (4, 4), 4: (4, 4)},
+          {"x": 1}, externals=[2], fetches=[4],
+          collectives=[{"op_index": 1, "op": "all_reduce",
+                        "axis": "mp", "axis_size": 2}])
+    rep = propagate(g, MESH,
+                    plan_for({"x": ShardSpec.of(None, "mp")},
+                             {2: ShardSpec.of("mp", None)}))
+    assert rep.findings == []
+    assert [e.kind for e in rep.events] == ["all_reduce"]
+    assert not rep.partial                  # consumed, not pending
+
+
+def test_pt904_conforming_all_gather_of_sharded_value():
+    g = G([("all_gather", [1], [2], {})], {1: (4, 4), 2: (8, 4)},
+          {"x": 1}, fetches=[2],
+          collectives=[{"op_index": 0, "op": "all_gather",
+                        "axis": "mp", "axis_size": 2}])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("mp")}))
+    assert rep.findings == []
+    assert "mp" not in rep.specs[2].axes()   # gathered away
+
+
+# ---------------------------------------------------------------- PT905
+
+def _stage(name, spec_plan):
+    g = G([("relu", [1], [2], {})], {1: (4, 8), 2: (4, 8)},
+          {"x": 1}, fetches=[2], name=name)
+    return g, spec_plan
+
+
+def test_pt905_stage_boundary_mismatch():
+    g0, p0 = _stage("s0", plan_for({"x": ShardSpec.of("dp")}))
+    g1, p1 = _stage("s1", replicated_plan())
+    findings = check_stage_boundaries([g0, g1], MESH, plans=[p0, p1])
+    assert [f.rule_id for f in findings] == ["PT905"]
+    assert findings[0].severity == "error"
+    assert "boundary:0->1" in findings[0].line_text
+
+
+def test_pt905_conforming_matched_stages():
+    g0, p0 = _stage("s0", plan_for({"x": ShardSpec.of("dp")}))
+    g1, p1 = _stage("s1", plan_for({"x": ShardSpec.of("dp")}))
+    assert check_stage_boundaries([g0, g1], MESH, plans=[p0, p1]) == []
+
+
+# ------------------------------------------------- propagation mechanics
+
+def test_reshape_carries_leading_group_sharding():
+    g = G([("reshape", [1], [2], {})], {1: (4, 8), 2: (2, 2, 8)},
+          {"x": 1}, fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of("dp")}))
+    assert rep.findings == [] and not rep.events
+    assert rep.specs[2].dim_axes(0) == ("dp",)
+
+
+def test_reshape_gathers_non_leading_sharded_dim():
+    g = G([("reshape", [1], [2], {})], {1: (4, 8), 2: (32,)},
+          {"x": 1}, fetches=[2])
+    rep = propagate(g, MESH, plan_for({"x": ShardSpec.of(None, "mp")}))
+    assert rep.findings == []
+    assert any(e.kind == "all_gather" and e.implicit for e in rep.events)
+    assert rep.specs[2].is_replicated
+
+
+def test_megatron_plan_col_row_alternation_and_single_allreduce():
+    # x @ W1 -> relu -> @ W2 -> relu : W1 col-split, W2 row-split, one
+    # implicit all-reduce where the partial is consumed
+    g = G([("linear", [1, 2], [4], {}),
+           ("relu", [4], [5], {}),
+           ("linear", [5, 3], [6], {}),
+           ("relu", [6], [7], {})],
+          {1: (4, 16), 2: (16, 32), 3: (32, 16),
+           4: (4, 32), 5: (4, 32), 6: (4, 16), 7: (4, 16)},
+          {"x": 1}, externals=[2, 3], fetches=[7])
+    plan = megatron_plan(g, MESH)
+    assert plan.feed_specs["x"].dim_axes(0) == ("dp",)
+    assert plan.external_specs[2].dim_axes(1) == ("mp",)   # col-split
+    assert plan.external_specs[3].dim_axes(0) == ("mp",)   # row-split
+    rep = propagate(g, MESH, plan)
+    assert rep.findings == []
+    ars = [e for e in rep.events if e.kind == "all_reduce"]
+    assert len(ars) == 1 and ars[0].implicit
+    assert str(rep.specs[7]) == "P[dp,-]"
+
+
+def test_mesh_two_tier_parse_and_tiering():
+    m = MeshSpec.parse("dp=2@dcn,mp=4")
+    assert m.tier("dp") == "dcn" and m.tier("mp") == "ici"
+    assert m.n_devices == 8
+    assert "dp=2@dcn" in m.describe()
+    g = G([("add", [1, 2], [3], {})],
+          {1: (8, 8), 2: (8, 8), 3: (8, 8)}, {"a": 1, "b": 2},
+          fetches=[3])
+    rep = propagate(g, m, plan_for({"a": ShardSpec.of("dp"),
+                                    "b": ShardSpec.of("mp")}))
+    # the reshard touches the dcn-tier dp axis -> event tiered dcn
+    assert any(e.tier == "dcn" for e in rep.events)
+    assert rep.comm_bytes("dcn") > 0
+
+
+def test_parse_spec_and_str_roundtrip():
+    s = parse_spec("dp,-,mp+sharding")
+    assert s.dim_axes(0) == ("dp",)
+    assert s.dim_axes(1) == ()
+    assert s.dim_axes(2) == ("mp", "sharding")
+    assert str(s) == "P[dp,-,(mp+sharding)]"
+
+
+def test_graph_json_roundtrip_preserves_propagation():
+    g = G([("matmul", [1, 2], [3], {}),
+           ("all_reduce", [3], [4], {})],
+          {1: (4, 8), 2: (8, 4), 3: (4, 4), 4: (4, 4)},
+          {"x": 1}, externals=[2], fetches=[4],
+          collectives=[{"op_index": 1, "op": "all_reduce",
+                        "axis": "mp", "axis_size": 2}])
+    g2 = ShardGraph.from_json(g.to_json())
+    plan = plan_for({"x": ShardSpec.of(None, "mp")},
+                    {2: ShardSpec.of("mp", None)})
+    r1 = propagate(g, MESH, plan)
+    r2 = propagate(g2, MESH, plan)
+    assert [f.key() for f in r1.findings] == [f.key() for f in r2.findings]
+    assert [(e.kind, e.bytes) for e in r1.events] \
+        == [(e.kind, e.bytes) for e in r2.events]
+    assert {u: str(s) for u, s in r1.specs.items()} \
+        == {u: str(s) for u, s in r2.specs.items()}
+
+
+def test_plan_by_name_rejects_unknown():
+    g = G([], {}, {})
+    with pytest.raises(ValueError):
+        plan_by_name("zigzag", g, MESH)

@@ -1,0 +1,179 @@
+"""ptshard keeps the repo's own captures clean, and the static
+auto-tuner it powers ranks the parallel-config grid fast.
+
+- every preset capture (mlp, llama block, decode step) must propagate
+  under the megatron plan on the demo mesh with ZERO non-baselined
+  PT9xx findings — the same bar the PT1xx–PT8xx families hold;
+- the ``--program llama --families PT9`` CLI route exits 0;
+- the jax-free ``tools/ptshard.py`` CLI round-trips a serialized graph
+  (clean exit 0 / finding exit 1 / SARIF well-formed);
+- the StaticAutoTuner ranks the full grid (>= 24 configs) for the
+  llama block in well under 10 s and its top pick is
+  Pareto-consistent with the MULTICHIP dryrun-validated configs.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.analysis.main import main as analysis_main
+from paddle_tpu.analysis.program.capture import PRESETS
+from paddle_tpu.analysis.sharding import (MeshSpec, check_sharding,
+                                          graph_from_program)
+from paddle_tpu.analysis.program.dataflow import abstract_run
+from paddle_tpu.analysis.program.ir import ProgramIR
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("preset", ["mlp", "llama-block", "decode"])
+def test_presets_clean_under_megatron(preset):
+    cap = PRESETS[preset]()
+    ir = ProgramIR(cap.program, feed_spec=cap.feed_spec, name=cap.name)
+    env, _ = abstract_run(ir)
+    findings, rep = check_sharding(ir, env, "dp=2,mp=2",
+                                   plan="megatron")
+    assert findings == [], [f.message for f in findings]
+    assert rep.plan_name == "megatron"
+    # the megatron plan actually engages: TP produces partial-sum
+    # all-reduces on the matmul-bearing presets
+    if preset != "decode":
+        assert any(e.kind == "all_reduce" for e in rep.events)
+
+
+def test_cli_program_mode_pt9_families_clean(capsys):
+    # the acceptance route: PT9 family selection reaches program mode
+    assert analysis_main(["--program", "llama", "--families", "PT9",
+                          "--no-baseline"]) == 0
+    out = capsys.readouterr().out
+    assert "sharding report" in out
+    assert "0 finding(s)" in out
+
+
+def test_cli_mesh_none_disables_pass(capsys):
+    assert analysis_main(["--program", "mlp", "--families", "PT9",
+                          "--mesh", "none", "--no-baseline"]) == 0
+    assert "sharding report" not in capsys.readouterr().out
+
+
+def _run_ptshard(args, cwd):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ptshard.py")]
+        + args, capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+def test_tools_ptshard_jaxfree_roundtrip(tmp_path):
+    cap = PRESETS["llama-block"]()
+    g = graph_from_program(cap.program, cap.feed_spec, name=cap.name)
+    p = tmp_path / "block.json"
+    p.write_text(g.to_json())
+
+    r = _run_ptshard([str(p), "--mesh", "dp=2,mp=2", "--report"],
+                     str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "0 finding(s)" in r.stdout
+    assert "comm volume" in r.stdout
+
+    r2 = _run_ptshard([str(p), "--mesh", "dp=2,mp=2", "--format",
+                       "sarif"], str(tmp_path))
+    sarif = json.loads(r2.stdout)
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["tool"]["driver"]["name"] == "ptshard"
+    assert sarif["runs"][0]["results"] == []      # clean capture
+
+
+def test_tools_ptshard_finding_exit_and_baseline(tmp_path):
+    from paddle_tpu.analysis.sharding import ShardGraph, ShardOp
+
+    # indivisible batch under dp=2 via megatron plan -> PT903... the
+    # plan skips non-divisible feeds, so hand a graph with a recorded
+    # redundant collective instead (PT904 fires plan-independently)
+    g = ShardGraph(
+        name="bad",
+        ops=[ShardOp(0, "all_reduce", (1,), (2,), {})],
+        shapes={1: (4, 4), 2: (4, 4)}, itemsize={}, feeds={"x": 1},
+        externals=[], fetches=[2],
+        collectives=[{"op_index": 0, "op": "all_reduce", "axis": "mp",
+                      "axis_size": 2}])
+    p = tmp_path / "bad.json"
+    p.write_text(g.to_json())
+
+    r = _run_ptshard([str(p)], str(tmp_path))
+    assert r.returncode == 1
+    assert "PT904" in r.stdout
+
+    # SARIF carries the PT9xx rule metadata for fired rules
+    rs = _run_ptshard([str(p), "--format", "sarif", "--no-baseline"],
+                      str(tmp_path))
+    sarif = json.loads(rs.stdout)
+    drv = sarif["runs"][0]["tool"]["driver"]
+    assert [r["id"] for r in drv["rules"]] == ["PT904"]
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "PT904"
+
+    # grandfather it, then the same run is clean; prune keeps it live
+    rw = _run_ptshard([str(p), "--write-baseline"], str(tmp_path))
+    assert rw.returncode == 0, rw.stderr
+    rb = _run_ptshard([str(p)], str(tmp_path))
+    assert rb.returncode == 0
+    assert "1 baselined" in rb.stdout
+    ru = _run_ptshard([str(p), "--update-baseline"], str(tmp_path))
+    assert ru.returncode == 0
+    assert "kept 1 live" in ru.stdout
+
+
+def test_static_tuner_ranks_grid_fast_and_pareto_consistent():
+    from paddle_tpu.distributed.auto_tuner import (
+        MULTICHIP_VALIDATED, StaticAutoTuner, pareto_front, rank_table,
+        top_is_pareto_consistent)
+
+    cap = PRESETS["llama-block"]()
+    g = graph_from_program(cap.program, cap.feed_spec, name=cap.name)
+    t0 = time.perf_counter()
+    tuner = StaticAutoTuner(g, n_devices=8, layers=32)
+    ranked = tuner.rank()
+    dt = time.perf_counter() - t0
+    assert dt < 10.0, f"ranking took {dt:.1f}s"
+    assert len(ranked) >= 24
+    # every config is a legal factorization of the chip count
+    assert all(r.config.world() == 8 for r in ranked)
+    # the dryrun-validated configs are present and marked
+    marked = {r.config.key() for r in ranked if r.validated}
+    assert marked == set(MULTICHIP_VALIDATED)
+    assert top_is_pareto_consistent(ranked)
+    assert ranked[0] in pareto_front(ranked)
+    # deterministic: same graph, same ranking
+    again = StaticAutoTuner(g, n_devices=8, layers=32).rank()
+    assert [r.config for r in again] == [r.config for r in ranked]
+    table = rank_table(ranked)
+    assert "step_ms" in table and "dryrun-validated" in table
+
+
+def test_static_tuner_scores_scale_sanely():
+    from paddle_tpu.distributed.auto_tuner import StaticAutoTuner, \
+        StaticConfig
+
+    cap = PRESETS["llama-block"]()
+    g = graph_from_program(cap.program, cap.feed_spec, name=cap.name)
+    tuner = StaticAutoTuner(g, n_devices=8, layers=32)
+    plain = tuner.score(StaticConfig(1, 1, 1, 8))
+    rc = tuner.score(StaticConfig(1, 1, 1, 8, recompute=True))
+    # recompute trades compute for memory
+    assert rc.est_step_ms > plain.est_step_ms
+    assert rc.est_peak_bytes <= plain.est_peak_bytes
+    # mp=8 moves more bytes than mp=2 (wider TP all-reduces)
+    mp2 = tuner.score(StaticConfig(2, 2, 1, 2))
+    assert plain.comm_bytes > mp2.comm_bytes
+    # pipeline staging introduces a bubble
+    assert mp2.bubble > 0 and plain.bubble == 0
+
+
+def test_estimate_cost_hook_feeds_cost_model():
+    from paddle_tpu.cost_model import CostModel
+
+    cap = PRESETS["mlp"]()
+    out = CostModel().profile_measure(cap.program)
+    assert out.get("time") is not None and out["time"] > 0
+    assert "config" in out

@@ -198,6 +198,13 @@ _PUBLISH_GATES = {"requests_completed": True, "bitwise_match": True,
 # intersection), so the gate phases in.
 _AUTOSCALE_GATES = {"requests_completed": True, "bitwise_match": True,
                     "goodput_rps": True, "scaleup_to_traffic_s": False}
+# autotune_rank: the static tuner must keep ranking the FULL parallel-
+# config grid and its top pick must stay Pareto-consistent with the
+# MULTICHIP dryrun-validated configs — both zero-slack (a shrunken grid
+# or a dominated top pick is a tuner bug, not noise).  rank_ms is
+# recorded in the row but not gated: tens of milliseconds of pure
+# python is too noisy for a 5% latency gate.
+_AUTOTUNE_GATES = {"configs_ranked": True, "pareto_consistent": True}
 _CHAOS_ROWS = (
     # fleet_recovery: one replica killed mid-decode; host_recovery: a
     # whole host's replicas felled at once; gateway_storm: every
@@ -213,6 +220,8 @@ _CHAOS_ROWS = (
      ("requests_completed", "bitwise_match")),
     ("autoscale_storm", _AUTOSCALE_GATES,
      ("requests_completed", "bitwise_match")),
+    ("autotune_rank", _AUTOTUNE_GATES,
+     ("configs_ranked", "pareto_consistent")),
 )
 _RECOVERY_ROWS = tuple(r for r, _, _ in _CHAOS_ROWS)
 

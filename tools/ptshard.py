@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""ptshard — standalone entry point for the PT9xx sharding-propagation
+analyzer over serialized ShardGraph JSON (``ShardGraph.to_json``).
+
+Loads the analysis package directly from source files so it runs even
+when paddle_tpu isn't installed and without importing the framework
+(no jax import — propagation is pure shape/spec arithmetic).
+
+Usage:
+  python tools/ptshard.py capture.json --mesh dp=2,mp=4
+  python tools/ptshard.py s0.json s1.json --pipeline   # PT905 boundaries
+  python tools/ptshard.py capture.json --format sarif
+  python tools/ptshard.py capture.json --update-baseline
+
+For presets (jax available) prefer the framework route:
+  python -m paddle_tpu.analysis --program llama --families PT9
+"""
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Import paddle_tpu.analysis as a detached package (skipping
+    paddle_tpu/__init__.py and its jax import).  The stub parent carries
+    a real __path__, so the propagator's lazy ``paddle_tpu.cost_model``
+    import (collective_bytes pricing) also resolves jax-free."""
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    import types
+
+    parent = types.ModuleType("paddle_tpu")
+    parent.__path__ = [os.path.join(_REPO, "paddle_tpu")]
+    sys.modules.setdefault("paddle_tpu", parent)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    _load_analysis()
+    from paddle_tpu.analysis.sharding.cli import main
+
+    sys.exit(main())

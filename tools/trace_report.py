@@ -138,6 +138,10 @@ KNOWN_METRICS = (
     "analysis/verify_failures",
     # concurrency analyzer (ptrace: PT7xx races + PT8xx protocols)
     "analysis/conc_runs", "analysis/conc_findings",
+    # sharding propagation (ptshard: PT9xx) + the static auto-tuner it
+    # powers (distributed/auto_tuner/static_tuner.py)
+    "analysis/shard_runs", "analysis/shard_findings",
+    "analysis/tuner_configs_ranked", "analysis/tuner_rank_ms",
     # distributed tracing + crash flight recorder (profiler/tracing.py)
     "trace/*",
     # fleet metrics aggregation plane (profiler/aggregate.py):
