@@ -968,6 +968,140 @@ def bench_host_recovery(on_tpu):
     }}
 
 
+def bench_fleet_subprocess(on_tpu):
+    """Process-isolated fleet gate row (ISSUE 20): two SUBPROCESS
+    replicas (inference/remote_replica.py) behind the router + fleet
+    supervisor; ``sigkill@replica`` SIGKILLs one worker PROCESS
+    mid-decode.  Unlike ``fleet_recovery`` the failure is a real pod
+    kill: the parent infers death from missed heartbeats, the drain's
+    dead-process path requeues the victim's streams to the surviving
+    worker, and a fresh process is respawned through the factory.
+    Gate signals: every admitted request completes and every finished
+    stream stays token-bitwise-identical to the uninterrupted
+    in-process reference (zero-slack both); drain and respawn wall
+    times are recorded alongside (not zero-slack — respawn pays a
+    full interpreter + jax start)."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.resilience import faults
+    from paddle_tpu.inference.fleet_supervisor import (
+        FleetSupervisor, FleetSupervisorConfig)
+    from paddle_tpu.inference.remote_replica import (
+        SubprocessReplicaFactory, sweep_orphans)
+    from paddle_tpu.inference.router import ReplicaRouter
+    from paddle_tpu.inference.serving import (PagedCausalLM,
+                                              PagedServingConfig,
+                                              SamplingParams,
+                                              ServingEngine)
+
+    n_req, prompt_len, max_new = 6, 12, 6
+    cfg_kwargs = dict(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=64,
+        max_batch=4, max_blocks_per_seq=6, token_budget=32)
+    model_seed = 0
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, cfg_kwargs["vocab_size"], prompt_len))
+               for _ in range(n_req)]
+    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+    def pin(engine, rid, key):
+        r = engine._requests[rid]
+        r.salt_rid, r.salt_seed = int(key), 0
+
+    # uninterrupted in-process reference: same model seed the workers
+    # rebuild from, streams keyed by their pinned salt identity
+    cfg = PagedServingConfig(**cfg_kwargs)
+    paddle.seed(model_seed)
+    with jax.default_device(jax.devices("cpu")[0]):
+        model = PagedCausalLM(cfg)
+    model.eval()
+    ref_eng = ServingEngine.from_model(model, cfg, seed=99)
+    ref = {}
+    for i, p in enumerate(prompts):
+        rid = ref_eng.add_request(list(p), max_new_tokens=max_new,
+                                  sampling=sp)
+        pin(ref_eng, rid, 3000 + i)
+        ref[3000 + i] = rid
+    while ref_eng.pending():
+        ref_eng.step()
+    ref = {k: list(ref_eng._requests[rid].generated)
+           for k, rid in ref.items()}
+
+    factory = SubprocessReplicaFactory(
+        cfg_kwargs, model_seed=model_seed, seed_base=10,
+        pid_dir=tempfile.mkdtemp(prefix="bench_subproc_"),
+        hb_interval_s=0.25, hb_miss_n=40, ack_timeout=5.0,
+        rpc_timeout=300.0, spawn_timeout=300.0)
+    row = {}
+    try:
+        router = ReplicaRouter([factory.build(0), factory.build(1)])
+        sup = FleetSupervisor(router, factory.make_engine_factory(),
+                              cfg=FleetSupervisorConfig(restart=False))
+        recovery = {}
+        on_failure = sup.on_failure
+
+        def timed_failure(idx):
+            t0 = time.perf_counter()
+            on_failure(idx)
+            recovery["s"] = recovery.get("s", 0.0) \
+                + (time.perf_counter() - t0)
+        router.failure_hook = timed_failure
+
+        # warm round: compile both children's decode graphs so the
+        # chaos round measures the fleet, not jax tracing
+        warm = [router.submit(prompts[i], max_new_tokens=max_new,
+                              sampling=sp, prefer=i) for i in range(2)]
+        router.run_to_completion(max_steps=100000)
+
+        victim = router.replicas[1].engine
+        faults.arm(f"sigkill@replica#3:rank={victim.child_rank}")
+        hs = {}
+        for i, p in enumerate(prompts):
+            h = router.submit(p, max_new_tokens=max_new, sampling=sp)
+            idx, rid = router._handles[h]
+            pin(router.replicas[idx].engine, rid, 3000 + i)
+            hs[h] = 3000 + i
+        t0 = time.perf_counter()
+        deadline = t0 + 240.0
+        while router._live_pending() \
+                and time.perf_counter() < deadline:
+            router.step_all()
+            time.sleep(0.005)
+        total_s = time.perf_counter() - t0
+        faults.disarm()
+        out = router.results()
+
+        completed = sum(1 for h in hs if len(out[h]) == max_new)
+        bitwise = all(out[h] == ref[k] for h, k in hs.items())
+
+        # respawn through the factory: a fresh process (fresh
+        # transport rank) joining the fleet, timed separately — it
+        # pays full interpreter + jax + compile start
+        t1 = time.perf_counter()
+        spawned = factory.build(2)
+        router.add_replica(spawned)
+        respawn_s = time.perf_counter() - t1
+        row = {
+            "n_requests": n_req, "max_new": max_new,
+            "requests_completed": completed,
+            "bitwise_match": bool(bitwise),
+            "recovery_s": round(recovery.get("s", 0.0), 4),
+            "detect_s": round(victim.beat_budget(), 4),
+            "total_s": round(total_s, 4),
+            "respawn_s": round(respawn_s, 4),
+            "victim_exit_class":
+                (victim.death or {}).get("exit_class"),
+            "respawned_placeable": bool(spawned.placeable()),
+        }
+    finally:
+        pid_dir = factory.pid_dir
+        factory.close()
+        row["orphans_after_close"] = len(sweep_orphans(pid_dir))
+    return {"fleet_subprocess": row}
+
+
 def bench_gateway_storm(on_tpu):
     """Gateway overload gate row (ISSUE 12): two replicas behind the
     FleetGateway; the ``overload@admit`` chaos pattern turns every
@@ -1916,6 +2050,7 @@ WORKLOADS = (
     ("fleet", bench_fleet_serving, True),
     ("fleet_recovery", bench_fleet_recovery, True),
     ("host_recovery", bench_host_recovery, True),
+    ("fleet_subprocess", bench_fleet_subprocess, True),
     ("weight_publish", bench_weight_publish, True),
     ("gateway_storm", bench_gateway_storm, True),
     ("autoscale_storm", bench_autoscale_storm, True),

@@ -109,6 +109,23 @@ the replica slot being spawned / the replica index being retired::
     PT_FAULT_PLAN="kill@spawn#1"              # first spawn attempt dies
     PT_FAULT_PLAN="kill@retire#1:rank=2"      # replica 2 dies mid-drain
 
+The ``replica`` site is the PROCESS-event site for subprocess replicas
+(``inference/remote_replica.py``): the PARENT consults it once per
+``RemoteEngine.step`` against the child's real PID, so the fault is an
+actual OS signal, not a flag.  ``sigkill`` delivers SIGKILL (the child
+vanishes mid-decode — exercises missed-heartbeat detection, the
+requeue-fallback drain, and the exit-code taxonomy in flight dumps),
+``hang`` delivers SIGSTOP (the process survives but its heartbeats
+stop — liveness must be INFERRED, the hang indistinguishable from
+death until a SIGCONT lets the half-open probe restore it), and
+``delay`` stalls the parent's step.  ``sigkill``/``hang`` are only
+meaningful against a real PID, so they are valid ONLY at ``replica``;
+frame kinds are rejected there, matching the spawn/retire precedent.
+Use ``:rank=R`` with the replica's ``fault_rank``::
+
+    PT_FAULT_PLAN="sigkill@replica#4:rank=1"  # SIGKILL child 1 mid-run
+    PT_FAULT_PLAN="hang@replica#2"            # SIGSTOP: beats go quiet
+
 Every injected fault increments ``faults/injected`` and
 ``faults/<kind>`` in the metrics registry so a chaos run's report shows
 exactly what was thrown at the system.
@@ -133,10 +150,10 @@ __all__ = ["FaultAction", "FaultRule", "FaultPlan", "FaultInjector",
            "maybe_arm_from_env", "FAULT_KINDS", "FAULT_SITES"]
 
 FAULT_KINDS = ("drop", "delay", "dup", "corrupt", "kill", "partition",
-               "overload")
+               "overload", "sigkill", "hang")
 FAULT_SITES = ("send", "dial", "recv", "step", "save",
                "prefill", "decode", "migrate", "cache_save", "host",
-               "admit", "publish", "spawn", "retire")
+               "admit", "publish", "spawn", "retire", "replica")
 
 # frame-level kinds are meaningless away from the wire: the validator
 # REJECTS them at the process/host sites instead of silently no-oping
@@ -165,6 +182,14 @@ _PUBLISH_KINDS = ("kill", "delay", "drop", "corrupt")
 # rejected so a no-op plan fails CI instead of silently passing.
 _RESIZE_SITES = ("spawn", "retire")
 _RESIZE_KINDS = ("kill", "delay")
+# the replica site is a PROCESS event against a real child PID: the
+# parent delivers an actual OS signal (sigkill → SIGKILL, hang →
+# SIGSTOP), so those two kinds mean nothing anywhere else, and frame
+# kinds mean nothing there — both directions are rejected so a no-op
+# plan fails CI instead of silently passing (spawn/retire precedent)
+_REPLICA_SITES = ("replica",)
+_REPLICA_KINDS = ("sigkill", "hang", "delay")
+_SIGNAL_KINDS = ("sigkill", "hang")
 
 
 @dataclass(frozen=True)
@@ -286,6 +311,18 @@ def parse_plan(spec: str) -> FaultPlan:
                 f"{clause!r} (a resize is a process event — only "
                 f"{'/'.join(_RESIZE_KINDS)} fire at "
                 f"{'/'.join(_RESIZE_SITES)})")
+        if site in _REPLICA_SITES and kind not in _REPLICA_KINDS:
+            raise ValueError(
+                f"kind {kind!r} is meaningless at the {site!r} site in "
+                f"{clause!r} (a subprocess replica dies by OS signal — "
+                f"only {'/'.join(_REPLICA_KINDS)} fire at "
+                f"{'/'.join(_REPLICA_SITES)})")
+        if kind in _SIGNAL_KINDS and site not in _REPLICA_SITES:
+            raise ValueError(
+                f"kind {kind!r} delivers a real OS signal to a child "
+                f"PID: it only applies at the "
+                f"{'/'.join(_REPLICA_SITES)} site(s), not {site!r} in "
+                f"{clause!r}")
         for opt in opts:
             k, _, v = opt.partition("=")
             if k == "rank":

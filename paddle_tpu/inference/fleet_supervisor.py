@@ -240,19 +240,38 @@ class FleetSupervisor:
                     and not dst.has_weight_version(
                         int(getattr(r, "weight_version", 0) or 0)):
                 continue
-            if self.handoff_factory is not None:
-                send_tp, recv_tp, dst_rank, src_rank = \
-                    self.handoff_factory(src_idx, dst_idx)
+            if hasattr(src, "migrate_out") and hasattr(dst,
+                                                       "migrate_in"):
+                # process-isolated pair (remote_replica.RemoteEngine):
+                # the parent orchestrates but the KV pages travel
+                # CHILD-TO-CHILD over the shared transport world —
+                # CRC-checked and retransmitted on drop/corrupt like
+                # any frame
+                try:
+                    src.migrate_out(rid, dst)
+                    new_rid = dst.migrate_in(src)
+                except (PeerUnreachableError, EngineDeadError):
+                    # a dead source process has no end to ship from;
+                    # the requeue fallback rebuilds from the parent's
+                    # admission mirror instead
+                    return False
             else:
-                tp = LoopbackTransport()
-                send_tp, recv_tp, dst_rank, src_rank = tp, tp, 1, 0
-            try:
-                disagg.migrate_request(src, rid, send_tp, dst=dst_rank)
-            except (PeerUnreachableError, EngineDeadError):
-                # the dying engine cannot ship its pages at all (the
-                # drop@migrate failure mode): no peer will do better
-                return False
-            new_rid = disagg.receive_request(dst, recv_tp, src=src_rank)
+                if self.handoff_factory is not None:
+                    send_tp, recv_tp, dst_rank, src_rank = \
+                        self.handoff_factory(src_idx, dst_idx)
+                else:
+                    tp = LoopbackTransport()
+                    send_tp, recv_tp, dst_rank, src_rank = tp, tp, 1, 0
+                try:
+                    disagg.migrate_request(src, rid, send_tp,
+                                           dst=dst_rank)
+                except (PeerUnreachableError, EngineDeadError):
+                    # the dying engine cannot ship its pages at all
+                    # (the drop@migrate failure mode): no peer will do
+                    # better
+                    return False
+                new_rid = disagg.receive_request(dst, recv_tp,
+                                                 src=src_rank)
             h = self.router._by_engine.get((src_idx, rid))
             self._remap(h, src_idx, rid, dst_idx, new_rid)
             _m_drains.inc()

@@ -87,9 +87,19 @@ class Replica:
 
     def __init__(self, engine: ServingEngine, name: Optional[str] = None,
                  health_fn: Optional[Callable[[], bool]] = None,
-                 restore_after: int = 3, host_id: Optional[str] = None):
+                 restore_after: int = 3, host_id: Optional[str] = None,
+                 backend_kind: str = "tpu", cost_weight: float = 1.0):
         self.engine = engine
         self.name = name or f"replica{id(engine) & 0xffff:04x}"
+        # heterogeneous fleets: ``backend_kind`` tags the accelerator
+        # class ("tpu"/"cpu"/...), ``cost_weight`` scales its load score
+        # in routing order (a CPU replica serving the same batch is
+        # "more loaded" per request — weight > 1 makes the router prefer
+        # TPU slots of equal raw load).  Non-TPU replicas are OVERFLOW:
+        # they absorb new placements only once every TPU replica is at
+        # or past the router's ``tpu_saturation`` load
+        self.backend_kind = backend_kind
+        self.cost_weight = float(cost_weight)
         # failure-domain label: replicas sharing it die together under
         # host loss, and the fleet supervisor drains AWAY from it first
         self.host_id = host_id if host_id is not None \
@@ -182,11 +192,17 @@ class ReplicaRouter:
     handle."""
 
     def __init__(self, replicas, requeue_deadline_s: Optional[float] = None,
-                 max_requeues: int = 3):
+                 max_requeues: int = 3, tpu_saturation: float = 1.0):
         self.replicas: List[Replica] = [
             r if isinstance(r, Replica) else Replica(r) for r in replicas]
         if not self.replicas:
             raise ValueError("router needs at least one replica")
+        # heterogeneous overflow threshold: non-TPU replicas receive
+        # NEW placements only once every placeable TPU replica's load
+        # score is >= this (load_score is 0..2: 1.0 ~= full batch
+        # occupancy OR a full KV pool).  With an all-TPU (or all-CPU)
+        # fleet the gate is vacuous and ordering is pure load/cost.
+        self.tpu_saturation = float(tpu_saturation)
         # replica-list mutation guard (autoscaler resizes a live fleet):
         # add_replica/remove_replica mutate under this lock, and every
         # traversal (_ordered/step_all/_live_pending) iterates a
@@ -270,14 +286,33 @@ class ReplicaRouter:
         reps = self._snapshot()
         healthy = [i for i, r in enumerate(reps)
                    if i != exclude and r.placeable()]
+        # heterogeneous gate: while ANY TPU replica still has headroom
+        # (load below tpu_saturation), non-TPU replicas sort behind all
+        # TPU ones — they are overflow capacity, not peers.  Once the
+        # TPU tier saturates the gate opens and pure cost-weighted load
+        # decides.  Vacuously open for homogeneous fleets.
+        tpu_open = any(
+            getattr(reps[i], "backend_kind", "tpu") == "tpu"
+            and reps[i].load_score() < self.tpu_saturation
+            for i in healthy)
+
+        def overflow(i: int) -> int:
+            if not tpu_open:
+                return 0
+            return 0 if getattr(reps[i], "backend_kind", "tpu") == "tpu" \
+                else 1
+
+        def cost_load(i: int) -> float:
+            return reps[i].load_score() * getattr(reps[i],
+                                                  "cost_weight", 1.0)
         if prefer_off_host is not None:
             # drain ordering under host loss: peers OFF the failing host
             # first (they do not share its fate), load-sorted within
             # each group
             return sorted(healthy, key=lambda i: (
                 reps[i].host_id == prefer_off_host,
-                reps[i].load_score()))
-        return sorted(healthy, key=lambda i: reps[i].load_score())
+                overflow(i), cost_load(i)))
+        return sorted(healthy, key=lambda i: (overflow(i), cost_load(i)))
 
     def submit(self, prompt_tokens, max_new_tokens=8, sampling=None,
                eos_token_id=None, deadline_s=None, tenant=None,

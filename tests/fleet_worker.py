@@ -1,0 +1,86 @@
+"""Shared scaffolding for the cross-process fleet workers.
+
+One definition of the request identity — model config, prompt, stream
+key, sampling, reference stream — imported by ``gateway_worker.py``,
+``resilience_worker.py``, the replica-host tests, and the parent-side
+assertions, so the two ends of a cross-process run can never drift.
+
+Importing this module also performs the worker env bootstrap (CPU
+backend, no jax distributed, repo root on sys.path), so workers import
+it FIRST, before anything that pulls in jax.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# the request identity every cross-process run shares
+BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
+            max_batch=3, max_blocks_per_seq=6, token_budget=32)
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+MAX_NEW = 6
+STREAM_KEY = 777
+SALT_SEED = 0
+MODEL_SEED = 3
+
+
+def serving_config():
+    from paddle_tpu.inference.serving import PagedServingConfig
+
+    return PagedServingConfig(**BASE)
+
+
+def build_model(seed=MODEL_SEED):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import PagedCausalLM
+
+    paddle.seed(seed)
+    m = PagedCausalLM(serving_config())
+    m.eval()
+    return m
+
+
+def sampling():
+    from paddle_tpu.inference.serving import SamplingParams
+
+    return SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+
+
+def reference_stream(model=None, engine_seed=55, prompt=None,
+                     max_new=None, stream_key=STREAM_KEY,
+                     salt_seed=SALT_SEED):
+    """The uninterrupted single-engine stream under the pinned salt
+    identity — the bitwise parity target for every drained / migrated /
+    requeued run.  The engine seed is deliberately arbitrary: sampling
+    salts depend only on (salt_seed, stream_key, token index), so the
+    stream must not depend on which engine decodes it."""
+    from paddle_tpu.inference.serving import ServingEngine
+
+    if model is None:
+        model = build_model()
+    eng = ServingEngine.from_model(model, serving_config(),
+                                   seed=engine_seed)
+    rid = eng.add_request(list(prompt if prompt is not None else PROMPT),
+                          max_new_tokens=max_new or MAX_NEW,
+                          sampling=sampling())
+    eng._requests[rid].salt_rid = int(stream_key)
+    eng._requests[rid].salt_seed = int(salt_seed)
+    while eng.pending():
+        eng.step()
+    return list(eng._requests[rid].generated)
+
+
+def quiesce(tp, tag, ranks, linger_rank=0, linger_s=1.0):
+    """Both ranks quiesce before either tears down its sockets; the
+    store host (``linger_rank``) lingers briefly after the barrier —
+    exiting immediately can reset a peer's in-flight barrier poll."""
+    tp.barrier(tag, ranks)
+    if tp.rank == linger_rank:
+        time.sleep(linger_s)

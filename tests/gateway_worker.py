@@ -11,37 +11,20 @@ the remotely finished stream is bitwise-identical to rank 0's locally
 computed uninterrupted reference.
 """
 import os
-import sys
-import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+import fleet_worker  # env bootstrap first: sets backend + sys.path
 
 import numpy as np  # noqa: E402
 
-# keep the request identity in ONE place so the two ranks and the
-# parent's assertions cannot drift
-BASE = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
-            num_kv_heads=2, ffn_size=64, block_size=8, num_blocks=48,
-            max_batch=3, max_blocks_per_seq=6, token_budget=32)
-PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
-MAX_NEW = 6
-STREAM_KEY = 777
+# the request identity lives in ONE place (tests/fleet_worker.py) so
+# the two ranks and the parent's assertions cannot drift
+BASE = fleet_worker.BASE
+PROMPT = fleet_worker.PROMPT
+MAX_NEW = fleet_worker.MAX_NEW
+STREAM_KEY = fleet_worker.STREAM_KEY
 CHANNEL = "gw_drain"
 
-
-def _model():
-    import paddle_tpu as paddle
-    from paddle_tpu.inference.serving import (PagedCausalLM,
-                                              PagedServingConfig)
-
-    paddle.seed(3)
-    m = PagedCausalLM(PagedServingConfig(**BASE))
-    m.eval()
-    return m
+_model = fleet_worker.build_model
 
 
 def main():
@@ -50,12 +33,11 @@ def main():
     from paddle_tpu.distributed.transport import init_transport
     from paddle_tpu.inference import disagg
     from paddle_tpu.inference.serving import (PagedServingConfig,
-                                              SamplingParams,
                                               ServingEngine)
 
     model = _model()
     cfg = PagedServingConfig(**BASE)
-    sp = SamplingParams(temperature=0.8, top_k=20, top_p=0.95)
+    sp = fleet_worker.sampling()
     tp = init_transport()
     assert tp is not None
 
@@ -85,21 +67,14 @@ def main():
         disagg.migrate_request(eng, rid, tp, 1, channel=CHANNEL)
 
         # uninterrupted reference under the SAME salt identity the
-        # gateway pinned — the engine seed is deliberately different:
-        # the stream must not depend on it
-        ref_eng = ServingEngine.from_model(model, cfg, seed=55)
-        ref_rid = ref_eng.add_request(PROMPT, max_new_tokens=MAX_NEW,
-                                      sampling=sp)
-        ref_eng._requests[ref_rid].salt_rid = STREAM_KEY
-        ref_eng._requests[ref_rid].salt_seed = 0
-        while ref_eng.pending():
-            ref_eng.step()
+        # gateway pinned (fleet_worker.reference_stream — the engine
+        # seed is deliberately different: the stream must not depend
+        # on it)
+        ref = fleet_worker.reference_stream(model=model)
         np.savez(os.path.join(out_dir, "rank0.npz"),
                  pre=np.asarray(pre, dtype=np.int64),
-                 ref=np.asarray(ref_eng._requests[ref_rid].generated,
-                                dtype=np.int64))
-        tp.barrier("gw_drain_done", [0, 1])
-        time.sleep(1.0)        # rank 0 hosts the store: linger briefly
+                 ref=np.asarray(ref, dtype=np.int64))
+        fleet_worker.quiesce(tp, "gw_drain_done", [0, 1])
     else:
         eng = ServingEngine.from_model(model, cfg, seed=20)
         rid = disagg.receive_request(eng, tp, 0, channel=CHANNEL)
@@ -108,7 +83,7 @@ def main():
         np.savez(os.path.join(out_dir, "rank1.npz"),
                  post=np.asarray(eng._requests[rid].generated,
                                  dtype=np.int64))
-        tp.barrier("gw_drain_done", [0, 1])
+        fleet_worker.quiesce(tp, "gw_drain_done", [0, 1])
     tp.close()
 
 

@@ -34,13 +34,9 @@ Modes (env RESILIENCE_MODE):
 """
 import json
 import os
-import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("PADDLE_JAX_DISTRIBUTED", "0")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import fleet_worker  # env bootstrap first: sets backend + sys.path
 
 import numpy as np  # noqa: E402
 
@@ -69,12 +65,7 @@ def run_faults(out_dir, rank):
                  "comm/dup_frames", "faults/injected")}
     np.savez(os.path.join(out_dir, f"rank{rank}.npz"),
              metrics=json.dumps(counters), **results)
-    # both ranks quiesce before either tears down its sockets; rank 0
-    # hosts the store, so it lingers briefly after the barrier — exiting
-    # immediately can reset rank 1's in-flight barrier poll
-    tp.barrier("faults_done", [0, 1])
-    if rank == 0:
-        time.sleep(1.0)
+    fleet_worker.quiesce(tp, "faults_done", [0, 1])
 
 
 def run_kill(out_dir, rank):

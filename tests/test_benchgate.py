@@ -106,9 +106,10 @@ def test_fast_mode_selects_gate_rows_only():
     gate = [n for n, _fn, g in bench.WORKLOADS if g]
     assert gate == ["llama_train", "eager_dispatch", "serving",
                     "spec_decode", "fleet", "fleet_recovery",
-                    "host_recovery", "weight_publish", "gateway_storm",
+                    "host_recovery", "fleet_subprocess",
+                    "weight_publish", "gateway_storm",
                     "autoscale_storm", "autotune_rank"]
-    assert len(bench.WORKLOADS) == 16
+    assert len(bench.WORKLOADS) == 17
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +264,38 @@ def test_benchgate_host_recovery_row_gated_like_fleet(tmp_path):
                  _host_recovery_result()) == 1
     # a baseline predating the host_recovery row gates only the rest
     assert _gate(tmp_path, _host_recovery_result(), _result()) == 0
+
+
+def _subprocess_result(completed=6.0, bitwise=True, recovery=0.35,
+                       **kw):
+    out = _result(**kw)
+    out["extra"]["fleet_subprocess"] = {
+        "fleet_subprocess": {"n_requests": 6, "max_new": 6,
+                             "requests_completed": completed,
+                             "bitwise_match": bitwise,
+                             "recovery_s": recovery,
+                             "detect_s": 10.0, "respawn_s": 2.6,
+                             "victim_exit_class": "killed",
+                             "orphans_after_close": 0},
+    }
+    return out
+
+
+def test_benchgate_subprocess_row_zero_slack_on_loss_and_bitwise(
+        tmp_path):
+    """fleet_subprocess (a worker PROCESS SIGKILLed mid-decode):
+    losing one request or one diverged stream fails with zero slack;
+    recovery_s is thresholded; respawn_s/detect_s ride ungated."""
+    assert _gate(tmp_path, _subprocess_result(recovery=0.36),
+                 _subprocess_result()) == 0
+    assert _gate(tmp_path, _subprocess_result(completed=5.0),
+                 _subprocess_result()) == 1
+    assert _gate(tmp_path, _subprocess_result(bitwise=False),
+                 _subprocess_result()) == 1
+    assert _gate(tmp_path, _subprocess_result(recovery=0.60),
+                 _subprocess_result()) == 1
+    # a baseline predating the row gates only the rest
+    assert _gate(tmp_path, _subprocess_result(), _result()) == 0
 
 
 def _gateway_result(completed=6.0, goodput=230.0, ttft=0.022,

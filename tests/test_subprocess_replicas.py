@@ -1,0 +1,630 @@
+"""Process-isolated replicas (ISSUE 20): subprocess ReplicaFactory +
+real cross-host serving fault domains.
+
+Layers under test:
+
+  * `inference/replica_host.py` — the worker process: one
+    ``ServingEngine`` behind the CRC/ACK ``TensorTransport`` as framed
+    RPCs, heartbeats carrying live gauges, orphan self-exit.
+  * `inference/remote_replica.py` — the parent half: ``RemoteEngine``
+    (full engine proxy surface), ``RemoteReplica`` (liveness probe =
+    PID + fresh beats), ``SubprocessReplicaFactory`` (spawn / weight
+    catch-up / teardown against a real PID), ``classify_exit``
+    taxonomy, ``sweep_orphans``.
+  * `inference/router.py` — heterogeneous fleets: ``backend_kind``
+    overflow gating and ``cost_weight`` in `_ordered`.
+  * `resilience/faults.py` — the process-event fault sites
+    (``sigkill@replica`` / ``hang@replica``), delivered by the PARENT
+    as real OS signals to a child PID.
+
+The acceptance invariant throughout the e2e tests: a subprocess fleet
+that takes a SIGKILL / SIGSTOP / lossy transport mid-decode finishes
+every stream token-bitwise-identical to the uninterrupted
+single-process reference, loses zero requests, and leaves zero child
+PIDs behind.
+
+The e2e tests spawn real jax-importing children and are marked
+``slow`` — each child pays the full interpreter + jax + compile
+startup.  Run them with ``-m slow``.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import fleet_worker
+from paddle_tpu.distributed.resilience import faults
+from paddle_tpu.distributed.resilience.errors import EngineDeadError
+from paddle_tpu.inference.autoscaler import (AutoScaler, AutoScalerConfig,
+                                             SpawnError)
+from paddle_tpu.inference.fleet_supervisor import (FleetSupervisor,
+                                                   FleetSupervisorConfig)
+from paddle_tpu.inference.gateway import (FleetGateway, GatewayConfig,
+                                          default_classes)
+from paddle_tpu.inference.remote_replica import (RemoteReplica,
+                                                 SubprocessReplicaFactory,
+                                                 classify_exit,
+                                                 sweep_orphans)
+from paddle_tpu.inference.router import Replica, ReplicaRouter
+from paddle_tpu.inference.serving import PagedServingConfig, ServingEngine
+from paddle_tpu.inference.weight_publish import (WeightPublisher,
+                                                 build_weight_set)
+from paddle_tpu.profiler import metrics as _metrics
+from paddle_tpu.profiler import timeline as _timeline
+from paddle_tpu.profiler import tracing as _tracing
+from paddle_tpu.profiler.aggregate import FleetAggregator
+from paddle_tpu.profiler.headroom import ScaleAdvice
+
+BASE = fleet_worker.BASE
+PROMPT = fleet_worker.PROMPT
+MAX_NEW = fleet_worker.MAX_NEW
+STREAM_KEY = fleet_worker.STREAM_KEY
+SALT_SEED = fleet_worker.SALT_SEED
+SP = fleet_worker.sampling()
+
+# the 1-vCPU CI box runs parent + two jax children on one core: child
+# compiles stall beats for many seconds, so liveness budgets here are
+# generous (10s+) and rpc/spawn timeouts far above any healthy run
+HB_KW = dict(hb_interval_s=0.25, hb_miss_n=40)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.disarm()
+    _tracing.flight.detach("timeline")
+    _tracing.set_flight_dir(None)
+    for tl in list(_timeline._sinks):
+        _timeline.uninstall(tl)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return fleet_worker.build_model()
+
+
+@pytest.fixture()
+def factory(tmp_path):
+    f = _mk_factory(tmp_path)
+    yield f
+    f.close()
+
+
+def _mk_factory(tmp_path, **kw):
+    for k, v in HB_KW.items():
+        kw.setdefault(k, v)
+    kw.setdefault("ack_timeout", 5.0)
+    kw.setdefault("rpc_timeout", 300.0)
+    kw.setdefault("spawn_timeout", 300.0)
+    kw.setdefault("store_timeout", 300.0)
+    return SubprocessReplicaFactory(
+        BASE, model_seed=fleet_worker.MODEL_SEED, seed_base=100,
+        pid_dir=str(tmp_path / "pids"), **kw)
+
+
+def _pin(engine, rid, stream_key=STREAM_KEY, salt_seed=SALT_SEED):
+    r = engine._requests[rid]
+    r.salt_rid = int(stream_key)
+    r.salt_seed = int(salt_seed)
+    return r
+
+
+def _deadline_free_gateway(router):
+    cls = default_classes()
+    for c in cls.values():
+        c.deadline_s = None
+    return FleetGateway(router, GatewayConfig(classes=cls))
+
+
+def _perturbed(model, noise_seed=5):
+    from paddle_tpu.jit import functional as FB
+
+    nrng = np.random.RandomState(noise_seed)
+    out = {}
+    for k, v in FB.current_params(model).items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            f = a.astype(np.float32)
+            out[k] = (f + nrng.normal(
+                0.0, 0.03 * (np.std(f) + 1e-6), f.shape)).astype(a.dtype)
+        else:
+            out[k] = a
+    return out
+
+
+def _reference_at_version(model, params, version, prompt=PROMPT,
+                          stream_key=STREAM_KEY, salt_seed=SALT_SEED,
+                          max_new=MAX_NEW):
+    """The uninterrupted single-process stream pinned at a published
+    weight version — the bitwise referee for every chaos run."""
+    eng = ServingEngine.from_model(model, PagedServingConfig(**BASE),
+                                   seed=0)
+    if version > 0:
+        arrays, crcs = build_weight_set(model, params, eng.cfg)
+        eng.stage_weight_set(version, arrays, crcs=crcs)
+        eng.commit_weight_set(version)
+    rid = eng.add_request(list(prompt), max_new_tokens=max_new,
+                          sampling=SP)
+    r = _pin(eng, rid, stream_key, salt_seed)
+    if version > 0:
+        eng.pin_weight_version(rid, version)
+    while not r.done:
+        eng.step()
+    return list(r.generated)
+
+
+def _up():
+    return ScaleAdvice("scale_up", "scripted storm", 1.5, None, None,
+                       None)
+
+
+class StubAdvisor:
+    def __init__(self, *script):
+        self.script = list(script)
+        self.tracker = None
+
+    def recommend(self, replica_loads=None, now=None):
+        if len(self.script) > 1:
+            return self.script.pop(0)
+        if self.script:
+            return self.script[0]
+        return ScaleAdvice("hold", "scripted", 0.5, None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# fault DSL: the process-event sites
+# ---------------------------------------------------------------------------
+
+def test_replica_fault_sites_parse_and_signal_kinds_are_fenced():
+    plan = faults.parse_plan("sigkill@replica#2:rank=1")
+    assert plan.rules[0].kind == "sigkill"
+    assert plan.rules[0].site == "replica"
+    assert plan.rules[0].rank == 1
+    faults.parse_plan("hang@replica#1")
+    faults.parse_plan("delay@replica%0.5")
+    # transport faults are meaningless at a process-event site ...
+    with pytest.raises(ValueError, match="replica"):
+        faults.parse_plan("corrupt@replica#1")
+    with pytest.raises(ValueError, match="replica"):
+        faults.parse_plan("kill@replica#1")
+    # ... and OS signals only make sense against a child PID
+    with pytest.raises(ValueError, match="OS signal"):
+        faults.parse_plan("sigkill@send#1")
+    with pytest.raises(ValueError, match="OS signal"):
+        faults.parse_plan("hang@recv#1")
+
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy
+# ---------------------------------------------------------------------------
+
+def test_classify_exit_taxonomy():
+    assert classify_exit(0)["exit_class"] == "clean"
+    assert classify_exit(None)["exit_class"] == "unresponsive"
+    assert classify_exit(-9)["exit_class"] == "killed"
+    assert classify_exit(-9, oom_score=950)["exit_class"] \
+        == "oom_kill_suspect"
+    assert classify_exit(-9, oom_score=100)["exit_class"] == "killed"
+    assert classify_exit(-15)["exit_class"] == "signal_15"
+    assert classify_exit(3)["exit_class"] == "nonzero"
+    note = classify_exit(-9, oom_score=950)
+    assert note["exit_code"] == -9 and note["oom_score"] == 950
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous fleets: overflow gating + cost-weighted ordering
+# ---------------------------------------------------------------------------
+
+class _GaugeEngine:
+    """Engine-shaped stub with scripted load gauges — enough surface
+    for Replica/load_score/_ordered without touching jax."""
+
+    def __init__(self, pending_n=0, used_pages=0):
+        self.cfg = PagedServingConfig(**BASE)
+        self._pending = [object()] * pending_n
+        self._free_pages = list(
+            range(self.cfg.num_blocks - 1 - used_pages))
+        self._requests = {}
+        self._prefix_cache = None
+        self.requeue_hook = None
+        self.dead = False
+
+    def pending(self):
+        return self._pending
+
+
+def _hetero_router(specs, **router_kw):
+    reps = [Replica(_GaugeEngine(pending_n=p), name=f"h{i}",
+                    backend_kind=bk, cost_weight=cw)
+            for i, (bk, cw, p) in enumerate(specs)]
+    return ReplicaRouter(reps, **router_kw)
+
+
+def test_cpu_replicas_are_overflow_while_tpu_has_headroom():
+    # the idle CPU replica would win a pure load sort; the gate keeps
+    # it behind the busier TPU ones while they still have headroom
+    router = _hetero_router([("tpu", 1.0, 1), ("tpu", 1.0, 2),
+                             ("cpu", 1.0, 0)])
+    assert router._ordered() == [0, 1, 2]
+
+
+def test_gate_opens_once_every_tpu_replica_saturates():
+    # both TPU replicas at/past full batch occupancy (load >= 1.0):
+    # the idle CPU replica now sorts first on pure cost-load
+    router = _hetero_router([("tpu", 1.0, 3), ("tpu", 1.0, 3),
+                             ("cpu", 1.0, 0)])
+    assert router._ordered()[0] == 2
+
+
+def test_cost_weight_breaks_ties_toward_cheap_backends():
+    # gate open (no TPU headroom); equal raw load on both CPU
+    # replicas, but the 4x cost weight makes one "more loaded" than
+    # even the saturated TPU slot
+    router = _hetero_router([("tpu", 1.0, 3), ("cpu", 4.0, 1),
+                             ("cpu", 1.0, 1)])
+    assert router._ordered() == [2, 0, 1]
+
+
+def test_homogeneous_fleet_ordering_is_pure_load():
+    # the gate is vacuous for an all-TPU fleet: order == load order
+    router = _hetero_router([("tpu", 1.0, 2), ("tpu", 1.0, 0),
+                             ("tpu", 1.0, 1)])
+    assert router._ordered() == [1, 2, 0]
+
+
+def test_saturation_threshold_is_tunable():
+    # threshold 0.3: one request of three (occ 1/3 >= 0.3) already
+    # counts as saturated, so the CPU replica takes overflow early
+    router = _hetero_router([("tpu", 1.0, 1), ("cpu", 1.0, 0)],
+                            tpu_saturation=0.3)
+    assert router._ordered()[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# orphan reaping
+# ---------------------------------------------------------------------------
+
+def _sleeper():
+    return subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def test_sweep_orphans_kills_only_children_of_dead_parents(tmp_path):
+    pid_dir = str(tmp_path / "pids")
+    os.makedirs(pid_dir)
+    # a genuinely dead "parent" pid: spawn-and-wait a no-op
+    dead_parent = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead_parent.wait()
+
+    orphan = _sleeper()
+    adopted = _sleeper()
+    try:
+        with open(os.path.join(pid_dir, "replica_r1.pid"), "w") as f:
+            json.dump({"pid": orphan.pid, "ppid": dead_parent.pid,
+                       "rank": 1, "job": "t"}, f)
+        with open(os.path.join(pid_dir, "replica_r2.pid"), "w") as f:
+            json.dump({"pid": adopted.pid, "ppid": os.getpid(),
+                       "rank": 2, "job": "t"}, f)
+        before = _metrics.registry().snapshot()["counters"].get(
+            "serving/orphans_reaped", 0)
+        killed = sweep_orphans(pid_dir)
+        assert killed == [orphan.pid]
+        assert orphan.wait(timeout=10) == -signal.SIGKILL
+        # the live parent's child survives, and keeps its pid file
+        assert adopted.poll() is None
+        names = sorted(os.listdir(pid_dir))
+        assert names == ["replica_r2.pid"]
+        after = _metrics.registry().snapshot()["counters"].get(
+            "serving/orphans_reaped", 0)
+        assert after == before + 1
+    finally:
+        for p in (orphan, adopted):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def test_sweep_orphans_prunes_stale_entries_for_exited_pids(tmp_path):
+    pid_dir = str(tmp_path / "pids")
+    os.makedirs(pid_dir)
+    gone = subprocess.Popen([sys.executable, "-c", "pass"])
+    gone.wait()
+    with open(os.path.join(pid_dir, "replica_r3.pid"), "w") as f:
+        json.dump({"pid": gone.pid, "ppid": gone.pid, "rank": 3,
+                   "job": "t"}, f)
+    assert sweep_orphans(pid_dir) == []
+    assert os.listdir(pid_dir) == []
+
+
+# ---------------------------------------------------------------------------
+# e2e: one subprocess replica, full RPC surface
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_subprocess_replica_round_trip_is_bitwise(model, tmp_path):
+    """Spawn one real worker process; a stream decoded over the framed
+    RPC wire — salt identity forwarded from the parent mirror — is
+    token-bitwise-identical to the in-process reference, and teardown
+    reaps the PID and its pid file."""
+    factory = _mk_factory(tmp_path)
+    try:
+        rep = factory.build(0)
+        assert isinstance(rep, RemoteReplica)
+        eng = rep.engine
+        pid = eng.pid
+        assert eng.process_healthy()
+        router = ReplicaRouter([rep])
+
+        h = router.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP)
+        _, rid = router._handles[h]
+        # salt identity pinned on the parent-side mirror must land in
+        # the child before the first token samples
+        _pin(eng, rid)
+        out = router.run_to_completion()
+        assert out[h] == fleet_worker.reference_stream(model=model)
+
+        # heartbeats: the child has been beating the whole time
+        eng.poll_heartbeats()
+        assert eng.beat_age() <= eng.beat_budget()
+        assert eng._last_beat_n > 0
+    finally:
+        factory.close()
+    assert not _pid_running(pid)
+    # pid files swept; the child's log stays behind for forensics
+    leftover = [n for n in os.listdir(str(tmp_path / "pids"))
+                if n.endswith(".pid")]
+    assert leftover == []
+
+
+def _pid_running(pid):
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# e2e: the acceptance chaos run — SIGKILL mid-decode
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sigkill_mid_decode_acceptance(model, tmp_path):
+    """The ISSUE 20 acceptance run: a 2-replica subprocess fleet
+    behind the gateway takes a SIGKILL of one worker mid-decode.  The
+    supervisor detects the death via missed heartbeats, drains the
+    victim's streams to the survivor over the requeue fallback, the
+    autoscaler respawns through the factory with weight catch-up to
+    the committed version — and every finished stream is
+    token-bitwise-identical to the uninterrupted single-process
+    reference.  Zero requests lost; the orphan sweep finds nothing."""
+    factory = _mk_factory(tmp_path)
+    try:
+        router = ReplicaRouter([factory.build(0), factory.build(1)])
+        sup = FleetSupervisor(
+            router, factory.make_engine_factory(),
+            cfg=FleetSupervisorConfig(restart=False))
+        pub = WeightPublisher(router, model, supervisor=sup)
+        params = _perturbed(model)
+        pub.publish(params=params)   # canary probes ride the RPC wire
+        assert pub.version == 1
+        assert all(r.engine.active_weight_version == 1
+                   for r in router.replicas)
+
+        gw = _deadline_free_gateway(router)
+        keys = {}
+        for i in range(3):
+            t = gw.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP,
+                          tenant=f"t{i}", stream_key=STREAM_KEY + i)
+            keys[t] = STREAM_KEY + i
+        gw.pump()
+        # both children must hold work so the victim dies MID-decode
+        assert all(r.engine.pending() for r in router.replicas)
+
+        victim = router.replicas[1].engine
+        vpid = victim.pid
+        # the rank filter matches the engine's fault_rank — the child
+        # TRANSPORT rank, not the replica index
+        faults.arm(f"sigkill@replica#2:rank={victim.child_rank}")
+        deadline = time.monotonic() + 600
+        while True:
+            gw.step()
+            out = gw.results()
+            if len(out) == 3 \
+                    and all(len(v) == MAX_NEW for v in out.values()):
+                break
+            if time.monotonic() > deadline:
+                pytest.fail("fleet did not finish after the SIGKILL")
+            time.sleep(0.01)
+        assert len(out) == 3, "a request was lost"
+        # death forensics: inferred from silence, classified as a kill
+        assert victim.dead
+        assert victim.death["reason"] == "missed_heartbeats"
+        assert victim.death["exit_class"] == "killed"
+        assert not _pid_running(vpid)
+
+        # the autoscaler respawns the slot through the factory, and
+        # the catch-up gate brings the fresh child to version 1
+        sc = AutoScaler(router, sup, StubAdvisor(_up()), factory,
+                        AutoScalerConfig(min_replicas=1, max_replicas=4,
+                                         scale_up_after=1,
+                                         scale_down_after=1,
+                                         cooldown_evals=0,
+                                         catchup_timeout_s=600.0,
+                                         spawn_backoff_base_s=0.0,
+                                         spawn_backoff_cap_s=0.0),
+                        gateway=gw, publisher=pub)
+        rec = sc.evaluate()
+        assert rec["action"] == "scale_up"
+        spawned = router.replicas[-1]
+        assert spawned.engine.active_weight_version == 1
+        assert spawned.placeable()
+
+        # bitwise parity: every stream matches the uninterrupted
+        # single-process reference pinned at version 1
+        for t, key in keys.items():
+            ref = _reference_at_version(model, params, 1,
+                                        stream_key=key)
+            assert out[t] == ref, f"stream {key} diverged"
+    finally:
+        factory.close()
+    assert sweep_orphans(str(tmp_path / "pids")) == []
+    assert not _pid_running(vpid)
+
+
+# ---------------------------------------------------------------------------
+# e2e: hang (SIGSTOP) → heartbeat demotion → restart → half-open restore
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_hang_demotion_restart_and_half_open_restore(model, tmp_path):
+    """A SIGSTOPped child stops beating but its PID stays alive: the
+    parent must INFER death from silence, reap the hung PID, drain to
+    the survivor, and the supervisor's restart must spawn a fresh
+    process (fresh transport rank) that half-open probes restore to
+    rotation."""
+    factory = _mk_factory(tmp_path, hb_interval_s=0.25, hb_miss_n=25)
+    try:
+        router = ReplicaRouter([factory.build(0), factory.build(1)])
+        sup = FleetSupervisor(
+            router, factory.make_engine_factory(),
+            cfg=FleetSupervisorConfig(max_restarts=2,
+                                      backoff_base_s=0.0,
+                                      backoff_cap_s=0.0))
+        victim = router.replicas[1].engine
+        vpid, vrank = victim.pid, victim.child_rank
+
+        h0 = router.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP,
+                           prefer=0)
+        h1 = router.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP,
+                           prefer=1)
+        _pin(router.replicas[0].engine, router._handles[h0][1])
+        _pin(victim, router._handles[h1][1], STREAM_KEY + 1)
+        router.step_all()
+
+        faults.arm(f"hang@replica#1:rank={victim.child_rank}")
+        # drive the fleet by wall clock, not step count: the hung
+        # child's death is INFERRED after the heartbeat budget, and a
+        # tight step loop would exhaust any step cap first
+        deadline = time.monotonic() + 600
+        while router._live_pending():
+            router.step_all()
+            if time.monotonic() > deadline:
+                pytest.fail("fleet did not converge after the hang")
+            time.sleep(0.01)
+        out = router.results()
+        assert len(out[h0]) == MAX_NEW and len(out[h1]) == MAX_NEW
+        assert out[h0] == fleet_worker.reference_stream(model=model)
+        assert out[h1] == fleet_worker.reference_stream(
+            model=model, stream_key=STREAM_KEY + 1)
+
+        # the hung PID was reaped at declare-dead time; the restarted
+        # slot is a NEW process on a NEVER-REUSED transport rank
+        assert victim.dead
+        assert victim.death["exit_class"] == "unresponsive"
+        assert victim.death["reaped"]
+        assert not _pid_running(vpid)
+        fresh = router.replicas[1].engine
+        assert fresh is not victim
+        assert fresh.child_rank > vrank
+        assert fresh.pid != vpid
+
+        # half-open restore: the replica was demoted by the failure;
+        # consecutive passing probes of the FRESH process restore it
+        rep = router.replicas[1]
+        for _ in range(rep.restore_after + 1):
+            rep.probe()
+        assert rep.placeable()
+        h2 = router.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP,
+                           prefer=1)
+        assert router._handles[h2][0] == 1
+        _pin(fresh, router._handles[h2][1], STREAM_KEY + 2)
+        out2 = router.run_to_completion(max_steps=100000)
+        assert out2[h2] == fleet_worker.reference_stream(
+            model=model, stream_key=STREAM_KEY + 2)
+    finally:
+        factory.close()
+    assert sweep_orphans(str(tmp_path / "pids")) == []
+
+
+# ---------------------------------------------------------------------------
+# e2e: cross-process drain under frame corruption — retransmit, not requeue
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_drain_migrates_child_to_child_under_frame_corruption(
+        model, tmp_path):
+    """A live drain between two worker processes while the source
+    child's sends drop AND corrupt 20% of frames: the CRC/ACK
+    transport must retransmit its way through — the migration path
+    completes (``serving/drains``) without falling back to the requeue
+    path (``serving/drain_requeues`` stays 0) — and the migrated
+    stream finishes bitwise on the survivor."""
+    factory = _mk_factory(
+        tmp_path, hb_interval_s=0.5, hb_miss_n=60, ack_timeout=2.0,
+        env_extra={
+            "PT_FAULT_PLAN":
+                "seed=5,drop@send%0.2:rank=1,corrupt@send%0.2:rank=1",
+            "PT_ACK_TIMEOUT": "2",
+        })
+    try:
+        router = ReplicaRouter([factory.build(0), factory.build(1)])
+        sup = FleetSupervisor(router, factory.make_engine_factory())
+        src = router.replicas[0].engine
+
+        h = router.submit(PROMPT, max_new_tokens=MAX_NEW, sampling=SP,
+                          prefer=0)
+        _pin(src, router._handles[h][1])
+        # step to the decode tip so real KV pages travel child-to-child
+        while not src._requests[router._handles[h][1]].generated:
+            router.step_all()
+
+        snap0 = _metrics.registry().snapshot()["counters"]
+        assert sup.drain(0)
+        snap1 = _metrics.registry().snapshot()["counters"]
+        assert snap1.get("serving/drains", 0) \
+            == snap0.get("serving/drains", 0) + 1
+        assert snap1.get("serving/drain_requeues", 0) \
+            == snap0.get("serving/drain_requeues", 0)
+        assert router._handles[h][0] == 1
+
+        out = router.run_to_completion(max_steps=100000)
+        assert out[h] == fleet_worker.reference_stream(model=model)
+
+        # the lossy child really was lossy: its own comm counters show
+        # retransmits (shipped over the metrics wire)
+        agg = FleetAggregator()
+        src.publish_metrics()
+        agg.poll(factory.transport(), src.child_rank)
+        snap = agg.replica_snapshot(src.host_id, src.name)
+        comm = snap["counters"]
+        assert comm.get("comm/retries", 0) > 0 \
+            or comm.get("comm/corrupt_frames", 0) > 0
+    finally:
+        factory.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: spawn failure surfaces the exit taxonomy + child log tail
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_spawn_failure_surfaces_exit_class_and_log_tail(tmp_path):
+    factory = _mk_factory(tmp_path, artifact=str(tmp_path / "missing"),
+                          spawn_timeout=120.0)
+    try:
+        with pytest.raises(SpawnError) as ei:
+            factory.build(0)
+        msg = str(ei.value)
+        assert "nonzero" in msg or "signal" in msg
+        # the child's stderr tail rides the error for forensics
+        assert "replica_r" in msg or "Error" in msg
+    finally:
+        factory.close()
+    assert sweep_orphans(str(tmp_path / "pids")) == []

@@ -205,6 +205,16 @@ _AUTOSCALE_GATES = {"requests_completed": True, "bitwise_match": True,
 # recorded in the row but not gated: tens of milliseconds of pure
 # python is too noisy for a 5% latency gate.
 _AUTOTUNE_GATES = {"configs_ranked": True, "pareto_consistent": True}
+# fleet_subprocess: one WORKER PROCESS SIGKILLed mid-decode (ISSUE 20)
+# — death inferred from missed heartbeats, the drain's dead-process
+# path requeues to the surviving worker, a fresh process respawns via
+# the factory.  requests_completed and bitwise_match are zero-slack (a
+# pod kill may never lose an admitted request or perturb a surviving
+# stream); recovery_s must not rise past the normal threshold.
+# respawn_s/detect_s ride in the row unguarded — respawn pays a full
+# interpreter + jax start and is too noisy for a 5% latency gate.
+_SUBPROC_GATES = {"requests_completed": True, "bitwise_match": True,
+                  "recovery_s": False}
 _CHAOS_ROWS = (
     # fleet_recovery: one replica killed mid-decode; host_recovery: a
     # whole host's replicas felled at once; gateway_storm: every
@@ -213,6 +223,8 @@ _CHAOS_ROWS = (
     # weight_publish: canary-gated hot swap under live traffic
     ("fleet_recovery", _RECOVERY_GATES, ("requests_completed",)),
     ("host_recovery", _RECOVERY_GATES, ("requests_completed",)),
+    ("fleet_subprocess", _SUBPROC_GATES,
+     ("requests_completed", "bitwise_match")),
     ("gateway_storm", _GATEWAY_GATES,
      ("interactive_completed", "interactive_slo_attainment")),
     ("spec_decode", _SPEC_GATES, ("bitwise_match",)),

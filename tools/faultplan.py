@@ -10,7 +10,12 @@ sees it).
 Usage:
   python tools/faultplan.py "drop@send#2,kill@step#5:rank=1"
   python tools/faultplan.py --check "seed=7,corrupt@send%0.05"
+  python tools/faultplan.py --check "sigkill@replica#4:rank=1"
   PT_FAULT_PLAN="kill@save#1" python tools/faultplan.py
+
+Process-event sites reject frame kinds (and vice versa): a
+``corrupt@replica`` or a ``sigkill@send`` fails here, in
+milliseconds, instead of silently no-oping on the pod.
 
 Exit codes: 0 = plan parses (normalized form printed), 2 = invalid.
 """

@@ -157,6 +157,10 @@ KNOWN_METRICS = (
     "autoscale/actions", "autoscale/spawn_failures",
     "autoscale/catchup_ms", "autoscale/drain_ms",
     "autoscale/frozen_evals", "autoscale/fleet_size",
+    # process-isolated replicas (inference/remote_replica.py): child
+    # spawns, heartbeat-declared process deaths, orphan-sweep reaps
+    "serving/replica_spawns", "serving/replica_process_deaths",
+    "serving/orphans_reaped",
 )
 
 
